@@ -1,0 +1,23 @@
+"""Test config: force CPU with a virtual 8-device mesh.
+
+The session environment pins JAX_PLATFORMS=axon (one real TPU chip through a
+tunnel) and a sitecustomize imports jax at interpreter startup, so the env var
+is already captured by the time conftest runs. jax.config.update is the only
+override that still works here — it must happen before any backend
+initialization. XLA_FLAGS is read at backend init, so setting it here is
+still in time.
+
+Multi-chip sharding tests then run against the 8 virtual CPU devices, per the
+project environment notes; the driver separately dry-runs the multi-chip path
+via __graft_entry__.dryrun_multichip.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,199 @@
+"""Forecaster kernels vs straightforward numpy reference loops."""
+import numpy as np
+import pytest
+
+from foremast_tpu.ops import forecast as fc
+
+
+def _series(seed, T=64, gaps=True):
+    rng = np.random.default_rng(seed)
+    x = (10 + np.sin(np.arange(T) * 0.3) * 3 + rng.normal(0, 0.5, T)).astype(
+        np.float32
+    )
+    mask = np.ones(T, bool)
+    if gaps:
+        mask[rng.choice(T, size=T // 8, replace=False)] = False
+    return x, mask
+
+
+def _np_ses(x, mask, alpha):
+    preds = np.zeros_like(x)
+    s = x[np.argmax(mask)]
+    for t in range(len(x)):
+        preds[t] = s
+        if mask[t]:
+            s = alpha * x[t] + (1 - alpha) * s
+    return preds
+
+
+def _np_des(x, mask, alpha, beta):
+    preds = np.zeros_like(x)
+    l = x[np.argmax(mask)]
+    b = 0.0
+    for t in range(len(x)):
+        preds[t] = l + b
+        if mask[t]:
+            l_new = alpha * x[t] + (1 - alpha) * (l + b)
+            b = beta * (l_new - l) + (1 - beta) * b
+            l = l_new
+        else:
+            l = l + b
+    return preds
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_ses_matches_numpy(seed):
+    x, mask = _series(seed)
+    alpha = 0.4
+    got = np.asarray(fc.ses_predictions(x[None], mask[None], np.float32([alpha])))[0]
+    np.testing.assert_allclose(got, _np_ses(x, mask, alpha), rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_des_matches_numpy(seed):
+    x, mask = _series(seed)
+    got = np.asarray(
+        fc.des_predictions(x[None], mask[None], np.float32([0.5]), np.float32([0.2]))
+    )[0]
+    np.testing.assert_allclose(got, _np_des(x, mask, 0.5, 0.2), rtol=1e-4, atol=1e-4)
+
+
+def test_moving_average_causal():
+    x = np.arange(10, dtype=np.float32)
+    mask = np.ones(10, bool)
+    got = np.asarray(fc.moving_average_predictions(x[None], mask[None], 3))[0]
+    # pred[t] = mean of last 3 points before t
+    np.testing.assert_allclose(got[4], np.mean([1, 2, 3]))
+    np.testing.assert_allclose(got[1], 0.0)  # only x[0] seen
+    np.testing.assert_allclose(got[0], 0.0)  # nothing seen -> first valid value
+
+
+def test_moving_average_skips_gaps():
+    # window covers time slots [t-3, t); the masked slot shrinks the sample
+    x = np.array([1, 100, 3, 5, 7], np.float32)
+    mask = np.array([True, False, True, True, True])
+    got = np.asarray(fc.moving_average_predictions(x[None], mask[None], 3))[0]
+    np.testing.assert_allclose(got[4], np.mean([3, 5]))  # 100 never enters
+
+
+def test_holt_winters_learns_seasonality():
+    P = 12
+    t = np.arange(240)
+    x = (10 + 5 * np.sin(2 * np.pi * t / P)).astype(np.float32)
+    mask = np.ones_like(x, bool)
+    preds = np.asarray(
+        fc.holt_winters_predictions(
+            x[None], mask[None], P, np.float32([0.3]), np.float32([0.05]), np.float32([0.3])
+        )
+    )[0]
+    # after two seasons, predictions track the cycle closely
+    err = np.abs(preds[3 * P :] - x[3 * P :]).mean()
+    assert err < 0.6, err
+
+
+def test_fit_holt_winters_beats_fixed_bad_params():
+    P = 12
+    t = np.arange(240)
+    rng = np.random.default_rng(0)
+    x = (10 + 5 * np.sin(2 * np.pi * t / P) + rng.normal(0, 0.2, t.size)).astype(
+        np.float32
+    )
+    mask = np.ones_like(x, bool)
+    fit_region = np.zeros_like(mask)
+    fit_region[2 * P :] = True
+    params, preds = fc.fit_holt_winters(x[None], mask[None], fit_region[None], P)
+    sse_fit = np.mean((np.asarray(preds)[0][fit_region] - x[fit_region]) ** 2)
+    bad = np.asarray(
+        fc.holt_winters_predictions(
+            x[None], mask[None], P, np.float32([0.9]), np.float32([0.3]), np.float32([0.05])
+        )
+    )[0]
+    sse_bad = np.mean((bad[fit_region] - x[fit_region]) ** 2)
+    assert sse_fit <= sse_bad + 1e-6
+
+
+def test_band_anomalies_modes():
+    B, T = 3, 20
+    x = np.zeros((B, T), np.float32)
+    mask = np.ones((B, T), bool)
+    region = np.zeros((B, T), bool)
+    region[:, 10:] = True
+    preds = np.zeros((B, T), np.float32)
+    x[0, 15] = 10.0  # spike up
+    x[1, 15] = -10.0  # spike down
+    x[2, 15] = -10.0  # spike down but upper-only bound
+    sigma = np.ones(B, np.float32)
+    thr = np.full(B, 3.0, np.float32)
+    modes = np.array([fc.BOUND_BOTH, fc.BOUND_BOTH, fc.BOUND_UPPER], np.int32)
+    floor = np.full(B, -np.inf, np.float32)
+    out = fc.band_anomalies(x, mask, region, preds, sigma, thr, modes, floor)
+    assert list(np.asarray(out["count"])) == [1, 1, 0]
+    assert list(np.asarray(out["first_index"]))[:2] == [15, 15]
+    assert np.asarray(out["checked"]).tolist() == [10, 10, 10]
+
+
+def test_band_min_lower_bound_floor():
+    # min_lower_bound clamps the lower band UP: with pred=1, thr=2 the raw
+    # lower band is -1 (x=0 in-band); flooring it at 0.5 makes x=0 anomalous.
+    B, T = 1, 12
+    x = np.zeros((B, T), np.float32)
+    mask = np.ones((B, T), bool)
+    region = np.ones((B, T), bool)
+    preds = np.full((B, T), 1.0, np.float32)
+    sigma = np.ones(B, np.float32)
+    thr = np.full(B, 2.0, np.float32)
+    modes = np.array([fc.BOUND_BOTH], np.int32)
+    out = fc.band_anomalies(
+        x, mask, region, preds, sigma, thr, modes, np.float32([-np.inf])
+    )
+    assert int(out["count"][0]) == 0
+    out2 = fc.band_anomalies(
+        x, mask, region, preds, sigma, thr, modes, np.float32([0.5])
+    )
+    assert int(out2["count"][0]) == 12
+
+
+def test_band_bitmask_upper_only_ignores_dips():
+    B, T = 1, 8
+    x = np.full((B, T), -10.0, np.float32)
+    mask = np.ones((B, T), bool)
+    region = np.ones((B, T), bool)
+    preds = np.zeros((B, T), np.float32)
+    out = fc.band_anomalies(
+        x,
+        mask,
+        region,
+        preds,
+        np.ones(B, np.float32),
+        np.full(B, 2.0, np.float32),
+        np.array([fc.BOUND_UPPER], np.int32),
+        np.float32([-np.inf]),
+    )
+    assert int(out["count"][0]) == 0
+
+
+def test_moving_average_long_gap_forward_fills_recent():
+    # review finding: a gap longer than the window must fall back to the most
+    # recent value before the gap, not the start of the series
+    T = 50
+    x = np.zeros(T, np.float32)
+    x[:10] = 1.0
+    x[10:20] = 9.0
+    mask = np.ones(T, bool)
+    mask[20:45] = False  # 25-slot outage, window is 5
+    got = np.asarray(fc.moving_average_predictions(x[None], mask[None], 5))[0]
+    np.testing.assert_allclose(got[30], 9.0)  # last seen level, not 1.0
+
+
+def test_kolmogorov_sf_small_x_is_one():
+    from foremast_tpu.ops.stats import kolmogorov_sf
+
+    # review finding: truncated series diverges for tiny x; must clamp to 1
+    for x in (0.0, 0.005, 0.01, 0.05, 0.19):
+        assert float(kolmogorov_sf(np.float32(x))) == 1.0
+    import scipy.stats.distributions as dist
+
+    for x in (0.3, 0.5, 1.0, 2.0):
+        np.testing.assert_allclose(
+            float(kolmogorov_sf(np.float32(x))), dist.kstwobign.sf(x), atol=1e-5
+        )

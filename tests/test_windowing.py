@@ -1,0 +1,71 @@
+"""Windowing: ragged -> masked grid invariants."""
+import numpy as np
+
+from foremast_tpu.ops.windowing import (
+    Window,
+    align_step,
+    bucket_length,
+    pack_windows,
+    resample_to_grid,
+)
+
+
+def test_align_step():
+    assert align_step(125, 60) == 120
+    assert align_step(120, 60) == 120
+
+
+def test_resample_basic():
+    start, end = 0, 600  # 10-min canary window, T=10
+    ts = [0, 60, 120, 300, 540]
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    w = resample_to_grid(ts, vals, start, end)
+    assert w.values.shape == (10,)
+    assert w.mask.sum() == 5
+    np.testing.assert_array_equal(w.values[[0, 1, 2, 5, 9]], [1, 2, 3, 4, 5])
+    assert not w.mask[3] and not w.mask[4]
+
+
+def test_resample_drops_nan_and_out_of_range():
+    w = resample_to_grid([0, 60, 7200, 120], [1.0, np.nan, 9.0, 2.0], 0, 300)
+    assert w.mask.sum() == 2  # nan and out-of-range dropped
+    assert w.values[0] == 1.0 and w.values[2] == 2.0
+
+
+def test_resample_rounds_to_nearest_slot():
+    # scrape lag: samples a few seconds past the boundary still snap to it
+    w = resample_to_grid([61.0, 124.0], [7.0, 8.0], 0, 300)
+    assert w.mask[1] and w.values[1] == 7.0
+    assert w.mask[2] and w.values[2] == 8.0
+
+
+def test_pack_windows_buckets():
+    ws = [
+        Window(np.ones(10, np.float32), np.ones(10, bool), 0),
+        Window(np.ones(30, np.float32), np.ones(30, bool), 0),
+    ]
+    vals, mask = pack_windows(ws)
+    assert vals.shape == (2, 32)  # bucket of 30 is 32
+    assert mask[0].sum() == 10 and mask[1].sum() == 30
+    assert not mask[0, 10:].any()
+
+
+def test_bucket_length_covers_7day_window():
+    assert bucket_length(10_080) == 16384
+    assert bucket_length(16) == 16
+
+
+def test_resample_in_range_by_timestamp_not_slot():
+    # review finding: ts=-29 must be dropped (before start); ts=575 must land
+    # in the last slot instead of being dropped
+    w = resample_to_grid([-29.0, 575.0], [5.0, 6.0], 0, 600)
+    assert not w.mask[0]
+    assert w.mask[9] and w.values[9] == 6.0
+
+
+def test_pack_windows_refuses_truncation():
+    import pytest
+
+    ws = [Window(np.ones(100, np.float32), np.ones(100, bool), 0)]
+    with pytest.raises(ValueError):
+        pack_windows(ws, pad_to=64)
