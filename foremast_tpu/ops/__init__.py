@@ -6,6 +6,7 @@ from .pairwise import (  # noqa: F401
     kruskal_wallis,
     ks_2samp,
     mann_whitney_u,
+    two_sample_tests,
     wilcoxon_signed_rank,
 )
 from .stats import chi2_sf, kolmogorov_sf, norm_sf  # noqa: F401

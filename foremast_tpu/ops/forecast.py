@@ -234,11 +234,21 @@ def fit_holt_winters(x, mask, fit_mask, period: int, grid=None):
 # ---------------------------------------------------------------------------
 @jax.jit
 def residual_sigma(x, preds, mask, region_mask):
-    """RMS one-step residual over region_mask & mask, per series (B,)."""
+    """RMS one-step residual over region_mask & mask, per series (B,).
+
+    With fewer than 2 residual samples there is no error scale to estimate;
+    sigma is +inf there, so downstream bands become infinitely wide and a
+    no-history series can never be judged anomalous (fail-open). The engine
+    additionally gates jobs on MIN_HISTORICAL_DATA_POINT_TO_MEASURE before
+    scoring, mirroring the reference brain's env config. A genuinely
+    constant history (n >= 2, zero residuals) keeps sigma = 0 on purpose:
+    any deviation from a perfectly flat metric IS anomalous.
+    """
     sel = (mask & region_mask).astype(_F)
-    n = jnp.maximum(jnp.sum(sel, axis=-1), 1.0)
+    n = jnp.sum(sel, axis=-1)
     r = jnp.where(mask & region_mask, x - preds, 0.0)
-    return jnp.sqrt(jnp.sum(r * r, axis=-1) / n)
+    sigma = jnp.sqrt(jnp.sum(r * r, axis=-1) / jnp.maximum(n, 1.0))
+    return jnp.where(n >= 2.0, sigma, jnp.inf)
 
 
 @jax.jit

@@ -29,6 +29,7 @@ from .stats import chi2_sf, kolmogorov_sf, norm_sf
 
 __all__ = [
     "mann_whitney_u",
+    "two_sample_tests",
     "wilcoxon_signed_rank",
     "kruskal_wallis",
     "friedman_chi_square",
@@ -129,11 +130,15 @@ def kruskal_wallis(groups, masks):
 
     n_i = jnp.sum(masks.astype(_F), axis=-1)
     R_i = jnp.sum(ranks, axis=-1)
-    H = 12.0 / (N * (N + 1.0)) * jnp.sum(_safe_div(R_i**2, n_i)) - 3.0 * (N + 1.0)
+    H = _safe_div(12.0, N * (N + 1.0)) * jnp.sum(_safe_div(R_i**2, n_i)) - 3.0 * (
+        N + 1.0
+    )
     correction = 1.0 - _safe_div(tie, N**3 - N)
     H = _safe_div(H, correction)
+    ok = (correction > 0.0) & (N > 0.0)
+    H = jnp.where(ok, H, 0.0)
     p = chi2_sf(H, jnp.asarray(k - 1.0, _F))
-    p = jnp.where(correction > 0.0, p, 1.0)
+    p = jnp.where(ok, p, 1.0)
     return H, p
 
 
@@ -165,10 +170,12 @@ def friedman_chi_square(data, block_mask):
     c = 1.0 - _safe_div(
         jnp.sum(ties * block_mask.astype(_F)), n * k * (k**2 - 1.0)
     )
-    chisq = 12.0 / (n * k * (k + 1.0)) * jnp.sum(Rj**2) - 3.0 * n * (k + 1.0)
+    chisq = _safe_div(12.0, n * k * (k + 1.0)) * jnp.sum(Rj**2) - 3.0 * n * (k + 1.0)
     chisq = _safe_div(chisq, c)
+    ok = (c > 0.0) & (n > 0.0)
+    chisq = jnp.where(ok, chisq, 0.0)
     p = chi2_sf(chisq, jnp.asarray(k - 1.0, _F))
-    p = jnp.where(c > 0.0, p, 1.0)
+    p = jnp.where(ok, p, 1.0)
     return chisq, p
 
 
@@ -215,6 +222,57 @@ def ks_2samp(x, x_mask, y, y_mask):
 
 
 # ---------------------------------------------------------------------------
+# Fused two-sample family: one sort serves both rank tests.
+# ---------------------------------------------------------------------------
+def two_sample_tests(x, x_mask, y, y_mask):
+    """Mann-Whitney + 2-group Kruskal + Wilcoxon + KS on one window pair.
+
+    The combined sample is ranked ONCE and the Mann-Whitney U and
+    Kruskal-Wallis H (k=2) statistics are both derived from the shared rank
+    sums — the sort dominates the cost of the rank tests, and the standalone
+    functions would sort the identical data twice through HLO that XLA cannot
+    CSE. Returns {test: (stat, p)} identical to the standalone kernels.
+    """
+    Tx = x.shape[-1]
+    comb = jnp.concatenate([x, y]).astype(_F)
+    cmask = jnp.concatenate([x_mask, y_mask])
+    ranks, tie, N = rank_and_ties(comb, cmask)
+
+    n1 = jnp.sum(x_mask.astype(_F))
+    n2 = jnp.sum(y_mask.astype(_F))
+    R1 = jnp.sum(ranks[:Tx])
+    R2 = N * (N + 1.0) / 2.0 - R1
+
+    # Mann-Whitney from shared ranks
+    U1 = R1 - n1 * (n1 + 1.0) / 2.0
+    U = jnp.maximum(U1, n1 * n2 - U1)
+    mu = n1 * n2 / 2.0
+    s2 = n1 * n2 / 12.0 * ((N + 1.0) - _safe_div(tie, N * (N - 1.0)))
+    s = jnp.sqrt(jnp.maximum(s2, 0.0))
+    z = _safe_div(U - mu - 0.5, s)
+    p_mw = jnp.where(s > 0.0, jnp.clip(2.0 * norm_sf(z), 0.0, 1.0), 1.0)
+
+    # Kruskal-Wallis (k=2) from the same rank sums
+    H = _safe_div(12.0, N * (N + 1.0)) * (
+        _safe_div(R1**2, n1) + _safe_div(R2**2, n2)
+    ) - 3.0 * (N + 1.0)
+    correction = 1.0 - _safe_div(tie, N**3 - N)
+    H = _safe_div(H, correction)
+    ok = (correction > 0.0) & (N > 0.0)
+    H = jnp.where(ok, H, 0.0)
+    p_k = jnp.where(ok, chi2_sf(H, jnp.asarray(1.0, _F)), 1.0)
+
+    W, p_w = wilcoxon_signed_rank(x, x_mask, y, y_mask)
+    D, p_ks = ks_2samp(x, x_mask, y, y_mask)
+    return {
+        "mann_whitney": (U1, p_mw),
+        "kruskal": (H, p_k),
+        "wilcoxon": (W, p_w),
+        "ks": (D, p_ks),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Batched wrappers — vmapped + jitted once, reused fleet-wide.
 # ---------------------------------------------------------------------------
 mann_whitney_u_batch = jax.jit(jax.vmap(mann_whitney_u))
@@ -231,13 +289,7 @@ def all_pairwise_tests(x, x_mask, y, y_mask):
     Args: x, y: (B, T); x_mask, y_mask: (B, T) bool.
     Returns dict test-name -> (stat (B,), pvalue (B,)). Kruskal is evaluated
     on the 2-group arrangement (baseline vs current), matching how the brain
-    applies it to canary judgment.
+    applies it to canary judgment; it shares one sort with Mann-Whitney via
+    two_sample_tests.
     """
-    groups = jnp.stack([x, y], axis=1)  # (B, 2, T)
-    gmasks = jnp.stack([x_mask, y_mask], axis=1)
-    return {
-        "mann_whitney": jax.vmap(mann_whitney_u)(x, x_mask, y, y_mask),
-        "wilcoxon": jax.vmap(wilcoxon_signed_rank)(x, x_mask, y, y_mask),
-        "kruskal": jax.vmap(kruskal_wallis)(groups, gmasks),
-        "ks": jax.vmap(ks_2samp)(x, x_mask, y, y_mask),
-    }
+    return jax.vmap(two_sample_tests)(x, x_mask, y, y_mask)

@@ -1,0 +1,225 @@
+"""Fleet-scale canary scoring: one device launch for the whole fleet.
+
+This is the north-star path (BASELINE.json): 100k concurrent (baseline,
+canary) metric-pair windows scored in one jitted, mesh-sharded program —
+replacing the reference brain's one-job-at-a-time CPU worker loop
+(ES poll -> fetch -> scipy -> write, SURVEY.md §2.4).
+
+Structure:
+  * `score_pairs` — the fused per-pair program: full pairwise test family +
+    moving-average band check + combined verdict, vmapped over the batch.
+    With inputs sharded over the fleet axis it runs embarrassingly parallel;
+    XLA partitions it without communication.
+  * `fleet_summary` — the cross-chip part: unhealthy counts and worst-k
+    services. Written with shard_map + ICI collectives (psum / all_gather of
+    per-shard top-k) so the reduction cost is O(k * n_devices), never a
+    gather of the full fleet.
+
+Verdict codes follow the brain's combinator semantics: a pair is unhealthy
+if the enabled pairwise tests reject under the ALL/ANY combinator
+(foremast-brain/README.md:34-38) OR the band check flags anomalies.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import forecast as fc
+from ..ops.pairwise import two_sample_tests
+from .mesh import FLEET_AXIS, fleet_sharding, replicated
+
+__all__ = ["score_pairs", "make_fleet_scorer", "fleet_summary", "COMBINE_ANY", "COMBINE_ALL"]
+
+_F = jnp.float32
+
+# test-enable bitmask positions
+TEST_MANN_WHITNEY = 1
+TEST_WILCOXON = 2
+TEST_KRUSKAL = 4
+TEST_KS = 8
+
+COMBINE_ANY = 0  # unhealthy if ANY enabled test rejects
+COMBINE_ALL = 1  # unhealthy only if ALL enabled tests reject
+
+# minimum valid points per test (deploy/foremast/3_brain/foremast-brain.yaml:74-79)
+MIN_MANN_WHITNEY = 20
+MIN_WILCOXON = 20
+MIN_KRUSKAL = 5
+
+
+def _pair_verdict(
+    baseline,
+    b_mask,
+    current,
+    c_mask,
+    pvalue_threshold,
+    test_mask,
+    combine,
+    ma_window,
+    band_threshold,
+    bound_mode,
+    min_lower_bound,
+):
+    """Single (baseline, current) judgment. vmapped by score_pairs."""
+    n_b = jnp.sum(b_mask.astype(_F))
+    n_c = jnp.sum(c_mask.astype(_F))
+    n_min = jnp.minimum(n_b, n_c)
+
+    tests = two_sample_tests(baseline, b_mask, current, c_mask)
+    pvals = jnp.stack(
+        [
+            tests["mann_whitney"][1],
+            tests["wilcoxon"][1],
+            tests["kruskal"][1],
+            tests["ks"][1],
+        ]
+    )
+
+    # a test participates only if enabled AND it has enough data
+    enough = jnp.stack(
+        [
+            n_min >= MIN_MANN_WHITNEY,
+            n_min >= MIN_WILCOXON,
+            n_min >= MIN_KRUSKAL,
+            n_min >= 2,
+        ]
+    )
+    bits = jnp.asarray([TEST_MANN_WHITNEY, TEST_WILCOXON, TEST_KRUSKAL, TEST_KS])
+    enabled = ((test_mask & bits) > 0) & enough
+    rejects = (pvals < pvalue_threshold) & enabled
+    n_enabled = jnp.sum(enabled)
+    any_reject = jnp.any(rejects)
+    all_reject = jnp.all(rejects | ~enabled) & (n_enabled > 0)
+    pairwise_unhealthy = jnp.where(combine == COMBINE_ALL, all_reject, any_reject)
+
+    # band check: baseline window drives an MA band; current judged against it
+    concat = jnp.concatenate([baseline, current])
+    concat_m = jnp.concatenate([b_mask, c_mask])
+    Tb = baseline.shape[-1]
+    region = jnp.arange(concat.shape[-1]) >= Tb
+    preds = fc._moving_average_1d(concat, concat_m & ~region, ma_window)
+    hist_sel = concat_m & ~region
+    r = jnp.where(hist_sel, concat - preds, 0.0)
+    nh = jnp.sum(hist_sel.astype(_F))
+    # no baseline history -> infinite band -> fail-open (cannot judge)
+    sigma = jnp.where(
+        nh >= 2.0, jnp.sqrt(jnp.sum(r * r) / jnp.maximum(nh, 1.0)), jnp.inf
+    )
+    thr = band_threshold * sigma
+    upper = preds + thr
+    lower = jnp.maximum(preds - thr, min_lower_bound)
+    mode = jnp.where(bound_mode == 0, 3, bound_mode)
+    viol = ((concat > upper) & ((mode & 1) > 0)) | ((concat < lower) & ((mode & 2) > 0))
+    flags = viol & concat_m & region
+    band_count = jnp.sum(flags)
+    n_checked = jnp.maximum(jnp.sum((concat_m & region).astype(_F)), 1.0)
+    band_unhealthy = band_count.astype(_F) / n_checked > 0.3
+
+    unhealthy = pairwise_unhealthy | band_unhealthy
+    # severity: how loudly this pair is anomalous (for fleet top-k);
+    # -log10(min enabled p) + band violation fraction
+    min_p = jnp.min(jnp.where(enabled, pvals, 1.0))
+    severity = -jnp.log10(jnp.maximum(min_p, 1e-12)) + band_count.astype(_F) / n_checked
+    return {
+        "unhealthy": unhealthy,
+        "severity": severity,
+        "pvalues": pvals,
+        "band_count": band_count,
+        "min_p": min_p,
+    }
+
+
+score_pairs = jax.jit(jax.vmap(_pair_verdict))
+
+
+def make_fleet_scorer(mesh, k: int = 8):
+    """Build the sharded fleet program for a given mesh.
+
+    Returns a jitted fn taking batched pair inputs (B divisible by the fleet
+    axis size) and returning per-pair verdicts plus the fleet summary
+    (unhealthy count, worst-k severities and indices) — one launch, with the
+    verdict reduction riding ICI.
+    """
+    shard = fleet_sharding(mesh)
+    repl = replicated(mesh)
+    n_shards = mesh.shape[FLEET_AXIS]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(FLEET_AXIS),) * 4 + (P(FLEET_AXIS),) * 7 + (P(FLEET_AXIS),),
+        out_specs=(P(FLEET_AXIS), P(), P(), P()),
+        check_vma=False,
+    )
+    def _sharded(
+        baseline, b_mask, current, c_mask,
+        pvalue_threshold, test_mask, combine, ma_window,
+        band_threshold, bound_mode, min_lower_bound, global_idx,
+    ):
+        out = jax.vmap(_pair_verdict)(
+            baseline, b_mask, current, c_mask,
+            pvalue_threshold, test_mask, combine, ma_window,
+            band_threshold, bound_mode, min_lower_bound,
+        )
+        local_unhealthy = jnp.sum(out["unhealthy"].astype(jnp.int32))
+        total_unhealthy = jax.lax.psum(local_unhealthy, FLEET_AXIS)
+        # communication-lean top-k: local k, then gather k*n_shards candidates
+        sev = jnp.where(out["unhealthy"], out["severity"], -jnp.inf)
+        loc_v, loc_i = jax.lax.top_k(sev, min(k, sev.shape[0]))
+        cand_v = jax.lax.all_gather(loc_v, FLEET_AXIS, tiled=True)
+        cand_idx = jax.lax.all_gather(global_idx[loc_i], FLEET_AXIS, tiled=True)
+        top_v, top_pos = jax.lax.top_k(cand_v, min(k, cand_v.shape[0]))
+        top_idx = cand_idx[top_pos]
+        return out, total_unhealthy, top_v, top_idx
+
+    def run(baseline, b_mask, current, c_mask, cfg):
+        B = baseline.shape[0]
+        if B % n_shards:
+            raise ValueError(f"batch {B} not divisible by fleet axis {n_shards}")
+        gidx = jnp.arange(B)
+        args = (
+            baseline, b_mask, current, c_mask,
+            cfg["pvalue_threshold"], cfg["test_mask"], cfg["combine"],
+            cfg["ma_window"], cfg["band_threshold"], cfg["bound_mode"],
+            cfg["min_lower_bound"], gidx,
+        )
+        args = jax.device_put(
+            args, tuple(shard for _ in args)
+        )
+        out, total, top_v, top_idx = _jit(args)
+        return out, int(total), top_v, top_idx
+
+    @jax.jit
+    def _jit(args):
+        return _sharded(*args)
+
+    return run
+
+
+def fleet_summary(unhealthy, severity, mesh, k: int = 8):
+    """Standalone summary reduction for already-scored fleets."""
+    scorer_in = NamedSharding(mesh, P(FLEET_AXIS))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(FLEET_AXIS), P(FLEET_AXIS), P(FLEET_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    def _sum(u, s, gi):
+        total = jax.lax.psum(jnp.sum(u.astype(jnp.int32)), FLEET_AXIS)
+        sev = jnp.where(u, s, -jnp.inf)
+        v, i = jax.lax.top_k(sev, min(k, sev.shape[0]))
+        cv = jax.lax.all_gather(v, FLEET_AXIS, tiled=True)
+        ci = jax.lax.all_gather(gi[i], FLEET_AXIS, tiled=True)
+        tv, tp = jax.lax.top_k(cv, min(k, cv.shape[0]))
+        return total, tv, ci[tp]
+
+    gidx = jnp.arange(unhealthy.shape[0])
+    u, s, gi = jax.device_put((unhealthy, severity, gidx), (scorer_in,) * 3)
+    return jax.jit(_sum)(u, s, gi)

@@ -1,0 +1,100 @@
+"""Fleet scoring on the 8-device virtual CPU mesh."""
+import jax
+import numpy as np
+import pytest
+
+from foremast_tpu.parallel import fleet_mesh, make_fleet_scorer, pad_to_multiple
+from foremast_tpu.parallel import fleet as fl
+
+
+def _fleet_batch(B=64, T=32, bad_every=8, seed=0):
+    """Healthy pairs except every bad_every-th (shifted current)."""
+    rng = np.random.default_rng(seed)
+    base = rng.normal(10, 1, (B, T)).astype(np.float32)
+    cur = rng.normal(10, 1, (B, T)).astype(np.float32)
+    bad = np.arange(B) % bad_every == 0
+    cur[bad] += 8.0
+    bm = np.ones((B, T), bool)
+    cm = np.ones((B, T), bool)
+    return base, bm, cur, cm, bad
+
+
+def _cfg(B):
+    return {
+        # decisive threshold: with dozens of healthy pairs, a 1-5% per-pair
+        # false-positive rate would (correctly) flag some by chance
+        "pvalue_threshold": np.full(B, 1e-4, np.float32),
+        "test_mask": np.full(B, fl.TEST_MANN_WHITNEY | fl.TEST_KRUSKAL, np.int32),
+        "combine": np.full(B, fl.COMBINE_ANY, np.int32),
+        "ma_window": np.full(B, 30, np.int32),
+        "band_threshold": np.full(B, 2.0, np.float32),
+        "bound_mode": np.full(B, 3, np.int32),
+        "min_lower_bound": np.full(B, -np.inf, np.float32),
+    }
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    mesh = fleet_mesh()
+    assert mesh.shape["fleet"] == 8
+
+
+def test_score_pairs_flags_bad_pairs():
+    B = 32
+    base, bm, cur, cm, bad = _fleet_batch(B)
+    cfg = _cfg(B)
+    out = jax.vmap(fl._pair_verdict)(base, bm, cur, cm, **{
+        k: cfg[k] for k in (
+            "pvalue_threshold", "test_mask", "combine", "ma_window",
+            "band_threshold", "bound_mode", "min_lower_bound")
+    }) if False else fl.score_pairs(
+        base, bm, cur, cm,
+        cfg["pvalue_threshold"], cfg["test_mask"], cfg["combine"],
+        cfg["ma_window"], cfg["band_threshold"], cfg["bound_mode"],
+        cfg["min_lower_bound"],
+    )
+    got = np.asarray(out["unhealthy"])
+    np.testing.assert_array_equal(got, bad)
+
+
+def test_fleet_scorer_end_to_end_sharded():
+    mesh = fleet_mesh()
+    B = 64
+    base, bm, cur, cm, bad = _fleet_batch(B)
+    run = make_fleet_scorer(mesh, k=8)
+    out, total, top_v, top_idx = run(base, bm, cur, cm, _cfg(B))
+    assert total == int(bad.sum())
+    # every reported top index is a genuinely bad pair
+    tv = np.asarray(top_v)
+    ti = np.asarray(top_idx)
+    real = tv > -np.inf
+    assert real.sum() == min(8, bad.sum())
+    assert all(bad[i] for i in ti[real])
+
+
+def test_fleet_scorer_rejects_undivisible_batch():
+    mesh = fleet_mesh()
+    base, bm, cur, cm, _ = _fleet_batch(60)
+    run = make_fleet_scorer(mesh)
+    with pytest.raises(ValueError):
+        run(base, bm, cur, cm, _cfg(60))
+
+
+def test_pad_to_multiple_roundtrip():
+    base, bm, cur, cm, _ = _fleet_batch(60)
+    (pb, pbm), B0 = pad_to_multiple([base, bm], 8)
+    assert pb.shape[0] == 64 and B0 == 60
+    assert not pbm[60:].any()  # padding is fully masked
+
+
+def test_fleet_summary_standalone():
+    mesh = fleet_mesh()
+    B = 64
+    unhealthy = np.zeros(B, bool)
+    unhealthy[[3, 17, 42]] = True
+    sev = np.zeros(B, np.float32)
+    sev[[3, 17, 42]] = [5.0, 9.0, 7.0]
+    total, tv, ti = fl.fleet_summary(unhealthy, sev, mesh, k=4)
+    assert int(total) == 3
+    got = [int(i) for i, v in zip(np.asarray(ti), np.asarray(tv)) if v > -np.inf]
+    assert got == [17, 42, 3]  # severity-descending

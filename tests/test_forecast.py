@@ -197,3 +197,20 @@ def test_kolmogorov_sf_small_x_is_one():
         np.testing.assert_allclose(
             float(kolmogorov_sf(np.float32(x))), dist.kstwobign.sf(x), atol=1e-5
         )
+
+
+def test_residual_sigma_no_history_fails_open():
+    # review finding: empty history must widen the band to inf, not collapse
+    # it to zero (which flagged everything)
+    B, T = 1, 16
+    x = np.ones((B, T), np.float32) * 5
+    mask = np.ones((B, T), bool)
+    region = np.ones((B, T), bool)  # everything is "current": no history
+    preds = np.zeros((B, T), np.float32)
+    sigma = np.asarray(fc.residual_sigma(x, preds, mask, ~region))
+    assert np.isinf(sigma[0])
+    out = fc.band_anomalies(
+        x, mask, region, preds, sigma, np.float32([2.0]), np.int32([3]),
+        np.float32([-np.inf]),
+    )
+    assert int(out["count"][0]) == 0  # cannot judge -> nothing flagged

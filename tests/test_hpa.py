@@ -1,0 +1,89 @@
+"""HPA score kernel + breath cooldown semantics."""
+import numpy as np
+
+from foremast_tpu.ops import forecast as fc
+from foremast_tpu.ops import hpa
+
+
+def _setup(tps_current_level, sla_current=5.0, T=96, region_len=30):
+    """History at ~100 tps, current window at tps_current_level."""
+    rng = np.random.default_rng(0)
+    B = 1
+    tps = np.concatenate(
+        [
+            rng.normal(100, 3, T - region_len),
+            rng.normal(tps_current_level, 3, region_len),
+        ]
+    ).astype(np.float32)[None]
+    mask = np.ones((B, T), bool)
+    region = np.zeros((B, T), bool)
+    region[:, -region_len:] = True
+    sla = np.concatenate(
+        [rng.normal(5, 0.5, T - region_len), rng.normal(sla_current, 0.5, region_len)]
+    ).astype(np.float32)[None]
+    # forecaster fit on history only: the band freezes at region start
+    hist_mask = mask & ~region
+    preds = fc.ses_predictions(tps, hist_mask, np.float32([0.3]))
+    sigma = fc.residual_sigma(tps, np.asarray(preds), hist_mask, ~region)
+    return dict(
+        tps=tps,
+        tps_mask=mask,
+        region=region,
+        tps_pred=np.asarray(preds),
+        tps_sigma=np.asarray(sigma),
+        sla=sla,
+        sla_mask=mask,
+        sla_static_limit=np.float32([50.0]),
+        sla_mode=np.int32([hpa.SLA_STATIC]),
+        threshold=np.float32([3.0]),
+    )
+
+
+def test_steady_traffic_holds_replicas():
+    out = hpa.hpa_scores(**_setup(100))
+    s = float(out["score"][0])
+    assert 35 <= s <= 65, s
+    assert int(out["reason"][0]) == hpa.REASON_PREDICTED_TREND
+
+
+def test_traffic_surge_scales_up():
+    out = hpa.hpa_scores(**_setup(300))
+    assert float(out["score"][0]) > 50
+    assert int(out["reason"][0]) == hpa.REASON_ANOMALY_TREND
+
+
+def test_traffic_collapse_scales_down():
+    out = hpa.hpa_scores(**_setup(20))
+    # demand follows the (falling) trend: score under 50
+    assert float(out["score"][0]) < 50
+
+
+def test_sla_violation_forces_scale_up():
+    out = hpa.hpa_scores(**_setup(100, sla_current=80.0))
+    assert float(out["score"][0]) >= 75
+    assert int(out["reason"][0]) == hpa.REASON_SLA_VIOLATION
+
+
+def test_sla_dynamic_mode_uses_history_sigma():
+    cfg = _setup(100, sla_current=9.0)  # way above mean+3sigma of ~5+-0.5
+    cfg["sla_mode"] = np.int32([hpa.SLA_DYNAMIC])
+    out = hpa.hpa_scores(**cfg)
+    assert int(out["reason"][0]) == hpa.REASON_SLA_VIOLATION
+    cfg["sla_mode"] = np.int32([hpa.SLA_STATIC])  # static limit 50 not hit
+    out2 = hpa.hpa_scores(**cfg)
+    assert int(out2["reason"][0]) != hpa.REASON_SLA_VIOLATION
+
+
+def test_breath_cooldowns():
+    st = hpa.BreathState(breath_up_s=120, breath_down_s=600)
+    # scale-up signal must be sustained for 120s
+    assert st.apply("svc", 80.0, now=0.0) == 50.0
+    assert st.apply("svc", 80.0, now=60.0) == 50.0
+    assert st.apply("svc", 80.0, now=130.0) == 80.0
+    # flip to scale-down restarts the clock with the longer window
+    assert st.apply("svc", 30.0, now=140.0) == 50.0
+    assert st.apply("svc", 30.0, now=500.0) == 50.0
+    assert st.apply("svc", 30.0, now=745.0) == 30.0
+    # neutral clears state
+    assert st.apply("svc", 50.0, now=800.0) == 50.0
+    assert st.apply("svc", 80.0, now=810.0) == 50.0

@@ -115,3 +115,41 @@ def test_degenerate_identical_windows():
     _, p_w = wilcoxon_signed_rank(x, m, x, m)
     assert float(p_mw) == 1.0
     assert float(p_w) == 1.0
+
+
+def test_all_masked_degenerate_p1_everywhere():
+    # review finding: kruskal/friedman returned NaN on fully-masked input
+    from foremast_tpu.ops import friedman_chi_square
+
+    z = np.zeros(16, np.float32)
+    zm = np.zeros(16, bool)
+    for stat, p in (
+        mann_whitney_u(z, zm, z, zm),
+        wilcoxon_signed_rank(z, zm, z, zm),
+        kruskal_wallis(np.stack([z, z]), np.stack([zm, zm])),
+        ks_2samp(z, zm, z, zm),
+        friedman_chi_square(np.zeros((8, 3), np.float32), np.zeros(8, bool)),
+    ):
+        assert np.isfinite(float(stat)), stat
+        assert float(p) == 1.0, p
+
+
+def test_two_sample_tests_matches_standalone():
+    from foremast_tpu.ops import two_sample_tests
+
+    x, xm, y, ym = _windows(3, ties=True, shift=0.7)
+    fused = two_sample_tests(x, xm, y, ym)
+    np.testing.assert_allclose(
+        float(fused["mann_whitney"][1]), float(mann_whitney_u(x, xm, y, ym)[1]), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(fused["kruskal"][1]),
+        float(kruskal_wallis(np.stack([x, y]), np.stack([xm, ym]))[1]),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(fused["wilcoxon"][1]), float(wilcoxon_signed_rank(x, xm, y, ym)[1]), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(fused["ks"][1]), float(ks_2samp(x, xm, y, ym)[1]), rtol=1e-6
+    )
