@@ -1,0 +1,130 @@
+"""Data sources: fetch (timestamps, values) series for a query URL.
+
+The engine's hot loop fetches current/baseline/historical windows for every
+open job. Sources are pluggable:
+
+  * PrometheusDataSource — real HTTP `query_range` (urllib; response shape
+    {"data":{"result":[{"values":[[ts,"v"],...]}]}}). Multiple result series
+    are averaged element-wise (the reference's recording rules pre-aggregate
+    to one series per query; the average keeps us safe if a selector matches
+    several).
+  * WavefrontDataSource — chart-API shape {"timeseries":[{"data":[[ts,v],...]}]}.
+  * FixtureDataSource — dict/url -> series or a callable; the test/demo seam
+    (the reference's equivalent seam was the injectable HTTP DoFunc,
+    foremast-barrelman/pkg/client/analyst/analystclient.go:24).
+
+All sources return (timestamps: list[float], values: list[float]).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from collections import OrderedDict
+from typing import Callable
+
+
+class FetchError(Exception):
+    pass
+
+
+def _avg_series(series: list[list[tuple[float, float]]]):
+    """Element-wise average of several [(ts, v)] series by timestamp."""
+    if not series:
+        return [], []
+    acc: dict[float, list[float]] = {}
+    for s in series:
+        for ts, v in s:
+            acc.setdefault(float(ts), []).append(float(v))
+    out_ts = sorted(acc)
+    return out_ts, [sum(acc[t]) / len(acc[t]) for t in out_ts]
+
+
+class PrometheusDataSource:
+    def __init__(self, timeout: float = 10.0):
+        self.timeout = timeout
+
+    def fetch(self, url: str):
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                payload = json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 - network boundary
+            raise FetchError(f"prometheus fetch failed: {e}") from e
+        if payload.get("status") not in (None, "success"):
+            raise FetchError(f"prometheus error: {payload}")
+        result = payload.get("data", {}).get("result", [])
+        series = [
+            [(float(ts), float(v)) for ts, v in item.get("values", [])]
+            for item in result
+        ]
+        return _avg_series(series)
+
+
+class WavefrontDataSource:
+    def __init__(self, token: str = "", timeout: float = 10.0):
+        self.token = token
+        self.timeout = timeout
+
+    def fetch(self, url: str):
+        req = urllib.request.Request(url)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                payload = json.loads(r.read())
+        except Exception as e:  # noqa: BLE001
+            raise FetchError(f"wavefront fetch failed: {e}") from e
+        series = [
+            [(float(ts), float(v)) for ts, v in item.get("data", [])]
+            for item in payload.get("timeseries", [])
+        ]
+        return _avg_series(series)
+
+
+class FixtureDataSource:
+    """URL -> canned series; or a resolver callable(url) -> (ts, vals)."""
+
+    def __init__(self, fixtures: dict | None = None,
+                 resolver: Callable[[str], tuple] | None = None):
+        # keep the caller's dict object (tests mutate it after construction);
+        # `fixtures or {}` would silently detach an initially-empty dict
+        self.fixtures = {} if fixtures is None else fixtures
+        self.resolver = resolver
+        self.requests: list[str] = []
+
+    def fetch(self, url: str):
+        self.requests.append(url)
+        if url in self.fixtures:
+            ts, vals = self.fixtures[url]
+            return list(ts), list(vals)
+        if self.resolver is not None:
+            return self.resolver(url)
+        raise FetchError(f"no fixture for {url}")
+
+
+class CachingDataSource:
+    """LRU wrapper, bounded by MAX_CACHE_SIZE — the reference brain's
+    in-memory model/window cache (foremast-brain/README.md:30), rebuilt from
+    historical queries on miss."""
+
+    def __init__(self, inner, max_entries: int = 1024):
+        self.inner = inner
+        self.max_entries = max_entries
+        self._cache: OrderedDict[str, tuple] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def fetch(self, url: str):
+        with self._lock:
+            if url in self._cache:
+                self._cache.move_to_end(url)
+                self.hits += 1
+                return self._cache[url]
+        res = self.inner.fetch(url)
+        with self._lock:
+            self.misses += 1
+            self._cache[url] = res
+            if len(self._cache) > self.max_entries:
+                self._cache.popitem(last=False)
+        return res

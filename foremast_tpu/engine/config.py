@@ -1,0 +1,148 @@
+"""Engine configuration: the reference brain's ML_* env surface.
+
+Re-implements the config contract documented in foremast-brain/README.md
+(:22-38, :49-55) and deployed at deploy/foremast/3_brain/foremast-brain.yaml
+(:24-81): global algorithm/threshold/bound plus indexed per-metric-type
+overrides (metric_type{N} / threshold{N} / bound{N} / min_lower_bound{N}),
+min-data-point gates per pairwise test, and the stuck-job takeover limit.
+"""
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class MetricPolicy:
+    """Per-metric-type judgment knobs."""
+
+    threshold: float = 2.0  # band half-width in sigmas
+    bound: int = 1  # bitmask: 1 upper, 2 lower, 3 both
+    min_lower_bound: float = 0.0
+
+
+# deployed defaults (foremast-brain.yaml:34-73)
+DEFAULT_POLICIES = {
+    "error5xx": MetricPolicy(2.0, 1, 0.0),
+    "error4xx": MetricPolicy(3.0, 1, 0.0),
+    "latency": MetricPolicy(10.0, 3, 0.0),
+    "cpu": MetricPolicy(5.0, 1, 0.0),
+    "memory": MetricPolicy(5.0, 1, 0.0),
+}
+
+PAIRWISE_TESTS = ("mann_whitney", "wilcoxon", "kruskal", "ks")
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    algorithm: str = "moving_average_all"  # ML_ALGORITHM
+    pairwise_algorithm: str = "mann_whitney_all"  # ML_PAIRWISE_ALGORITHM
+    pairwise_threshold: float = 0.01  # ML_PAIRWISE_THRESHOLD (p-value alpha)
+    threshold: float = 2.0  # ML_THRESHOLD (band sigmas)
+    bound: int = 1  # ML_BOUND bitmask
+    min_lower_bound: float = 0.0
+    min_historical_points: int = 10  # MIN_HISTORICAL_DATA_POINT_TO_MEASURE
+    min_mann_whitney_points: int = 20  # MIN_MANN_WHITE_DATA_POINTS
+    min_wilcoxon_points: int = 20  # MIN_WILCOXON_DATA_POINTS
+    min_kruskal_points: int = 5  # MIN_KRUSKAL_DATA_POINTS
+    max_stuck_seconds: float = 90.0  # MAX_STUCK_IN_SECONDS
+    max_cache_size: int = 1024  # MAX_CACHE_SIZE (model/window cache entries)
+    ma_window: int = 30  # moving-average lookback (steps)
+    hw_period: int = 1440  # Holt-Winters season (steps; 1 day at 60s)
+    # band verdict gate: a window is unhealthy when
+    # count >= max(band_min_points, band_violation_fraction * checked).
+    # A single k-sigma excursion in a 30-point window is expected Gaussian
+    # noise (~4.5% of points at 2 sigma); the per-metric thresholds assume
+    # near-zero-variance error metrics, so noisy metrics need the gate.
+    band_min_points: int = 2
+    band_violation_fraction: float = 0.1
+    policies: dict = field(default_factory=lambda: dict(DEFAULT_POLICIES))
+
+    def policy_for(self, metric_name: str) -> MetricPolicy:
+        """Longest-substring match of configured metric types in the name
+        (metric names arrive as e.g. namespace_app_pod_http_errors_5xx)."""
+        best = None
+        for key, pol in self.policies.items():
+            norm = key.replace("error", "").lower()
+            if key.lower() in metric_name.lower() or (
+                norm and norm in metric_name.lower()
+            ):
+                if best is None or len(key) > len(best[0]):
+                    best = (key, pol)
+        if best:
+            return best[1]
+        return MetricPolicy(self.threshold, self.bound, self.min_lower_bound)
+
+    @property
+    def pairwise_combine_all(self) -> bool:
+        return self.pairwise_algorithm.endswith("_all") or self.pairwise_algorithm == "all"
+
+    def enabled_tests(self) -> int:
+        """Bitmask of enabled pairwise tests (parallel.fleet TEST_* bits)."""
+        from ..parallel import fleet as fl
+
+        name = self.pairwise_algorithm
+        table = {
+            "mann_whitney": fl.TEST_MANN_WHITNEY,
+            "wilcoxon": fl.TEST_WILCOXON,
+            "kruskal": fl.TEST_KRUSKAL,
+            "ks": fl.TEST_KS,
+        }
+        for key, bit in table.items():
+            if name.startswith(key):
+                return bit
+        # "all"/"any" composite modes enable the full family
+        return (
+            fl.TEST_MANN_WHITNEY | fl.TEST_WILCOXON | fl.TEST_KRUSKAL | fl.TEST_KS
+        )
+
+
+def _env_float(env, key, default):
+    try:
+        return float(env[key])
+    except (KeyError, ValueError):
+        return default
+
+
+def _env_int(env, key, default):
+    try:
+        return int(env[key])
+    except (KeyError, ValueError):
+        return default
+
+
+def from_env(env=None) -> EngineConfig:
+    """Build an EngineConfig from the ML_* env-var family."""
+    env = dict(os.environ) if env is None else env
+    policies = dict(DEFAULT_POLICIES)
+    base = MetricPolicy(
+        threshold=_env_float(env, "threshold", 2.0),
+        bound=_env_int(env, "bound", 1),
+        min_lower_bound=_env_float(env, "min_lower_bound", 0.0),
+    )
+    n = _env_int(env, "metric_type_threshold_count", 0)
+    for i in range(n):
+        name = env.get(f"metric_type{i}")
+        if not name:
+            continue
+        policies[name] = MetricPolicy(
+            threshold=_env_float(env, f"threshold{i}", base.threshold),
+            bound=_env_int(env, f"bound{i}", base.bound),
+            min_lower_bound=_env_float(env, f"min_lower_bound{i}", base.min_lower_bound),
+        )
+    return EngineConfig(
+        algorithm=env.get("ML_ALGORITHM", "moving_average_all"),
+        pairwise_algorithm=env.get("ML_PAIRWISE_ALGORITHM", "mann_whitney_all"),
+        pairwise_threshold=_env_float(env, "ML_PAIRWISE_THRESHOLD", 0.01),
+        threshold=base.threshold,
+        bound=base.bound,
+        min_lower_bound=base.min_lower_bound,
+        min_historical_points=_env_int(env, "MIN_HISTORICAL_DATA_POINT_TO_MEASURE", 10),
+        min_mann_whitney_points=_env_int(env, "MIN_MANN_WHITE_DATA_POINTS", 20),
+        min_wilcoxon_points=_env_int(env, "MIN_WILCOXON_DATA_POINTS", 20),
+        min_kruskal_points=_env_int(env, "MIN_KRUSKAL_DATA_POINTS", 5),
+        max_stuck_seconds=_env_float(env, "MAX_STUCK_IN_SECONDS", 90.0),
+        max_cache_size=_env_int(env, "MAX_CACHE_SIZE", 1024),
+        policies=policies,
+    )

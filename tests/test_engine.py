@@ -1,0 +1,290 @@
+"""Engine: state machine, lease takeover, and the end-to-end scoring slice.
+
+The e2e test is SURVEY.md §7's "minimum end-to-end slice": a synthetic
+ErrorGenerator scenario (reference demo app self-inflicts 5xx) through a
+fixture data source -> job -> batched TPU-kernel scoring -> verdict.
+"""
+import numpy as np
+import pytest
+
+from foremast_tpu.dataplane import FixtureDataSource, VerdictExporter
+from foremast_tpu.engine import Analyzer, Document, EngineConfig, JobStore, MetricQueries
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+
+# ---------------------------------------------------------------- state machine
+def test_status_machine_happy_path():
+    store = JobStore()
+    doc, created = store.create(Document(id="j1", app_name="a", strategy="canary",
+                                         start_time="", end_time=""))
+    assert created and doc.status == J.INITIAL
+    store.transition("j1", J.PREPROCESS_INPROGRESS)
+    store.transition("j1", J.PREPROCESS_COMPLETED)
+    store.transition("j1", J.POSTPROCESS_INPROGRESS)
+    store.transition("j1", J.COMPLETED_UNHEALTH, reason="bad")
+    assert store.get("j1").status == J.COMPLETED_UNHEALTH
+    assert J.to_external(J.COMPLETED_UNHEALTH) == "anomaly"
+    assert J.to_external(J.INITIAL) == "new"
+    assert J.to_external(J.PREPROCESS_FAILED) == "abort"
+
+
+def test_invalid_transition_rejected():
+    store = JobStore()
+    store.create(Document(id="j1", app_name="a", strategy="canary",
+                          start_time="", end_time=""))
+    with pytest.raises(J.InvalidTransition):
+        store.transition("j1", J.COMPLETED_HEALTH)
+
+
+def test_create_dedupes_open_jobs():
+    store = JobStore()
+    d1, c1 = store.create(Document(id="x", app_name="a", strategy="canary",
+                                   start_time="", end_time=""))
+    d2, c2 = store.create(Document(id="x", app_name="a", strategy="canary",
+                                   start_time="", end_time=""))
+    assert c1 and not c2 and d1 is d2
+    # terminal jobs may be recreated
+    store.transition("x", J.ABORT)
+    _, c3 = store.create(Document(id="x", app_name="a", strategy="canary",
+                                  start_time="", end_time=""))
+    assert c3
+
+
+def test_stuck_job_takeover():
+    store = JobStore()
+    store.create(Document(id="j", app_name="a", strategy="canary",
+                          start_time="", end_time=""))
+    got = store.claim_open_jobs("w1", max_stuck_seconds=90)
+    assert [d.id for d in got] == ["j"]
+    # w2 cannot steal a fresh lease
+    assert store.claim_open_jobs("w2", max_stuck_seconds=90) == []
+    # ...but can steal an expired one
+    store.get("j").lease_at -= 120
+    got2 = store.claim_open_jobs("w2", max_stuck_seconds=90)
+    assert [d.id for d in got2] == ["j"]
+    assert store.get("j").lease_holder == "w2"
+
+
+def test_snapshot_resume(tmp_path):
+    p = str(tmp_path / "snap.json")
+    store = JobStore(snapshot_path=p)
+    store.create(Document(id="j", app_name="a", strategy="canary",
+                          start_time="", end_time="",
+                          metrics={"error5xx": MetricQueries(current="u1")}))
+    store2 = JobStore(snapshot_path=p)
+    doc = store2.get("j")
+    assert doc is not None and doc.metrics["error5xx"].current == "u1"
+
+
+# ---------------------------------------------------------------- e2e slice
+STEP = 60
+
+
+def _series(rng, level, n, spread=None):
+    spread = level * 0.1 + 0.01 if spread is None else spread
+    ts = np.arange(n) * STEP
+    return ts.tolist(), np.clip(rng.normal(level, spread, n), 0, None).tolist()
+
+
+def _mk_job(store, fixtures, job_id, *, bad=False, end_time=0.0, rng=None):
+    """Canary job: healthy baseline ~0.5 err/s; canary 5 err/s if bad."""
+    rng = rng or np.random.default_rng(0)
+    cur_url = f"http://prom/{job_id}/cur"
+    base_url = f"http://prom/{job_id}/base"
+    hist_url = f"http://prom/{job_id}/hist"
+    fixtures[cur_url] = _series(rng, 5.0 if bad else 0.5, 30)
+    fixtures[base_url] = _series(rng, 0.5, 30)
+    fixtures[hist_url] = _series(rng, 0.5, 600)
+    doc = Document(
+        id=job_id, app_name=f"app-{job_id}", namespace="demo", strategy="canary",
+        start_time=to_rfc3339(0.0), end_time=to_rfc3339(end_time),
+        metrics={"error5xx": MetricQueries(current=cur_url, baseline=base_url,
+                                           historical=hist_url)},
+    )
+    store.create(doc)
+    return doc
+
+
+def test_e2e_slice_bad_canary_flagged_good_passes():
+    rng = np.random.default_rng(7)
+    fixtures = {}
+    store = JobStore()
+    exporter = VerdictExporter()
+    _mk_job(store, fixtures, "bad", bad=True, rng=rng)
+    _mk_job(store, fixtures, "good", bad=False, rng=rng)
+    analyzer = Analyzer(EngineConfig(pairwise_threshold=1e-4), FixtureDataSource(fixtures),
+                        store, exporter)
+    outcomes = analyzer.run_cycle(now=10_000.0)  # past endTime
+    assert outcomes["bad"] == J.COMPLETED_UNHEALTH
+    assert outcomes["good"] == J.COMPLETED_HEALTH
+    bad = store.get("bad")
+    assert "error5xx" in bad.reason
+    assert bad.anomaly  # flat [ts, v, ...] payload present
+    pairs = next(iter(bad.anomaly.values()))
+    assert len(pairs) >= 2 and len(pairs) % 2 == 0
+    # exporter published foremastbrain series
+    text = exporter.render()
+    assert "foremastbrain:error5xx_upper" in text
+    assert 'app="app-bad"' in text
+
+
+def test_e2e_healthy_before_endtime_requeues():
+    rng = np.random.default_rng(3)
+    fixtures = {}
+    store = JobStore()
+    _mk_job(store, fixtures, "j", bad=False, end_time=5_000_000.0, rng=rng)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    outcomes = analyzer.run_cycle(now=100.0)  # before endTime
+    assert outcomes["j"] == J.INITIAL  # fail-fast: keep watching
+    # bad data arriving on a later cycle flips it
+    fixtures[f"http://prom/j/cur"] = _series(rng, 8.0, 30)
+    outcomes = analyzer.run_cycle(now=200.0)
+    assert outcomes["j"] == J.COMPLETED_UNHEALTH
+
+
+def test_e2e_fetch_failure_marks_preprocess_failed():
+    store = JobStore()
+    doc = Document(id="j", app_name="a", namespace="d", strategy="canary",
+                   start_time=to_rfc3339(0), end_time=to_rfc3339(0),
+                   metrics={"error5xx": MetricQueries(current="http://nope")})
+    store.create(doc)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource({}), store)
+    out = analyzer.run_cycle()
+    assert out == {}  # failed in preprocess, not judged
+    assert store.get("j").status == J.PREPROCESS_FAILED
+    assert J.to_external(store.get("j").status) == "abort"
+
+
+def test_e2e_no_data_is_unknown():
+    store = JobStore()
+    fixtures = {"u": ([], [])}
+    doc = Document(id="j", app_name="a", namespace="d", strategy="canary",
+                   start_time=to_rfc3339(0), end_time=to_rfc3339(0),
+                   metrics={"error5xx": MetricQueries(current="u")})
+    store.create(doc)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    out = analyzer.run_cycle(now=100.0)
+    assert out["j"] == J.COMPLETED_UNKNOWN
+
+
+def test_hpa_job_emits_logs_and_requeues():
+    rng = np.random.default_rng(5)
+    fixtures = {}
+    store = JobStore()
+    exporter = VerdictExporter()
+    tps_url, sla_url = "http://prom/tps", "http://prom/sla"
+    hist_ts, hist_v = _series(rng, 100.0, 90, spread=3.0)
+    cur_ts = [t + hist_ts[-1] + STEP for t in np.arange(30) * STEP]
+    fixtures[tps_url] = (hist_ts + list(cur_ts),
+                         hist_v + np.random.default_rng(1).normal(240, 5, 30).tolist())
+    fixtures[sla_url] = _series(rng, 5.0, 120, spread=0.3)
+    doc = Document(
+        id="app:demo:hpa", app_name="app", namespace="demo", strategy="hpa",
+        start_time="START_TIME", end_time="END_TIME",
+        metrics={
+            "tps": MetricQueries(historical=tps_url, current=tps_url, priority=0),
+            "latency": MetricQueries(historical=sla_url, current=sla_url, priority=1),
+        },
+    )
+    store.create(doc)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store, exporter)
+    out = analyzer.run_cycle(now=0.0)
+    assert out["app:demo:hpa"] == J.INITIAL  # hpa jobs never terminate
+    logs = store.hpalogs_for("app:demo:hpa")
+    assert logs and logs[0].details[0]["metricType"] == "tps"
+    assert "foremastbrain:namespace_app_per_pod:hpa_score" in exporter.render()
+    # first cycle is breath-gated to 50
+    assert logs[0].hpascore == 50.0
+
+
+# -------------------------------------------------- review-finding regressions
+def test_continuous_job_never_completes_while_healthy():
+    rng = np.random.default_rng(2)
+    fixtures = {}
+    store = JobStore()
+    ts = (np.arange(60) * STEP).tolist()
+    fixtures["cu"] = (ts, rng.normal(0.5, 0.05, 60).clip(0).tolist())
+    fixtures["hu"] = ((np.arange(600) * STEP).tolist(),
+                      rng.normal(0.5, 0.05, 600).clip(0).tolist())
+    doc = Document(id="c", app_name="a", namespace="d", strategy="continuous",
+                   start_time="START_TIME", end_time="END_TIME",
+                   metrics={"error5xx": MetricQueries(current="cu", historical="hu")})
+    store.create(doc)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    for cycle in range(3):
+        out = analyzer.run_cycle(now=1000.0 + cycle)
+        assert out["c"] == J.INITIAL  # healthy continuous jobs loop forever
+
+
+def test_continuous_job_survives_transient_fetch_error():
+    store = JobStore()
+    fixtures = {}
+    doc = Document(id="c", app_name="a", namespace="d", strategy="continuous",
+                   start_time="START_TIME", end_time="END_TIME",
+                   metrics={"m": MetricQueries(current="missing")})
+    store.create(doc)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    analyzer.run_cycle(now=100.0)
+    assert store.get("c").status == J.INITIAL  # requeued, not dead
+    # one-shot canary jobs DO fail terminally on fetch errors
+    doc2 = Document(id="k", app_name="a", namespace="d", strategy="canary",
+                    start_time=to_rfc3339(0), end_time=to_rfc3339(0),
+                    metrics={"m": MetricQueries(current="missing")})
+    store.create(doc2)
+    analyzer.run_cycle(now=100.0)
+    assert store.get("k").status == J.PREPROCESS_FAILED
+
+
+def test_empty_current_is_unknown_not_healthy():
+    rng = np.random.default_rng(4)
+    store = JobStore()
+    ts = (np.arange(30) * STEP).tolist()
+    fixtures = {
+        "cu": ([], []),  # deployment produced NO metrics
+        "bu": (ts, rng.normal(0.5, 0.05, 30).tolist()),
+        "hu": ((np.arange(600) * STEP).tolist(),
+               rng.normal(0.5, 0.05, 600).tolist()),
+    }
+    doc = Document(id="j", app_name="a", namespace="d", strategy="canary",
+                   start_time=to_rfc3339(0), end_time=to_rfc3339(0),
+                   metrics={"error5xx": MetricQueries(current="cu", baseline="bu",
+                                                      historical="hu")})
+    store.create(doc)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    out = analyzer.run_cycle(now=100.0)
+    assert out["j"] == J.COMPLETED_UNKNOWN  # silence is not health
+
+
+def test_band_anomaly_timestamps_on_current_grid():
+    rng = np.random.default_rng(6)
+    store = JobStore()
+    hist_n = 600
+    hist_ts = (np.arange(hist_n) * STEP).tolist()
+    cur_start = 900_000.0  # current window far from historical grid's end
+    cur_ts = (cur_start + np.arange(30) * STEP).tolist()
+    fixtures = {
+        "cu": (cur_ts, rng.normal(8.0, 0.3, 30).tolist()),
+        "hu": (hist_ts, rng.normal(0.5, 0.05, hist_n).tolist()),
+    }
+    doc = Document(id="j", app_name="a", namespace="d", strategy="canary",
+                   start_time=to_rfc3339(0), end_time=to_rfc3339(0),
+                   metrics={"error5xx": MetricQueries(current="cu", historical="hu")})
+    store.create(doc)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    out = analyzer.run_cycle(now=1_000_000.0)
+    assert out["j"] == J.COMPLETED_UNHEALTH
+    pairs = next(iter(store.get("j").anomaly.values()))
+    stamps = pairs[0::2]
+    assert all(cur_start <= t < cur_start + 30 * STEP for t in stamps), stamps
+
+
+def test_exporter_sanitizes_metric_names():
+    from foremast_tpu.dataplane import VerdictExporter
+
+    ex = VerdictExporter()
+    ex.record_bounds("a", "ns", 'x{y} 1\nfake_series 99', 1.0, 0.0, 0.0)
+    text = ex.render()
+    assert "fake_series 99" not in text.replace("x_y__1_fake_series_99", "")
+    for line in text.strip().splitlines():
+        assert line.startswith("foremastbrain:"), line

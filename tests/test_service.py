@@ -1,0 +1,159 @@
+"""Service API over a real socket: create -> engine cycle -> status."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from foremast_tpu.dataplane import FixtureDataSource, VerdictExporter
+from foremast_tpu.engine import Analyzer, EngineConfig, JobStore
+from foremast_tpu.service import ForemastService, build_document, serve_background
+from foremast_tpu.service.api import ApiError
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+
+def _req(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(r, timeout=5) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def stack():
+    fixtures = {}
+    store = JobStore()
+    exporter = VerdictExporter()
+    service = ForemastService(store, exporter)
+    server = serve_background(service, port=0)
+    port = server.server_address[1]
+    analyzer = Analyzer(EngineConfig(pairwise_threshold=1e-4),
+                        FixtureDataSource(fixtures), store, exporter)
+    yield f"http://127.0.0.1:{port}", fixtures, analyzer, store
+    server.shutdown()
+
+
+def _create_body(app="demo-app", strategy="canary", urls=("cu", "bu", "hu")):
+    cur, base, hist = urls
+    return {
+        "appName": app,
+        "namespace": "demo",
+        "strategy": strategy,
+        "startTime": to_rfc3339(0.0),
+        "endTime": to_rfc3339(600.0),
+        "metricsInfo": {
+            "current": {"error5xx": {"url": cur, "priority": 0}},
+            "baseline": {"error5xx": {"url": base}},
+            "historical": {"error5xx": {"url": hist}},
+        },
+    }
+
+
+def test_create_then_score_then_status(stack):
+    base_url, fixtures, analyzer, store = stack
+    rng = np.random.default_rng(0)
+    ts = (np.arange(30) * 60).tolist()
+    fixtures["cu"] = (ts, rng.normal(6.0, 0.4, 30).clip(0).tolist())
+    fixtures["bu"] = (ts, rng.normal(0.5, 0.05, 30).clip(0).tolist())
+    fixtures["hu"] = ((np.arange(600) * 60).tolist(),
+                      rng.normal(0.5, 0.05, 600).clip(0).tolist())
+    code, resp = _req("POST", f"{base_url}/v1/healthcheck/create", _create_body())
+    assert code == 200 and resp["status"] == "new"
+    job_id = resp["jobId"]
+
+    # duplicate create returns the same open job
+    code, resp2 = _req("POST", f"{base_url}/v1/healthcheck/create", _create_body())
+    assert resp2["jobId"] == job_id
+
+    code, st = _req("GET", f"{base_url}/v1/healthcheck/id/{job_id}")
+    assert st["status"] == "new"
+
+    analyzer.run_cycle(now=10_000.0)
+    code, st = _req("GET", f"{base_url}/v1/healthcheck/id/{job_id}")
+    assert st["status"] == "anomaly"
+    assert "error5xx" in st["reason"]
+
+    # verdict series exposed on /metrics
+    with urllib.request.urlopen(f"{base_url}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert "foremastbrain:error5xx_upper" in text
+
+
+def test_validation_errors(stack):
+    base_url, *_ = stack
+    code, resp = _req("POST", f"{base_url}/v1/healthcheck/create",
+                      {"appName": "bad app!", "strategy": "canary"})
+    assert code == 400 and "appName" in resp["error"]
+    code, resp = _req("POST", f"{base_url}/v1/healthcheck/create",
+                      {"appName": "ok", "strategy": "nope"})
+    assert code == 400 and "strategy" in resp["error"]
+    code, resp = _req("GET", f"{base_url}/v1/healthcheck/id/missing-job")
+    assert code == 404
+
+
+def test_hpa_job_id_and_placeholders():
+    body = {
+        "appName": "shop",
+        "namespace": "prod",
+        "strategy": "hpa",
+        "metricsInfo": {
+            "current": {
+                "tps": {
+                    "dataSourceType": "prometheus",
+                    "parameters": {
+                        "endpoint": "http://prom:9090/api/v1/",
+                        "query": "namespace_app_pod_tps{app='shop'}",
+                        "start": 1000,
+                        "end": 2000,
+                        "step": 60,
+                    },
+                }
+            },
+            "historical": {"tps": {"url": "http://prom/api?start=1&end=2&step=60"}},
+        },
+    }
+    doc = build_document(body)
+    assert doc.id == "shop:prod:hpa"
+    assert "start=START_TIME&end=END_TIME" in doc.metrics["tps"].current
+    assert "start=START_TIME_H" in doc.metrics["tps"].historical
+    assert doc.start_time == "START_TIME"
+
+
+def test_wavefront_url_construction():
+    body = {
+        "appName": "w",
+        "strategy": "rollover",
+        "metricsInfo": {
+            "current": {
+                "m": {
+                    "dataSourceType": "wavefront",
+                    "parameters": {
+                        "endpoint": "https://wf.example/chart/api",
+                        "query": "ts(my.metric)",
+                        "start": 100,
+                        "end": 200,
+                        "step": 60,
+                    },
+                }
+            }
+        },
+    }
+    doc = build_document(body)
+    url = doc.metrics["m"].current
+    assert url.startswith("https://wf.example/chart/api?q=ts%28my.metric%29")
+    assert "&g=m&" in url
+
+
+def test_alert_endpoint_returns_hpalogs(stack):
+    base_url, fixtures, analyzer, store = stack
+    from foremast_tpu.engine.jobs import HpaLog
+
+    store.add_hpalog(HpaLog(job_id="web:prod:hpa", hpascore=80.0,
+                            reason="scale up", details=[]))
+    code, resp = _req("GET", f"{base_url}/alert/web/prod/hpa")
+    assert code == 200
+    assert resp["hpalogs"][0]["hpascore"] == 80.0
