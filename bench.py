@@ -1,0 +1,69 @@
+"""Benchmark: canary metric-pair scoring throughput on the fused TPU program.
+
+North star (BASELINE.json / BASELINE.md): score 100k concurrent
+(baseline, canary) metric-pair windows in <1 s p99 on a v5e-8 — i.e.
+12,500 pairs/s/chip. This bench runs the single-chip fused scorer
+(pairwise test family + forecast-band check, parallel/fleet.py) on
+realistic windows (T=128 ≈ 2h of 60s-step points — wider than the
+reference's 10-min canary window) and reports pairs scored per second
+per chip. vs_baseline = value / 12500 (>1.0 beats the 8-chip-in-1s
+target pro-rated to one chip).
+
+Prints exactly one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+TARGET_PAIRS_PER_SEC_PER_CHIP = 100_000 / 8.0  # BASELINE.json north star, per chip
+
+
+def main() -> None:
+    import jax
+
+    from foremast_tpu.parallel.fleet import score_pairs
+
+    B, T = 8192, 128
+    rng = np.random.default_rng(0)
+    baseline = rng.normal(10.0, 2.0, (B, T)).astype(np.float32)
+    current = rng.normal(10.0, 2.0, (B, T)).astype(np.float32)
+    b_mask = rng.random((B, T)) > 0.05
+    c_mask = rng.random((B, T)) > 0.05
+    cfg = (
+        np.full(B, 0.01, np.float32),
+        np.full(B, 0b1111, np.int32),
+        np.zeros(B, np.int32),
+        np.full(B, 10, np.int32),
+        np.full(B, 3.0, np.float32),
+        np.zeros(B, np.int32),
+        np.zeros(B, np.float32),
+        np.tile(np.asarray([20, 20, 5], np.int32), (B, 1)),
+    )
+    args = [jax.device_put(a) for a in (baseline, b_mask, current, c_mask, *cfg)]
+
+    def run():
+        out = score_pairs(*args)
+        jax.block_until_ready(out["unhealthy"])
+        return out
+
+    run()  # compile
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    p50 = float(np.median(times))
+    pairs_per_sec = B / p50
+    print(json.dumps({
+        "metric": "canary_pairs_scored_per_sec_per_chip",
+        "value": round(pairs_per_sec, 1),
+        "unit": "pairs/s/chip",
+        "vs_baseline": round(pairs_per_sec / TARGET_PAIRS_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

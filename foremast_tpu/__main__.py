@@ -1,0 +1,4 @@
+"""`python -m foremast_tpu` — run the combined service + engine process."""
+from .runtime import main
+
+main()

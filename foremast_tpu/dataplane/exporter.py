@@ -63,10 +63,15 @@ class VerdictExporter:
         """[(name, labels-dict, value)] for alternate sinks (Wavefront)."""
         now = time.time()
         with self._lock:
+            # evict, don't just filter: label sets come from user-submitted
+            # jobs, so unexpired-but-unevicted keys are an unbounded leak
+            dead = [k for k, (_, at) in self._gauges.items()
+                    if now - at > self.stale_seconds]
+            for k in dead:
+                del self._gauges[k]
             return [
                 (name, dict(labels), value)
                 for (name, labels), (value, at) in self._gauges.items()
-                if now - at <= self.stale_seconds
             ]
 
     def render(self) -> str:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.request
 from collections import OrderedDict
 from typing import Callable
@@ -103,28 +104,40 @@ class FixtureDataSource:
 
 
 class CachingDataSource:
-    """LRU wrapper, bounded by MAX_CACHE_SIZE — the reference brain's
+    """LRU+TTL wrapper, bounded by MAX_CACHE_SIZE — the reference brain's
     in-memory model/window cache (foremast-brain/README.md:30), rebuilt from
-    historical queries on miss."""
+    historical queries on miss.
 
-    def __init__(self, inner, max_entries: int = 1024):
+    The TTL is load-bearing, not an optimization detail: the engine re-fetches
+    the SAME current-window URL every cycle until endTime (fail-fast recheck,
+    design.md:43). A TTL-less cache would freeze the first — mostly empty —
+    response and judge stale data forever."""
+
+    def __init__(self, inner, max_entries: int = 1024, ttl_seconds: float = 55.0):
+        # default just under the 60 s metric step: one fresh fetch per new
+        # sample, cycle-frequency dedupe in between
         self.inner = inner
         self.max_entries = max_entries
-        self._cache: OrderedDict[str, tuple] = OrderedDict()
+        self.ttl_seconds = ttl_seconds
+        self._cache: OrderedDict[str, tuple] = OrderedDict()  # url -> (res, at)
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def fetch(self, url: str):
+        now = time.time()
         with self._lock:
             if url in self._cache:
-                self._cache.move_to_end(url)
-                self.hits += 1
-                return self._cache[url]
+                res, at = self._cache[url]
+                if now - at <= self.ttl_seconds:
+                    self._cache.move_to_end(url)
+                    self.hits += 1
+                    return res
+                del self._cache[url]
         res = self.inner.fetch(url)
         with self._lock:
             self.misses += 1
-            self._cache[url] = res
+            self._cache[url] = (res, now)
             if len(self._cache) > self.max_entries:
                 self._cache.popitem(last=False)
         return res

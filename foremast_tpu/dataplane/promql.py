@@ -17,6 +17,7 @@ Re-implements the behavior of foremast-barrelman's query builder
 """
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from urllib.parse import quote
 
@@ -80,6 +81,20 @@ def wavefront_url(endpoint: str, query: str, start, end, step: int = DEFAULT_STE
     return f"{endpoint}?q={quote(query, safe='')}&s={start}&g={gran}&e={end}"
 
 
+def placeholderize(url: str, historical: bool) -> str:
+    """Swap concrete start/end params for START_TIME/END_TIME placeholders.
+
+    The single home of URL-dialect knowledge: prometheus uses start=/end=,
+    wavefront s=/e=. Historical URLs get the _H marker so the engine
+    re-materializes them onto the 7-day window instead of the 30-min one.
+    """
+    if not url:
+        return url
+    start = f"{START_PLACEHOLDER}_H" if historical else START_PLACEHOLDER
+    url = re.sub(r"([?&])(start|s)=[^&]*", rf"\g<1>\g<2>={start}", url)
+    return re.sub(r"([?&])(end|e)=[^&]*", rf"\g<1>\g<2>={END_PLACEHOLDER}", url)
+
+
 @dataclass
 class MetricWindows:
     """The three query URLs for one metric."""
@@ -128,11 +143,9 @@ def build_metric_windows(
 
         if continuous:
             # windows re-materialized every cycle by the engine
-            cur = url(cur_q, START_PLACEHOLDER, END_PLACEHOLDER)
+            cur = placeholderize(url(cur_q, 0, 0), historical=False)
             base = ""
-            hist = url(hist_q, START_PLACEHOLDER, END_PLACEHOLDER).replace(
-                f"start={START_PLACEHOLDER}", f"start={START_PLACEHOLDER}_H"
-            )
+            hist = placeholderize(url(hist_q, 0, 0), historical=True)
         else:
             cur = url(cur_q, start_a, end_a)
             base = url(base_q, start_a - length, start_a)

@@ -33,13 +33,18 @@ from ..dataplane.promql import (
 )
 from ..ops import forecast as fc
 from ..ops import hpa as hpa_ops
-from ..ops.windowing import Window, bucket_length, pack_windows, resample_to_grid
+from ..ops.windowing import (
+    MAX_WINDOW_STEPS,
+    Window,
+    align_step,
+    bucket_length,
+    pack_windows,
+    resample_to_grid,
+)
 from ..parallel import fleet as fl
 from ..utils.timeutils import from_rfc3339
 from . import jobs as J
 from .config import EngineConfig, MetricPolicy
-
-_ALGOS = ("moving_average", "exponential_smoothing", "double_exponential", "holt_winters")
 
 
 @dataclass
@@ -70,6 +75,18 @@ class _HpaItem:
     priority: int = 0
 
 
+def _concat_trimmed(hist: Window, cur: Window):
+    """(values, mask, n_h) of hist+current, hist left-trimmed so the concat
+    fits the largest compiled bucket (static-shape ceiling)."""
+    n_c = cur.values.shape[0]
+    max_h = max(MAX_WINDOW_STEPS - n_c, 0)
+    h_vals = hist.values[-max_h:] if max_h else hist.values[:0]
+    h_mask = hist.mask[-max_h:] if max_h else hist.mask[:0]
+    vals = np.concatenate([h_vals, cur.values[: MAX_WINDOW_STEPS]])
+    mask = np.concatenate([h_mask, cur.mask[: MAX_WINDOW_STEPS]])
+    return vals, mask, h_vals.shape[0]
+
+
 @dataclass
 class _JobState:
     doc: J.Document
@@ -96,7 +113,12 @@ class Analyzer:
         ts, vals = self.source.fetch(url)
         if not ts:
             return Window(np.zeros(1, np.float32), np.zeros(1, bool), 0)
-        return resample_to_grid(ts, vals, min(ts), max(ts) + 60, 60)
+        # clamp the grid span to the largest compiled bucket, keeping the
+        # most recent samples: a user query returning >11 days of data must
+        # not produce an unbucketable window (and with it a poisoned batch)
+        end = align_step(max(ts)) + 60
+        start = max(align_step(min(ts)), end - MAX_WINDOW_STEPS * 60)
+        return resample_to_grid(ts, vals, start, end, 60)
 
     def _preprocess(self, doc: J.Document, now: float):
         """Fetch all windows for a job; returns (pair, band, hpa) item lists."""
@@ -123,6 +145,26 @@ class Analyzer:
         return pairs, bands, hpas
 
     # ------------------------------------------------------------- scoring
+    def _isolate(self, score_fn, items):
+        """Run a batch scorer with per-job blast-radius containment.
+
+        Scorers batch many jobs into one device program, so one poisoned
+        item would otherwise fail the whole cycle for everyone — and the
+        stuck-job takeover would re-claim and re-crash it forever. On batch
+        failure, retry item-by-item and report {job_id: error} for the
+        offenders only.
+        """
+        try:
+            return score_fn(items), {}
+        except Exception:  # noqa: BLE001 - fall back to per-item isolation
+            results, bad = {}, {}
+            for it in items:
+                try:
+                    results.update(score_fn([it]))
+                except Exception as e:  # noqa: BLE001
+                    bad[it.job_id] = f"{type(e).__name__}: {e}"
+            return results, bad
+
     def _score_pairs(self, items: list[_PairItem]):
         """Batch all pairwise items (bucketed by window length)."""
         results = {}
@@ -150,6 +192,17 @@ class Analyzer:
                 np.asarray([it.policy.threshold for it in group], np.float32),
                 np.asarray([it.policy.bound for it in group], np.int32),
                 np.asarray([it.policy.min_lower_bound for it in group], np.float32),
+                np.tile(
+                    np.asarray(
+                        [
+                            cfg.min_mann_whitney_points,
+                            cfg.min_wilcoxon_points,
+                            cfg.min_kruskal_points,
+                        ],
+                        np.int32,
+                    ),
+                    (B, 1),
+                ),
             )
             unhealthy = np.asarray(out["unhealthy"])
             min_p = np.asarray(out["min_p"])
@@ -185,19 +238,22 @@ class Analyzer:
         by_bucket: dict[int, list[_BandItem]] = {}
         for it in items:
             T = bucket_length(
-                it.historical.values.shape[0] + it.current.values.shape[0]
+                min(
+                    it.historical.values.shape[0] + it.current.values.shape[0],
+                    MAX_WINDOW_STEPS,
+                )
             )
             by_bucket.setdefault(T, []).append(it)
         for T, group in by_bucket.items():
             concats = []
             regions = np.zeros((len(group), T), bool)
+            trimmed_n_h = {}
             for i, it in enumerate(group):
                 h, c = it.historical, it.current
-                n_h, n_c = h.values.shape[0], c.values.shape[0]
-                vals = np.concatenate([h.values, c.values])
-                mask = np.concatenate([h.mask, c.mask])
+                vals, mask, n_h = _concat_trimmed(h, c)
+                trimmed_n_h[id(it)] = n_h
                 concats.append(Window(vals, mask, h.start, h.step))
-                regions[i, n_h : n_h + n_c] = True
+                regions[i, n_h : vals.shape[0]] = True
             xv, xm = pack_windows(concats, pad_to=T)
             preds, hist_mask = self._predict(xv, xm, regions)
             sigma = np.asarray(fc.residual_sigma(xv, preds, hist_mask, ~regions))
@@ -214,7 +270,7 @@ class Analyzer:
             flags = np.asarray(out["flags"])
             checked = np.asarray(out["checked"])
             for i, it in enumerate(group):
-                n_h = it.historical.values.shape[0]
+                n_h = trimmed_n_h[id(it)]
 
                 def concat_ts(j: int) -> float:
                     # anomalies lie in the current region: translate the
@@ -243,7 +299,7 @@ class Analyzer:
                 }
         return results
 
-    def _score_hpa(self, items: list[_HpaItem], now: float):
+    def _score_hpa(self, items: list[_HpaItem]):
         """Batch HPA items: primary (priority 0 / tps-like) metric drives the
         traffic model; an SLA metric (is_increase & priority>0) the reward."""
         by_job: dict[str, list[_HpaItem]] = {}
@@ -254,24 +310,33 @@ class Analyzer:
         for job_id, group in by_job.items():
             group.sort(key=lambda it: it.priority)
             tps_it = group[0]
-            sla_it = group[1] if len(group) > 1 else group[0]
+            # SLA metric contract: is_increase (a "more is worse" signal)
+            # with priority > 0; fall back to any secondary, then primary
+            sla_candidates = [it for it in group[1:] if it.is_increase]
+            if sla_candidates:
+                sla_it = sla_candidates[0]
+            else:
+                sla_it = group[1] if len(group) > 1 else group[0]
             rows.append((job_id, tps_it, sla_it))
         if not rows:
             return out
         # pack length must fit BOTH the tps and sla series (lengths are
         # data-driven and independent)
         T = max(
-            bucket_length(it.historical.values.shape[0] + it.current.values.shape[0])
+            bucket_length(
+                min(
+                    it.historical.values.shape[0] + it.current.values.shape[0],
+                    MAX_WINDOW_STEPS,
+                )
+            )
             for row in rows
             for it in (row[1], row[2])
         )
 
         def build(it):
-            vals = np.concatenate([it.historical.values, it.current.values])
-            mask = np.concatenate([it.historical.mask, it.current.mask])
+            vals, mask, n_h = _concat_trimmed(it.historical, it.current)
             region = np.zeros(T, bool)
-            n_h = it.historical.values.shape[0]
-            region[n_h : n_h + it.current.values.shape[0]] = True
+            region[n_h : vals.shape[0]] = True
             return Window(vals, mask, it.historical.start), region
 
         tps_w, regions = zip(*[build(t) for _, t, _ in rows])
@@ -344,13 +409,16 @@ class Analyzer:
                 self.store.transition(doc_id, J.POSTPROCESS_INPROGRESS, worker=worker)
 
         live = {k: v for k, v in states.items() if not v.failed}
-        pair_res = self._score_pairs(all_pairs)
-        band_res = self._score_bands(all_bands)
-        hpa_res = self._score_hpa(all_hpas, now)
+        pair_res, pair_bad = self._isolate(self._score_pairs, all_pairs)
+        band_res, band_bad = self._isolate(self._score_bands, all_bands)
+        hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
+        scoring_failed = {**pair_bad, **band_bad, **hpa_bad}
 
         # fold per-metric results into per-job verdicts
         for it in all_pairs:
-            r = pair_res[(it.job_id, it.metric, "pair")]
+            r = pair_res.get((it.job_id, it.metric, "pair"))
+            if r is None:
+                continue
             st = live[it.job_id]
             st.judged_any = True
             if r["unhealthy"]:
@@ -358,7 +426,9 @@ class Analyzer:
                     (it.metric, f"pairwise rejection p={r['min_p']:.2e}", [])
                 )
         for it in all_bands:
-            r = band_res[(it.job_id, it.metric, "band")]
+            r = band_res.get((it.job_id, it.metric, "band"))
+            if r is None:
+                continue
             st = live[it.job_id]
             st.judged_any = True
             self.exporter.record_bounds(
@@ -378,6 +448,16 @@ class Analyzer:
         outcomes = {}
         for job_id, st in live.items():
             doc = st.doc
+            if job_id in scoring_failed:
+                reason = f"scoring failed: {scoring_failed[job_id]}"
+                if doc.strategy in CONTINUOUS_STRATEGIES:
+                    # perpetual jobs retry next cycle (data may heal)
+                    self.store.transition(job_id, J.INITIAL, reason=reason, worker=worker)
+                    outcomes[job_id] = J.INITIAL
+                else:
+                    self.store.transition(job_id, J.ABORT, reason=reason, worker=worker)
+                    outcomes[job_id] = J.ABORT
+                continue
             if doc.strategy == STRATEGY_HPA:
                 outcomes[job_id] = self._finish_hpa(st, hpa_res.get(job_id), worker, now)
                 continue

@@ -21,6 +21,7 @@ implement the same four methods.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from dataclasses import asdict, dataclass, field
@@ -144,6 +145,8 @@ class JobStore:
         self._jobs: dict[str, Document] = {}
         self._hpalogs: list[HpaLog] = []
         self._snapshot_path = snapshot_path
+        self._dirty = False
+        self._last_write = 0.0
         if snapshot_path:
             self._load()
 
@@ -242,34 +245,45 @@ class JobStore:
             return
         now = time.time()
         self._dirty = True
-        if now - getattr(self, "_last_write", 0.0) < 1.0:
+        if now - self._last_write < 1.0:
             return
         self.flush()
 
     def flush(self):
-        """Force-write the snapshot (called at cycle boundaries/shutdown)."""
-        if not self._snapshot_path or not getattr(self, "_dirty", True):
+        """Force-write the snapshot (called at cycle boundaries/shutdown).
+
+        Serialize AND write under the lock: concurrent flushes share one
+        .tmp path, so an unlocked write lets two threads interleave bytes
+        and os.replace() a corrupt snapshot into place.
+        """
+        if not self._snapshot_path:
             return
         with self._lock:
+            if not self._dirty:
+                return
             data = {
                 "jobs": [d.to_json() for d in self._jobs.values()],
                 "hpalogs": [asdict(l) for l in self._hpalogs],
             }
             self._dirty = False
             self._last_write = time.time()
-        tmp = self._snapshot_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        import os
-
-        os.replace(tmp, self._snapshot_path)
+            tmp = self._snapshot_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._snapshot_path)
 
     def _load(self):
-        import os
-
         if not os.path.exists(self._snapshot_path):
             return
-        with open(self._snapshot_path) as f:
-            data = json.load(f)
-        self._jobs = {d["id"]: Document.from_json(d) for d in data.get("jobs", [])}
-        self._hpalogs = [HpaLog(**l) for l in data.get("hpalogs", [])]
+        try:
+            with open(self._snapshot_path) as f:
+                data = json.load(f)
+            jobs = {d["id"]: Document.from_json(d) for d in data.get("jobs", [])}
+            logs = [HpaLog(**l) for l in data.get("hpalogs", [])]
+        except (json.JSONDecodeError, OSError, KeyError, TypeError):
+            # a torn/corrupt snapshot must not brick the service: quarantine
+            # it and start empty (jobs are re-submitted by the operator tick)
+            os.replace(self._snapshot_path, self._snapshot_path + ".corrupt")
+            return
+        self._jobs = jobs
+        self._hpalogs = logs

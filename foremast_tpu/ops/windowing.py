@@ -19,7 +19,14 @@ from typing import Sequence
 
 import numpy as np
 
-__all__ = ["Window", "resample_to_grid", "pack_windows", "align_step", "bucket_length"]
+__all__ = [
+    "Window",
+    "resample_to_grid",
+    "pack_windows",
+    "align_step",
+    "bucket_length",
+    "MAX_WINDOW_STEPS",
+]
 
 DEFAULT_STEP = 60  # seconds; metricsquery.go:63 "step = 60"
 
@@ -75,6 +82,8 @@ def resample_to_grid(
 
 
 _BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+
+MAX_WINDOW_STEPS = _BUCKETS[-1]
 
 
 def bucket_length(T: int) -> int:

@@ -63,8 +63,15 @@ def _pair_verdict(
     band_threshold,
     bound_mode,
     min_lower_bound,
+    min_points=None,
 ):
-    """Single (baseline, current) judgment. vmapped by score_pairs."""
+    """Single (baseline, current) judgment. vmapped by score_pairs.
+
+    min_points: (3,) gates for mann-whitney/wilcoxon/kruskal — the
+    MIN_*_DATA_POINTS config surface (foremast-brain.yaml:74-79).
+    """
+    if min_points is None:
+        min_points = jnp.asarray([MIN_MANN_WHITNEY, MIN_WILCOXON, MIN_KRUSKAL])
     n_b = jnp.sum(b_mask.astype(_F))
     n_c = jnp.sum(c_mask.astype(_F))
     n_min = jnp.minimum(n_b, n_c)
@@ -82,9 +89,9 @@ def _pair_verdict(
     # a test participates only if enabled AND it has enough data
     enough = jnp.stack(
         [
-            n_min >= MIN_MANN_WHITNEY,
-            n_min >= MIN_WILCOXON,
-            n_min >= MIN_KRUSKAL,
+            n_min >= min_points[0],
+            n_min >= min_points[1],
+            n_min >= min_points[2],
             n_min >= 2,
         ]
     )
@@ -151,19 +158,19 @@ def make_fleet_scorer(mesh, k: int = 8):
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(FLEET_AXIS),) * 4 + (P(FLEET_AXIS),) * 7 + (P(FLEET_AXIS),),
+        in_specs=(P(FLEET_AXIS),) * 4 + (P(FLEET_AXIS),) * 8 + (P(FLEET_AXIS),),
         out_specs=(P(FLEET_AXIS), P(), P(), P()),
         check_vma=False,
     )
     def _sharded(
         baseline, b_mask, current, c_mask,
         pvalue_threshold, test_mask, combine, ma_window,
-        band_threshold, bound_mode, min_lower_bound, global_idx,
+        band_threshold, bound_mode, min_lower_bound, min_points, global_idx,
     ):
         out = jax.vmap(_pair_verdict)(
             baseline, b_mask, current, c_mask,
             pvalue_threshold, test_mask, combine, ma_window,
-            band_threshold, bound_mode, min_lower_bound,
+            band_threshold, bound_mode, min_lower_bound, min_points,
         )
         local_unhealthy = jnp.sum(out["unhealthy"].astype(jnp.int32))
         total_unhealthy = jax.lax.psum(local_unhealthy, FLEET_AXIS)
@@ -181,11 +188,17 @@ def make_fleet_scorer(mesh, k: int = 8):
         if B % n_shards:
             raise ValueError(f"batch {B} not divisible by fleet axis {n_shards}")
         gidx = jnp.arange(B)
+        min_points = cfg.get(
+            "min_points",
+            jnp.tile(
+                jnp.asarray([MIN_MANN_WHITNEY, MIN_WILCOXON, MIN_KRUSKAL]), (B, 1)
+            ),
+        )
         args = (
             baseline, b_mask, current, c_mask,
             cfg["pvalue_threshold"], cfg["test_mask"], cfg["combine"],
             cfg["ma_window"], cfg["band_threshold"], cfg["bound_mode"],
-            cfg["min_lower_bound"], gidx,
+            cfg["min_lower_bound"], min_points, gidx,
         )
         args = jax.device_put(
             args, tuple(shard for _ in args)

@@ -1,0 +1,111 @@
+"""Composition root: one process = service + engine worker loop.
+
+The reference ran foremast-service (Go, HTTP :8099), foremast-brain (Python
+worker pool polling Elasticsearch), and the verdict /metrics exporter
+(:8000) as three deployments with ES between them (SURVEY.md §1 L3-L5). The
+TPU-native design collapses them into one process: the HTTP API writes into
+the in-process JobStore, worker cycles drain it through the batched TPU
+scorer, and the exporter serves foremastbrain:* from the same registry.
+
+Env surface (union of the reference services'):
+  ML_* family            engine knobs (engine/config.py, foremast-brain/README.md:22-38)
+  MAX_CACHE_SIZE         window-fetch LRU entries (foremast-brain/README.md:30)
+  QUERY_SERVICE_ENDPOINT metric-store base for the dashboard proxy
+                         (foremast-service/cmd/manager/main.go:301-309)
+  SNAPSHOT_PATH          job-store checkpoint file (ES's durability role)
+  PORT                   HTTP port (reference :8099)
+  CYCLE_SECONDS          engine cycle cadence (brain poll loop)
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .dataplane.exporter import VerdictExporter
+from .dataplane.fetch import CachingDataSource, PrometheusDataSource
+from .engine.analyzer import Analyzer
+from .engine.config import EngineConfig, from_env
+from .engine.jobs import JobStore
+from .service.api import ForemastService, make_server
+
+__all__ = ["Runtime"]
+
+
+class Runtime:
+    def __init__(
+        self,
+        config: EngineConfig | None = None,
+        data_source=None,
+        snapshot_path: str | None = None,
+        query_endpoint: str = "",
+        cache: bool = True,
+    ):
+        self.config = config or from_env()
+        source = data_source or PrometheusDataSource()
+        if cache:
+            source = CachingDataSource(source, max_entries=self.config.max_cache_size)
+        self.source = source
+        self.store = JobStore(snapshot_path=snapshot_path)
+        self.exporter = VerdictExporter()
+        self.analyzer = Analyzer(
+            self.config, self.source, self.store, exporter=self.exporter
+        )
+        self.service = ForemastService(
+            self.store, exporter=self.exporter, query_endpoint=query_endpoint
+        )
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._server = None
+
+    # -- lifecycle --
+    def start(self, host: str = "0.0.0.0", port: int = 8099,
+              cycle_seconds: float = 10.0, worker: str = "worker-0"):
+        """Start the HTTP server and the engine worker loop (background)."""
+        self._server = make_server(self.service, host, port)
+        t_http = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t_http.start()
+        t_eng = threading.Thread(
+            target=self._worker_loop, args=(cycle_seconds, worker), daemon=True
+        )
+        t_eng.start()
+        self._threads = [t_http, t_eng]
+        return self
+
+    def _worker_loop(self, cycle_seconds: float, worker: str):
+        while not self._stop.is_set():
+            t0 = time.time()
+            try:
+                self.analyzer.run_cycle(worker=worker)
+            except Exception as e:  # noqa: BLE001 - worker must survive a bad cycle
+                print(f"[foremast-tpu] cycle error: {e}", flush=True)
+            self._stop.wait(max(0.0, cycle_seconds - (time.time() - t0)))
+
+    def stop(self):
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+        self.store.flush()
+
+    def run_forever(self, **kw):
+        self.start(**kw)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            self.stop()
+
+
+def main():
+    rt = Runtime(
+        snapshot_path=os.environ.get("SNAPSHOT_PATH") or None,
+        query_endpoint=os.environ.get("QUERY_SERVICE_ENDPOINT", ""),
+    )
+    port = int(os.environ.get("PORT", "8099"))
+    cycle = float(os.environ.get("CYCLE_SECONDS", "10"))
+    print(f"[foremast-tpu] serving :{port}, cycle={cycle}s", flush=True)
+    rt.run_forever(port=port, cycle_seconds=cycle)
+
+
+if __name__ == "__main__":
+    main()

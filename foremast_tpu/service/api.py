@@ -38,6 +38,7 @@ from ..dataplane.promql import (
     CONTINUOUS_STRATEGIES,
     END_PLACEHOLDER,
     START_PLACEHOLDER,
+    placeholderize,
     prometheus_range_url,
     wavefront_url,
 )
@@ -67,33 +68,28 @@ def _category_url(entry: dict, strategy: str) -> str:
     """
     if not entry:
         return ""
+    if not isinstance(entry, dict):
+        raise ApiError(400, f"metric entry must be an object, got {type(entry).__name__}")
     if entry.get("url"):
         url = entry["url"]
     else:
         params = entry.get("parameters", {})
+        if not isinstance(params, dict):
+            raise ApiError(400, "metric 'parameters' must be an object")
         query = params.get("query", "")
         if not query:
             return ""
         endpoint = params.get("endpoint", "http://prometheus:9090/api/v1/")
         start = params.get("start", 0)
         end = params.get("end", 0)
-        step = int(params.get("step", 60))
+        try:
+            step = int(params.get("step", 60))
+        except (TypeError, ValueError):
+            raise ApiError(400, f"invalid step {params.get('step')!r}") from None
         if entry.get("dataSourceType") == "wavefront":
             url = wavefront_url(endpoint, query, start, end, step)
         else:
             url = prometheus_range_url(endpoint, query, start, end, step)
-    return url
-
-
-def _placeholderize(url: str, historical: bool) -> str:
-    """Swap concrete start/end for placeholders (continuous/hpa jobs)."""
-    if not url:
-        return url
-    start = f"{START_PLACEHOLDER}_H" if historical else START_PLACEHOLDER
-    url = re.sub(r"([?&])start=[^&]*", rf"\g<1>start={start}", url)
-    url = re.sub(r"([?&])end=[^&]*", rf"\g<1>end={END_PLACEHOLDER}", url)
-    url = re.sub(r"([?&])s=[^&]*", rf"\g<1>s={start}", url)
-    url = re.sub(r"([?&])e=[^&]*", rf"\g<1>e={END_PLACEHOLDER}", url)
     return url
 
 
@@ -115,7 +111,10 @@ def build_document(req: dict) -> Document:
 
     continuous = strategy in CONTINUOUS_STRATEGIES
     metrics: dict[str, MetricQueries] = {}
-    for name in set(current) | set(baseline) | set(historical):
+    # sorted: set iteration is hash-randomized across processes, and the
+    # HPA tps/sla selection tie-breaks on insertion order — scores must not
+    # change across a restart
+    for name in sorted(set(current) | set(baseline) | set(historical)):
         if not _METRIC_RE.match(name):
             raise ApiError(400, f"invalid metric name {name!r}")
         cur_e = current.get(name, {})
@@ -123,16 +122,24 @@ def build_document(req: dict) -> Document:
         base = _category_url(baseline.get(name, {}), strategy)
         hist = _category_url(historical.get(name, {}), strategy)
         if continuous:
-            cur = _placeholderize(cur, historical=False)
+            cur = placeholderize(cur, historical=False)
             base = ""
-            hist = _placeholderize(hist, historical=True)
+            hist = placeholderize(hist, historical=True)
+        # hpa flags may ride whichever category carries the metric
+        flags = cur_e or baseline.get(name, {}) or historical.get(name, {})
+        try:
+            priority = int(flags.get("priority", 0))
+        except (TypeError, ValueError):
+            raise ApiError(
+                400, f"invalid priority {flags.get('priority')!r} for {name}"
+            ) from None
         metrics[name] = MetricQueries(
             current=cur,
             baseline=base,
             historical=hist,
-            priority=int(cur_e.get("priority", 0)),
-            is_increase=bool(cur_e.get("isIncrease", True)),
-            is_absolute=bool(cur_e.get("isAbsolute", False)),
+            priority=priority,
+            is_increase=bool(flags.get("isIncrease", True)),
+            is_absolute=bool(flags.get("isAbsolute", False)),
         )
 
     start_time = req.get("startTime", "")

@@ -30,6 +30,7 @@ def _cfg(B):
         "band_threshold": np.full(B, 2.0, np.float32),
         "bound_mode": np.full(B, 3, np.int32),
         "min_lower_bound": np.full(B, -np.inf, np.float32),
+        "min_points": np.tile(np.asarray([20, 20, 5], np.int32), (B, 1)),
     }
 
 
@@ -43,15 +44,11 @@ def test_score_pairs_flags_bad_pairs():
     B = 32
     base, bm, cur, cm, bad = _fleet_batch(B)
     cfg = _cfg(B)
-    out = jax.vmap(fl._pair_verdict)(base, bm, cur, cm, **{
-        k: cfg[k] for k in (
-            "pvalue_threshold", "test_mask", "combine", "ma_window",
-            "band_threshold", "bound_mode", "min_lower_bound")
-    }) if False else fl.score_pairs(
+    out = fl.score_pairs(
         base, bm, cur, cm,
         cfg["pvalue_threshold"], cfg["test_mask"], cfg["combine"],
         cfg["ma_window"], cfg["band_threshold"], cfg["bound_mode"],
-        cfg["min_lower_bound"],
+        cfg["min_lower_bound"], cfg["min_points"],
     )
     got = np.asarray(out["unhealthy"])
     np.testing.assert_array_equal(got, bad)
