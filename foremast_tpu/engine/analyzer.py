@@ -151,18 +151,22 @@ class Analyzer:
         Scorers batch many jobs into one device program, so one poisoned
         item would otherwise fail the whole cycle for everyone — and the
         stuck-job takeover would re-claim and re-crash it forever. On batch
-        failure, retry item-by-item and report {job_id: error} for the
-        offenders only.
+        failure, retry per JOB (not per item: _score_hpa scores a job's
+        metrics jointly — splitting them would misassign tps/sla roles) and
+        report {job_id: error} for the offenders only.
         """
         try:
             return score_fn(items), {}
-        except Exception:  # noqa: BLE001 - fall back to per-item isolation
+        except Exception:  # noqa: BLE001 - fall back to per-job isolation
             results, bad = {}, {}
+            by_job: dict[str, list] = {}
             for it in items:
+                by_job.setdefault(it.job_id, []).append(it)
+            for job_id, group in by_job.items():
                 try:
-                    results.update(score_fn([it]))
+                    results.update(score_fn(group))
                 except Exception as e:  # noqa: BLE001
-                    bad[it.job_id] = f"{type(e).__name__}: {e}"
+                    bad[job_id] = f"{type(e).__name__}: {e}"
             return results, bad
 
     def _score_pairs(self, items: list[_PairItem]):

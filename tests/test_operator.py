@@ -1,0 +1,601 @@
+"""Operator tests over the FakeKube seam.
+
+Mirrors the reference's intended test strategy (fake clientsets +
+injectable analyst DoFunc, SURVEY.md §4) and its system-level acceptance
+path: deploy healthy v1, roll a bad v2, assert the monitor goes Unhealthy
+and the deployment auto-rolls back (docs/guides/installation.md:88-150).
+"""
+import time
+
+import numpy as np
+import pytest
+
+from foremast_tpu.dataplane.exporter import VerdictExporter
+from foremast_tpu.dataplane.fetch import FixtureDataSource
+from foremast_tpu.engine.analyzer import Analyzer
+from foremast_tpu.engine.config import EngineConfig
+from foremast_tpu.engine.jobs import JobStore
+from foremast_tpu.operator import (
+    Barrelman,
+    DeploymentController,
+    FakeKube,
+    HpaController,
+    InProcessAnalyst,
+    MonitorController,
+)
+from foremast_tpu.operator.analyst import StatusResponse
+from foremast_tpu.operator.loop import OperatorLoop
+from foremast_tpu.operator.types import (
+    DEFAULT_HPA_TEMPLATE,
+    PHASE_HEALTHY,
+    PHASE_RUNNING,
+    PHASE_UNHEALTHY,
+    Analyst,
+    DeploymentMetadata,
+    DeploymentMonitor,
+    HpaScoreTemplate,
+    Metrics,
+    MonitorSpec,
+    MonitorStatus,
+    Monitoring,
+    RemediationAction,
+)
+from foremast_tpu.service.api import ForemastService
+
+
+def _deployment(name, ns="default", image="app:v1", app=None, revision=1, env=None):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "labels": {"app": app or name},
+            "annotations": {"deployment.kubernetes.io/revision": str(revision)},
+        },
+        "spec": {
+            "selector": {"matchLabels": {"app": app or name}},
+            "template": {
+                "spec": {
+                    "containers": [
+                        {"name": "main", "image": image, "env": env or []}
+                    ]
+                }
+            },
+        },
+    }
+
+
+def _replicaset(name, owner, revision, hash_, ns="default", replicas=1):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "labels": {"pod-template-hash": hash_},
+            "annotations": {"deployment.kubernetes.io/revision": str(revision)},
+            "ownerReferences": [{"kind": "Deployment", "name": owner}],
+        },
+        "spec": {
+            "replicas": replicas,
+            "template": {"spec": {"containers": [{"name": "main", "image": f"app:r{revision}"}]}},
+        },
+    }
+
+
+def _pod(name, app, hash_, ns="default"):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "labels": {"app": app, "pod-template-hash": hash_},
+        }
+    }
+
+
+def _metadata(name="demo", ns="default", endpoint="http://prom/api/v1/"):
+    return DeploymentMetadata(
+        name=name,
+        namespace=ns,
+        analyst=Analyst(endpoint="http://svc:8099"),
+        metrics=Metrics(
+            data_source_type="prometheus",
+            endpoint=endpoint,
+            monitoring=[Monitoring(metric_name="error5xx", metric_alias="error5xx")],
+        ),
+        hpa_score_templates=[
+            HpaScoreTemplate(name=DEFAULT_HPA_TEMPLATE, metrics=["cpu", "tps", "latency"])
+        ],
+    )
+
+
+class ScriptedAnalyst:
+    """Canned analyst: records requests, returns scripted statuses."""
+
+    def __init__(self, phase=PHASE_RUNNING):
+        self.requests = []
+        self.phase = phase
+        self.reason = ""
+        self.n = 0
+
+    def start_analyzing(self, request):
+        self.requests.append(request)
+        self.n += 1
+        return f"job-{self.n}"
+
+    def get_status(self, job_id):
+        return StatusResponse(phase=self.phase, reason=self.reason)
+
+
+# --------------------------------------------------------------- barrelman
+def test_monitor_new_deployment_creates_running_monitor():
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    b = Barrelman(kube, analyst)
+    kube.deployments[("default", "demo")] = _deployment("demo", revision=2)
+    kube.replicasets[("default", "demo-1")] = _replicaset("demo-1", "demo", 1, "h1")
+    kube.replicasets[("default", "demo-2")] = _replicaset("demo-2", "demo", 2, "h2")
+    kube.pods[("default", "demo-1-a")] = _pod("demo-1-a", "demo", "h1")
+    kube.pods[("default", "demo-2-a")] = _pod("demo-2-a", "demo", "h2")
+
+    m = b.monitor_new_deployment("default", "demo", kube.get_deployment("default", "demo"))
+    assert m.status.phase == PHASE_RUNNING
+    assert m.status.job_id == "job-1"
+    req = analyst.requests[0]
+    assert req["strategy"] == "rollingUpdate"
+    # current = new pods, baseline = old pods (pod-level queries)
+    assert "demo-2-a" in req["metricsInfo"]["current"]["error5xx"]["url"]
+    assert "demo-1-a" in req["metricsInfo"]["baseline"]["error5xx"]["url"]
+    assert "7" not in req["metricsInfo"]["current"]["error5xx"]["url"].split("?")[0]
+
+
+def test_pod_names_by_replicaset_revision():
+    kube = FakeKube()
+    b = Barrelman(kube, ScriptedAnalyst())
+    kube.deployments[("default", "demo")] = _deployment("demo", revision=3)
+    kube.replicasets[("default", "rs-old")] = _replicaset("rs-old", "demo", 2, "old")
+    kube.replicasets[("default", "rs-new")] = _replicaset("rs-new", "demo", 3, "new")
+    kube.pods[("default", "p-old")] = _pod("p-old", "demo", "old")
+    kube.pods[("default", "p-new1")] = _pod("p-new1", "demo", "new")
+    kube.pods[("default", "p-new2")] = _pod("p-new2", "demo", "new")
+    old, new = b.get_pod_names("default", kube.get_deployment("default", "demo"))
+    assert old == ["p-old"] and sorted(new) == ["p-new1", "p-new2"]
+
+
+def test_check_running_status_applies_phase_and_expiry():
+    kube = FakeKube()
+    analyst = ScriptedAnalyst(phase=PHASE_UNHEALTHY)
+    analyst.reason = "bad"
+    b = Barrelman(kube, analyst)
+    now = time.time()
+    from foremast_tpu.utils.timeutils import to_rfc3339
+
+    kube.upsert_monitor(
+        DeploymentMonitor(
+            name="demo", namespace="default",
+            spec=MonitorSpec(wait_until=to_rfc3339(now + 600)),
+            status=MonitorStatus(phase=PHASE_RUNNING, job_id="j1"),
+        )
+    )
+    touched = b.check_running_status(now)
+    assert touched["default/demo"] == PHASE_UNHEALTHY
+    m = kube.get_monitor("default", "demo")
+    assert m.status.remediation_taken is False
+
+    # expiry: running past waitUntil forced Healthy+Expired
+    analyst.phase = PHASE_RUNNING
+    kube.upsert_monitor(
+        DeploymentMonitor(
+            name="late", namespace="default",
+            spec=MonitorSpec(wait_until=to_rfc3339(now - 10)),
+            status=MonitorStatus(phase=PHASE_RUNNING, job_id="j2"),
+        )
+    )
+    b.check_running_status(now)
+    late = kube.get_monitor("default", "late")
+    assert late.status.phase == PHASE_HEALTHY and late.status.expired
+
+
+def test_empty_job_id_expires_healthy():
+    kube = FakeKube()
+    b = Barrelman(kube, ScriptedAnalyst())
+    kube.upsert_monitor(
+        DeploymentMonitor(
+            name="demo", namespace="default",
+            status=MonitorStatus(phase=PHASE_RUNNING, job_id=""),
+        )
+    )
+    b.check_running_status()
+    m = kube.get_monitor("default", "demo")
+    assert m.status.phase == PHASE_HEALTHY and m.status.expired
+
+
+# ------------------------------------------------------ deployment controller
+def test_namespace_gating():
+    kube = FakeKube()
+    kube.namespaces["locked"] = {"annotations": {"foremast.ai/monitoring": "false"}}
+    dc = DeploymentController(kube, Barrelman(kube, ScriptedAnalyst()))
+    assert dc.is_monitored_namespace("default")
+    assert not dc.is_monitored_namespace("kube-system")
+    assert not dc.is_monitored_namespace("monitoring")
+    assert not dc.is_monitored_namespace("locked")
+
+
+def test_image_change_triggers_analysis_env_change_too():
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    dc = DeploymentController(kube, Barrelman(kube, analyst))
+    d1 = _deployment("demo", image="app:v1", revision=1)
+    d2 = _deployment("demo", image="app:v2", revision=2)
+    dc.on_update(d1, d2)
+    assert len(analyst.requests) == 1
+    d3 = _deployment("demo", image="app:v2", revision=3,
+                     env=[{"name": "X", "value": "1"}])
+    dc.on_update(d2, d3)
+    assert len(analyst.requests) == 2
+    # no-op update does not trigger
+    dc.on_update(d3, d3)
+    assert len(analyst.requests) == 2
+
+
+def test_rollback_loop_guard():
+    """A rollback-generated update (revision == RollbackRevision) must not
+    start a new analysis (DeploymentController.go:177-186)."""
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    dc = DeploymentController(kube, Barrelman(kube, analyst))
+    kube.upsert_monitor(
+        DeploymentMonitor(
+            name="demo", namespace="default",
+            spec=MonitorSpec(rollback_revision=3),
+        )
+    )
+    d_new = _deployment("demo", image="app:v1", revision=3)
+    dc.on_update(_deployment("demo", image="app:v2", revision=2), d_new)
+    assert analyst.requests == []
+
+
+def test_canary_deployment_monitored_against_base():
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    dc = DeploymentController(kube, Barrelman(kube, analyst))
+    dc.on_add(_deployment("demo-foremast-canary", app="demo"))
+    assert len(analyst.requests) == 1
+    assert analyst.requests[0]["strategy"] == "canary"
+    assert analyst.requests[0]["appName"] == "demo"
+
+
+def test_on_add_creates_baseline_healthy_monitor():
+    kube = FakeKube()
+    dc = DeploymentController(kube, Barrelman(kube, ScriptedAnalyst()))
+    dc.on_add(_deployment("demo"))
+    m = kube.get_monitor("default", "demo")
+    assert m is not None and m.status.phase == PHASE_HEALTHY
+
+
+# ------------------------------------------------------- monitor controller
+def _rollback_fixture(kube):
+    kube.deployments[("default", "demo")] = _deployment("demo", image="app:v2", revision=2)
+    kube.replicasets[("default", "rs1")] = _replicaset("rs1", "demo", 1, "h1")
+    kube.replicasets[("default", "rs2")] = _replicaset("rs2", "demo", 2, "h2")
+
+
+def test_remediation_rollback_patches_template():
+    kube = FakeKube()
+    _rollback_fixture(kube)
+    mc = MonitorController(kube, Barrelman(kube, ScriptedAnalyst()))
+    monitor = DeploymentMonitor(
+        name="demo", namespace="default",
+        spec=MonitorSpec(
+            remediation=RemediationAction(option="AutoRollback"),
+            rollback_revision=1,
+        ),
+        status=MonitorStatus(phase=PHASE_UNHEALTHY),
+    )
+    kube.upsert_monitor(monitor)
+    mc.on_update(None, monitor)
+    assert monitor.status.remediation_taken
+    kinds = [p[0] for p in kube.patches]
+    assert "deployment" in kinds
+    # template restored to revision-1 RS's template
+    d = kube.get_deployment("default", "demo")
+    assert d["spec"]["template"]["spec"]["containers"][0]["image"] == "app:r1"
+    assert any(e["reason"] == "ForemastRollback" for e in kube.events)
+
+
+def test_rollback_refuses_paused_deployment():
+    kube = FakeKube()
+    _rollback_fixture(kube)
+    kube.deployments[("default", "demo")]["spec"]["paused"] = True
+    mc = MonitorController(kube, Barrelman(kube, ScriptedAnalyst()))
+    monitor = DeploymentMonitor(
+        name="demo", namespace="default",
+        spec=MonitorSpec(rollback_revision=1),
+    )
+    err = mc.rollback(monitor)
+    assert "paused" in err
+    assert kube.patches == []
+
+
+def test_remediation_pause():
+    kube = FakeKube()
+    _rollback_fixture(kube)
+    mc = MonitorController(kube, Barrelman(kube, ScriptedAnalyst()))
+    monitor = DeploymentMonitor(
+        name="demo", namespace="default",
+        spec=MonitorSpec(remediation=RemediationAction(option="AutoPause")),
+        status=MonitorStatus(phase=PHASE_UNHEALTHY),
+    )
+    mc.on_update(None, monitor)
+    assert kube.get_deployment("default", "demo")["spec"]["paused"] is True
+
+
+def test_remediation_only_fires_on_flip():
+    kube = FakeKube()
+    _rollback_fixture(kube)
+    mc = MonitorController(kube, Barrelman(kube, ScriptedAnalyst()))
+    monitor = DeploymentMonitor(
+        name="demo", namespace="default",
+        spec=MonitorSpec(
+            remediation=RemediationAction(option="AutoRollback"),
+            rollback_revision=1,
+        ),
+        status=MonitorStatus(phase=PHASE_UNHEALTHY, remediation_taken=True),
+    )
+    mc.on_update(None, monitor)
+    assert kube.patches == []  # already taken
+
+
+# ----------------------------------------------------------- hpa controller
+def _hpa(name="demo", ns="default", desired=2, current=2, score_metric=True):
+    metrics = []
+    if score_metric:
+        metrics.append(
+            {
+                "type": "Object",
+                "object": {"metric": {"name": "namespace_app_pod_hpa_score"}},
+            }
+        )
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"scaleTargetRef": {"name": name}, "metrics": metrics},
+        "status": {"desiredReplicas": desired, "currentReplicas": current},
+    }
+
+
+def test_hpa_stamps_score_template_and_arms_monitor():
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    hc = HpaController(kube, Barrelman(kube, analyst))
+    kube.upsert_monitor(DeploymentMonitor(name="demo", namespace="default"))
+    hc.on_upsert(None, _hpa())
+    m = kube.get_monitor("default", "demo")
+    assert m.spec.hpa_score_template == DEFAULT_HPA_TEMPLATE
+    assert m.status.hpa_score_enabled
+    assert analyst.requests and analyst.requests[0]["strategy"] == "hpa"
+    # hpa metrics come from the template aliases in priority order
+    cur = analyst.requests[0]["metricsInfo"]["current"]
+    assert cur["cpu"]["priority"] == 0 and cur["latency"]["priority"] == 2
+
+
+def test_hpa_scaling_alert_letter():
+    kube = FakeKube()
+    hc = HpaController(kube, Barrelman(kube, ScriptedAnalyst()))
+    from foremast_tpu.operator.types import HpaLogEntry
+
+    logs = [
+        HpaLogEntry(
+            timestamp=str(1000 + i),
+            hpascore=80,
+            reason="r",
+            details=[{"metricType": "tps", "current": 100, "upper": 90, "lower": 10}],
+        )
+        for i in range(8)
+    ]
+    kube.upsert_monitor(
+        DeploymentMonitor(
+            name="demo", namespace="default",
+            spec=MonitorSpec(hpa_score_template=DEFAULT_HPA_TEMPLATE),
+            status=MonitorStatus(hpa_logs=logs),
+        )
+    )
+    hc.on_upsert(_hpa(desired=2, current=2), _hpa(desired=4, current=2))
+    assert len(hc.alerts) == 1
+    assert "scaled up from 2 to 4" in hc.alerts[0]
+    assert hc.alerts[0].count("out of normal range") == 4  # 4 logs for up
+    hc.on_upsert(_hpa(desired=4, current=4), _hpa(desired=1, current=4))
+    assert "scaled down from 4 to 1" in hc.alerts[1]
+    assert hc.alerts[1].count("out of normal range") == 6  # 6 logs for down
+
+
+def test_hpa_delete_clears_template():
+    kube = FakeKube()
+    hc = HpaController(kube, Barrelman(kube, ScriptedAnalyst()))
+    kube.upsert_monitor(
+        DeploymentMonitor(
+            name="demo", namespace="default",
+            spec=MonitorSpec(hpa_score_template=DEFAULT_HPA_TEMPLATE),
+            status=MonitorStatus(hpa_score_enabled=True),
+        )
+    )
+    hc.on_delete(_hpa())
+    m = kube.get_monitor("default", "demo")
+    assert m.spec.hpa_score_template == "" and not m.status.hpa_score_enabled
+
+
+# ----------------------------------------------------- review-fix regressions
+def test_unreachable_analyst_still_expires_monitor():
+    """AnalystError during polling must not block wait_until expiry."""
+
+    class DeadAnalyst:
+        def start_analyzing(self, request):
+            from foremast_tpu.operator.analyst import AnalystError
+
+            raise AnalystError("down")
+
+        def get_status(self, job_id):
+            from foremast_tpu.operator.analyst import AnalystError
+
+            raise AnalystError("down")
+
+    kube = FakeKube()
+    b = Barrelman(kube, DeadAnalyst())
+    now = time.time()
+    from foremast_tpu.utils.timeutils import to_rfc3339
+
+    kube.upsert_monitor(
+        DeploymentMonitor(
+            name="demo", namespace="default",
+            spec=MonitorSpec(wait_until=to_rfc3339(now - 5)),
+            status=MonitorStatus(phase=PHASE_RUNNING, job_id="gone"),
+        )
+    )
+    b.check_running_status(now)
+    m = kube.get_monitor("default", "demo")
+    assert m.status.phase == PHASE_HEALTHY and m.status.expired
+
+
+def test_inprocess_analyst_maps_apierror():
+    from foremast_tpu.engine.jobs import JobStore as _JS
+    from foremast_tpu.operator.analyst import AnalystError
+
+    svc = ForemastService(_JS())
+    analyst = InProcessAnalyst(svc)
+    with pytest.raises(AnalystError):
+        analyst.start_analyzing({"appName": "bad name!", "strategy": "canary"})
+
+
+def test_bad_metadata_does_not_wedge_reconcile_loop():
+    """A metric alias the service rejects must not crash every tick; the
+    snapshot advances and an event records the failure."""
+    kube = FakeKube()
+    md = _metadata()
+    md.metrics.monitoring[0].metric_alias = "bad alias!"  # fails _METRIC_RE
+    kube.upsert_metadata(md)
+    store = JobStore()
+    loop = OperatorLoop(kube, InProcessAnalyst(ForemastService(store)))
+    kube.deployments[("default", "demo")] = _deployment("demo", revision=1)
+    loop.tick()
+    kube.deployments[("default", "demo")] = _deployment("demo", image="app:v2", revision=2)
+    loop.tick()  # must not raise
+    assert any(e["reason"] in ("ReconcileError", "AnalystUnavailable") for e in kube.events)
+    loop.tick()  # snapshot advanced; no repeat crash storm
+    assert kube.get_monitor("default", "demo") is not None
+
+
+def test_unmonitoring_namespace_does_not_delete_metadata():
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    store = JobStore()
+    loop = OperatorLoop(kube, InProcessAnalyst(ForemastService(store)))
+    kube.deployments[("default", "demo")] = _deployment("demo")
+    loop.tick()
+    assert kube.get_metadata("default", "demo") is not None
+    # pause monitoring for the namespace — deployments drop out of scope
+    kube.namespaces["default"] = {"annotations": {"foremast.ai/monitoring": "false"}}
+    loop.tick()
+    assert kube.get_metadata("default", "demo") is not None  # NOT deleted
+    # truly delete the deployment (with monitoring back on)
+    kube.namespaces["default"] = {}
+    loop.tick()
+    del kube.deployments[("default", "demo")]
+    loop.tick()
+    assert kube.get_metadata("default", "demo") is None
+
+
+def test_isolate_retries_per_job_preserving_hpa_grouping():
+    from foremast_tpu.engine.analyzer import Analyzer as _A
+
+    a = _A(EngineConfig(), FixtureDataSource({}), JobStore())
+
+    class It:
+        def __init__(self, job_id, metric):
+            self.job_id, self.metric = job_id, metric
+
+    seen_groups = []
+
+    def scorer(items):
+        if len(items) > 2:
+            raise ValueError("batch poisoned")
+        if any(it.job_id == "bad" for it in items):
+            raise ValueError("boom")
+        seen_groups.append([it.metric for it in items])
+        return {items[0].job_id: {"metrics": [it.metric for it in items]}}
+
+    items = [It("j1", "tps"), It("j1", "latency"), It("bad", "x")]
+    res, bad = a._isolate(scorer, items)
+    # j1's two metrics were scored TOGETHER (tps/sla roles preserved)
+    assert seen_groups == [["tps", "latency"]]
+    assert res["j1"]["metrics"] == ["tps", "latency"]
+    assert set(bad) == {"bad"}
+
+
+# ------------------------------------------------- flagship e2e (real engine)
+def test_flagship_rollout_unhealthy_rollback_e2e():
+    """The installation-guide acceptance path with the REAL scoring engine:
+    healthy v1 -> bad v2 rollout -> canary analysis flags anomaly ->
+    monitor Unhealthy -> auto-rollback patches the deployment back."""
+    rng = np.random.default_rng(5)
+    now = time.time()
+
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata(endpoint="http://prom/api/v1/"))
+    store = JobStore()
+    exporter = VerdictExporter()
+
+    def resolver(url):
+        # old pods (baseline) healthy, new pods (current) error storm;
+        # 7-day app-level history healthy
+        n_hist = 1440
+        if "pod=~" in url and "p-new" in url:
+            return (
+                [now - 600 + 60 * i for i in range(10)],
+                list(rng.poisson(300, 10).astype(float)),
+            )
+        if "pod=~" in url:
+            return (
+                [now - 1200 + 60 * i for i in range(10)],
+                list(rng.poisson(30, 10).astype(float)),
+            )
+        return (
+            [now - 86400 + 60 * i for i in range(n_hist)],
+            list(rng.poisson(30, n_hist).astype(float)),
+        )
+
+    source = FixtureDataSource(resolver=resolver)
+    engine = Analyzer(EngineConfig(), source, store, exporter=exporter)
+    service = ForemastService(store, exporter=exporter)
+    analyst = InProcessAnalyst(service)
+    loop = OperatorLoop(kube, analyst)
+
+    # v1 world
+    kube.deployments[("default", "demo")] = _deployment("demo", image="app:v1", revision=1)
+    kube.replicasets[("default", "rs1")] = _replicaset("rs1", "demo", 1, "h1")
+    kube.pods[("default", "p-old")] = _pod("p-old", "demo", "h1")
+    loop.tick(now)
+    assert kube.get_monitor("default", "demo").status.phase == PHASE_HEALTHY
+
+    # roll v2 (error generator)
+    kube.deployments[("default", "demo")] = _deployment("demo", image="app:v2", revision=2)
+    kube.replicasets[("default", "rs2")] = _replicaset("rs2", "demo", 2, "h2")
+    kube.pods[("default", "p-new")] = _pod("p-new", "demo", "h2")
+    m = kube.get_monitor("default", "demo")
+    m.spec.remediation = RemediationAction(option="AutoRollback")
+    kube.upsert_monitor(m)
+
+    loop.tick(now)  # sees the diff, starts analysis
+    m = kube.get_monitor("default", "demo")
+    assert m.status.phase == PHASE_RUNNING
+    assert m.spec.rollback_revision == 1
+
+    engine.run_cycle(now=now)  # the TPU scoring pass
+    loop.tick(now)  # polls status -> Unhealthy -> remediation
+    m = kube.get_monitor("default", "demo")
+    assert m.status.phase == PHASE_UNHEALTHY
+    assert m.status.anomaly.anomalous_metrics  # anomaly payload flowed back
+    assert m.status.remediation_taken
+    d = kube.get_deployment("default", "demo")
+    assert d["spec"]["template"]["spec"]["containers"][0]["image"] == "app:r1"
+    assert any(e["reason"] == "ForemastRollback" for e in kube.events)
