@@ -16,21 +16,11 @@ the same registry via `samples()`.
 """
 from __future__ import annotations
 
-import re
 import threading
 import time
 
-_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
-
-
-def _esc(v: str) -> str:
-    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
-
-
-def _sanitize_name(name: str) -> str:
-    """Metric names come from user requests; anything outside the Prometheus
-    name charset would corrupt (or inject into) the whole exposition."""
-    return _NAME_OK.sub("_", name)
+from ..utils.promtext import escape_label_value as _esc
+from ..utils.promtext import sanitize_metric_name as _sanitize_name
 
 
 class VerdictExporter:

@@ -1,0 +1,85 @@
+"""Demo app: instrumented WSGI service with configurable fault injection.
+
+The reference's acceptance tests hinge on a demo Spring Boot app whose
+ErrorGenerator/LoadGenerator self-inflict 4xx/5xx/load at a configurable
+rate (examples/spring-boot-demo/src/main/java/ai/foremast/metrics/demo/
+K8sMetricsDemoApp.java:19-41 and ErrorGenerator.java:19-28) — v1 deploys
+clean, v2 deploys with errors, and the pipeline must notice. This is that
+chaos tool for the TPU framework: a WSGI app + generators driving synthetic
+traffic through the instrumentation middleware, so the whole analysis path
+can be exercised hermetically.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..instrumentation import MetricsMiddleware, MetricsRegistry
+
+
+def demo_app(environ, start_response):
+    """Routes: / -> 200; /error4xx -> 400; /error5xx -> 502; /slow -> 200."""
+    path = environ.get("PATH_INFO", "/")
+    if path == "/error4xx":
+        start_response("400 Bad Request", [("Content-Length", "3")])
+        return [b"4xx"]
+    if path == "/error5xx":
+        start_response("502 Bad Gateway", [("Content-Length", "3")])
+        return [b"5xx"]
+    if path == "/slow":
+        time.sleep(0.05)
+    start_response("200 OK", [("Content-Length", "2")])
+    return [b"ok"]
+
+
+class Generator:
+    """Drives synthetic requests through a WSGI app at a fixed rate."""
+
+    def __init__(self, app, path: str, per_second: float, caller: str = "loadgen"):
+        self.app = app
+        self.path = path
+        self.per_second = per_second
+        self.caller = caller
+        self._stop = threading.Event()
+        self._thread = None
+
+    def hit(self, n: int = 1):
+        for _ in range(n):
+            environ = {
+                "PATH_INFO": self.path,
+                "REQUEST_METHOD": "GET",
+                "HTTP_X_CALLER": self.caller,
+            }
+            consumed = self.app(environ, lambda s, h, e=None: None)
+            # WSGI apps may return generators; drain them
+            for _chunk in consumed or []:
+                pass
+
+    def start(self):
+        def loop():
+            while not self._stop.is_set():
+                self.hit()
+                self._stop.wait(1.0 / max(self.per_second, 1e-6))
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+
+def build_demo(app_name: str = "demo", error5xx_per_second: float = 0.0,
+               error4xx_per_second: float = 0.0, load_per_second: float = 0.0):
+    """(wrapped_app, registry, generators) — v1 is error rate 0; a 'bad v2'
+    is the same app with error5xx_per_second > 0."""
+    registry = MetricsRegistry(common_tags={"app": app_name})
+    app = MetricsMiddleware(demo_app, registry=registry, app_name=app_name)
+    gens = []
+    if error5xx_per_second > 0:
+        gens.append(Generator(app, "/error5xx", error5xx_per_second, "errorgen"))
+    if error4xx_per_second > 0:
+        gens.append(Generator(app, "/error4xx", error4xx_per_second, "errorgen"))
+    if load_per_second > 0:
+        gens.append(Generator(app, "/", load_per_second))
+    return app, registry, gens

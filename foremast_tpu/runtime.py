@@ -15,6 +15,8 @@ Env surface (union of the reference services'):
   SNAPSHOT_PATH          job-store checkpoint file (ES's durability role)
   PORT                   HTTP port (reference :8099)
   CYCLE_SECONDS          engine cycle cadence (brain poll loop)
+  WAVEFRONT_PROXY        host[:port] of a Wavefront proxy to mirror the
+                         verdict series to (custom.iks.foremast.*)
 """
 from __future__ import annotations
 
@@ -40,6 +42,7 @@ class Runtime:
         snapshot_path: str | None = None,
         query_endpoint: str = "",
         cache: bool = True,
+        wavefront_sink=None,
     ):
         self.config = config or from_env()
         source = data_source or PrometheusDataSource()
@@ -54,6 +57,7 @@ class Runtime:
         self.service = ForemastService(
             self.store, exporter=self.exporter, query_endpoint=query_endpoint
         )
+        self.wavefront_sink = wavefront_sink
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._server = None
@@ -77,6 +81,8 @@ class Runtime:
             t0 = time.time()
             try:
                 self.analyzer.run_cycle(worker=worker)
+                if self.wavefront_sink is not None:
+                    self.wavefront_sink.flush()
             except Exception as e:  # noqa: BLE001 - worker must survive a bad cycle
                 print(f"[foremast-tpu] cycle error: {e}", flush=True)
             self._stop.wait(max(0.0, cycle_seconds - (time.time() - t0)))
@@ -101,6 +107,14 @@ def main():
         snapshot_path=os.environ.get("SNAPSHOT_PATH") or None,
         query_endpoint=os.environ.get("QUERY_SERVICE_ENDPOINT", ""),
     )
+    proxy = os.environ.get("WAVEFRONT_PROXY", "")
+    if proxy:
+        from .dataplane.wavefront_sink import WavefrontSink
+
+        host, _, port = proxy.partition(":")
+        rt.wavefront_sink = WavefrontSink(
+            rt.exporter, host=host, port=int(port or 2878)
+        )
     port = int(os.environ.get("PORT", "8099"))
     cycle = float(os.environ.get("CYCLE_SECONDS", "10"))
     print(f"[foremast-tpu] serving :{port}, cycle={cycle}s", flush=True)

@@ -1,0 +1,147 @@
+"""Instrumentation tests — CommonMetricsFilter semantics mirror the
+reference's CommonMetricsFilterTest (SURVEY.md §4)."""
+import io
+
+from foremast_tpu.examples.demo_app import Generator, build_demo, demo_app
+from foremast_tpu.instrumentation import (
+    CommonMetricsFilter,
+    MetricsMiddleware,
+    MetricsRegistry,
+)
+
+
+# ------------------------------------------------------------------ filter
+def test_filter_disabled_accepts_everything():
+    f = CommonMetricsFilter(enabled=False, blacklist="jvm.threads")
+    assert f.accepts("jvm.threads")
+    assert f.accepts("anything.else")
+
+
+def test_filter_whitelist_blacklist_prefix_tagrules():
+    f = CommonMetricsFilter(
+        enabled=True,
+        whitelist="http_server_requests",
+        blacklist="jvm.gc.pause",
+        prefixes="tomcat",
+        tag_rules="caller:loadgen",
+    )
+    assert f.accepts("http.server.requests")  # whitelist, _ -> . normalized
+    assert not f.accepts("jvm.gc.pause")  # blacklist
+    assert f.accepts("tomcat.threads.busy")  # prefix
+    assert f.accepts("random.metric", {"caller": "loadgen"})  # tag rule
+    assert not f.accepts("random.metric", {"caller": "other"})
+    assert not f.accepts("random.metric")  # default closed
+
+
+def test_filter_runtime_enable_disable():
+    f = CommonMetricsFilter(enabled=True, blacklist="a.b")
+    assert not f.accepts("a.b")
+    f.enable_metric("a_b")  # normalization applies
+    assert f.accepts("a.b")
+    f.disable_metric("a.b")
+    assert not f.accepts("a.b")
+
+
+def test_filter_invalid_tag_rule_raises():
+    import pytest
+
+    with pytest.raises(ValueError):
+        CommonMetricsFilter(enabled=True, tag_rules="noseparator")
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_counters_and_timers_render():
+    r = MetricsRegistry(common_tags={"app": "demo"})
+    r.counter("requests.total.count", {"status": "200"}, 3)
+    r.timer("http_server_requests", {"status": "200"}, 0.25)
+    r.timer("http_server_requests", {"status": "200"}, 0.75)
+    out = r.render()
+    assert 'requests_total_count_total{app="demo",status="200"} 3.0' in out
+    assert 'http_server_requests_seconds_count{app="demo",status="200"} 2' in out
+    assert 'http_server_requests_seconds_sum{app="demo",status="200"} 1.0' in out
+    assert 'http_server_requests_seconds_max{app="demo",status="200"} 0.75' in out
+
+
+def test_registry_respects_filter():
+    f = CommonMetricsFilter(enabled=True, whitelist="kept")
+    r = MetricsRegistry(metrics_filter=f)
+    r.counter("kept")
+    r.counter("dropped")
+    out = r.render()
+    assert "kept_total" in out and "dropped" not in out
+
+
+# -------------------------------------------------------------- middleware
+def _call(app, path, method="GET", headers=None):
+    environ = {"PATH_INFO": path, "REQUEST_METHOD": method, **(headers or {})}
+    captured = {}
+
+    def sr(status, hdrs, exc_info=None):
+        captured["status"] = status
+        captured["headers"] = hdrs
+
+    body = b"".join(app(environ, sr))
+    return captured.get("status", ""), body
+
+
+def test_middleware_times_requests_with_tags():
+    app = MetricsMiddleware(demo_app, app_name="demo")
+    _call(app, "/", headers={"HTTP_X_CALLER": "svc-b"})
+    _call(app, "/error5xx")
+    status, body = _call(app, "/actuator/prometheus")
+    text = body.decode()
+    assert status.startswith("200")
+    assert 'status="200"' in text and 'caller="svc-b"' in text
+    assert 'status="502"' in text and 'uri="/error5xx"' in text
+    assert 'app="demo"' in text
+
+
+def test_middleware_preregisters_error_statuses():
+    app = MetricsMiddleware(demo_app, app_name="demo")
+    _, body = _call(app, "/actuator/prometheus")
+    text = body.decode()
+    for code in ("403", "404", "501", "502"):
+        assert f'status="{code}"' in text  # series exist at zero from boot
+    assert 'uri="/**"' in text
+
+
+def test_middleware_toggle_endpoints():
+    f = CommonMetricsFilter(enabled=True, whitelist="http_server_requests")
+    reg = MetricsRegistry(metrics_filter=f)
+    app = MetricsMiddleware(demo_app, registry=reg, init_statuses=())
+    status, body = _call(app, "/k8s-metrics/disable/http_server_requests")
+    assert status.startswith("200") and b"disabled" in body
+    _call(app, "/")
+    _, body = _call(app, "/actuator/prometheus")
+    assert b"http_server_requests_seconds_count" not in body
+    _call(app, "/k8s-metrics/enable/http_server_requests")
+    _call(app, "/")
+    _, body = _call(app, "/actuator/prometheus")
+    assert b"http_server_requests_seconds_count" in body
+
+
+def test_middleware_exception_records_500():
+    def boom(environ, start_response):
+        raise RuntimeError("kaput")
+
+    app = MetricsMiddleware(boom, app_name="demo", init_statuses=())
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        _call(app, "/explode")
+    text = app.registry.render()
+    assert 'status="500"' in text and 'exception="RuntimeError"' in text
+
+
+# ---------------------------------------------------------------- demo app
+def test_demo_generators_produce_error_series():
+    app, registry, _ = build_demo("demo-v2")
+    gen = Generator(app, "/error5xx", per_second=100, caller="errorgen")
+    gen.hit(25)
+    text = registry.render()
+    assert 'status="502"' in text
+    line = next(
+        l for l in text.splitlines()
+        if "seconds_count" in l and 'status="502"' in l and 'caller="errorgen"' in l
+    )
+    assert float(line.rsplit(" ", 1)[1]) == 25

@@ -1,0 +1,206 @@
+"""Trigger + wavefront-mirror tests (SURVEY.md §2.3, §3.5)."""
+import time
+
+import pytest
+
+from foremast_tpu.dataplane.exporter import VerdictExporter
+from foremast_tpu.dataplane.wavefront_sink import WavefrontSink
+from foremast_tpu.operator.analyst import AnalystError, StatusResponse
+from foremast_tpu.trigger import TriggerService, parse_requests_lines
+
+
+def test_parse_requests_lines():
+    lines = [
+        "svc-a;error4xx;ts(err4);latency;ts(lat)",
+        "# comment",
+        "",
+        "svc-b;tps;ts(tps)",
+    ]
+    parsed = parse_requests_lines(lines)
+    assert parsed == [
+        ("svc-a", {"error4xx": "ts(err4)", "latency": "ts(lat)"}),
+        ("svc-b", {"tps": "ts(tps)"}),
+    ]
+
+
+class ScriptedAnalyst:
+    def __init__(self):
+        self.requests = []
+        self.phases = {}  # job_id -> phase
+        self.n = 0
+        self.fail_next = 0
+
+    def start_analyzing(self, request):
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise AnalystError("down")
+        self.requests.append(request)
+        self.n += 1
+        return f"job-{self.n}"
+
+    def get_status(self, job_id):
+        return StatusResponse(
+            phase=self.phases.get(job_id, "Running"),
+            reason=self.phases.get(job_id + ":reason", ""),
+        )
+
+
+def test_rollover_request_shape():
+    a = ScriptedAnalyst()
+    t = TriggerService(analyst=a, wavefront_endpoint="http://wf")
+    now = 1_700_000_000.0
+    assert t.submit("svc", {"latency": "ts(lat)"}, now)
+    req = a.requests[0]
+    assert req["strategy"] == "rollover"
+    cur = req["metricsInfo"]["current"]["latency"]["parameters"]
+    hist = req["metricsInfo"]["historical"]["latency"]["parameters"]
+    assert cur["start"] == (int(now) - 300) * 1000  # ms, 5 min back
+    assert cur["end"] - cur["start"] == 30 * 60 * 1000  # 30-min window
+    assert hist["start"] == (int(now) - 300 - 7 * 86400) * 1000  # 7 days
+    assert req["metricsInfo"]["baseline"]["latency"]["parameters"] == hist
+
+
+def test_poll_resubmits_and_records_anomalies(tmp_path):
+    a = ScriptedAnalyst()
+    t = TriggerService(analyst=a, wavefront_endpoint="http://wf",
+                       volume_path=str(tmp_path))
+    now = 1_700_000_000.0
+    t.start([("svc", {"latency": "ts(lat)"})], now)
+    a.phases["job-1"] = "Unhealthy"
+    a.phases["job-1:reason"] = (
+        "anomaly detected on latency :: latency: 9 points outside "
+        "[1,2] from ts 1700000100"
+    )
+    resolved = t.poll_once(now + 60)
+    assert resolved == {"svc": "Unhealthy"}
+    assert t.jobs["svc"].job_id == "job-2"  # resubmitted
+    assert len(t.anomalies) == 1
+    rec = t.anomalies[0]
+    assert rec["app"] == "svc" and rec["job_id"] == "job-1"
+    assert rec["metric"] == "latency"
+    assert "custom.iks.foremast.latency" in rec["row"]  # dashboard deep link
+    assert "t=1699999200" in rec["row"]  # anomaly ts - 15 min
+    files = list(tmp_path.iterdir())
+    assert len(files) == 1 and files[0].name.startswith("anomaly_")
+
+    # Healthy and Warning also resubmit, without anomaly records
+    a.phases["job-2"] = "Healthy"
+    t.poll_once(now + 120)
+    assert t.jobs["svc"].job_id == "job-3"
+    a.phases["job-3"] = "Warning"
+    t.poll_once(now + 180)
+    assert t.jobs["svc"].job_id == "job-4"
+    assert len(t.anomalies) == 1
+
+
+def test_dashboard_url_fallback_without_metric():
+    t = TriggerService(analyst=ScriptedAnalyst(), wavefront_endpoint="http://wf")
+    assert t.dashboard_url("svc", {}, "something opaque") == "http://wf/dashboard/Foremast"
+
+
+def test_summary_report(tmp_path):
+    counts = {"custom.iks.foremast.latency_anomaly": 7}
+    t = TriggerService(
+        analyst=ScriptedAnalyst(), volume_path=str(tmp_path),
+        anomaly_counter=lambda metric, s, e: counts.get(metric, 0),
+    )
+    report = t.summary_report([("svc", {"latency": "ts(lat)"})], now=1_700_000_000.0)
+    assert "svc\tlatency\t7" in report
+    assert any(f.name.startswith("report_") for f in tmp_path.iterdir())
+
+
+def test_submit_failure_keeps_old_job():
+    a = ScriptedAnalyst()
+    t = TriggerService(analyst=a)
+    t.start([("svc", {"m": "q"})])
+    a.phases["job-1"] = "Healthy"
+    a.fail_next = 1
+    t.poll_once()
+    assert t.jobs["svc"].job_id == "job-1"  # resubmit failed; retry next poll
+
+
+def test_report_names_track_exporter_sanitization():
+    """Dotted metric names must query the series the sink actually emits
+    (exporter sanitizes '.' -> '_'), and the fallback count is windowed +
+    exact-matched."""
+    from foremast_tpu.dataplane.wavefront_sink import mirror_name
+
+    assert mirror_name("error.rate", "anomaly") == "custom.iks.foremast.error_rate_anomaly"
+
+    queried = []
+    t = TriggerService(
+        analyst=ScriptedAnalyst(), volume_path="/tmp/x",
+        anomaly_counter=lambda m, s, e: queried.append(m) or 0,
+    )
+    t.summary_report([("svc", {"error.rate": "q"})], now=1e9)
+    assert queried == ["custom.iks.foremast.error_rate_anomaly"]
+
+    # fallback: windowed, exact metric match (no substring over-count)
+    t2 = TriggerService(analyst=ScriptedAnalyst(), volume_path="/tmp/x")
+    now = 1_700_000_000.0
+    t2.anomalies = [
+        {"ts": now - 100, "app": "svc", "metric": "error5xx", "reason": "", "row": "", "job_id": ""},
+        {"ts": now - 100, "app": "svc", "metric": "error", "reason": "", "row": "", "job_id": ""},
+        {"ts": now - 2 * 86400, "app": "svc", "metric": "error", "reason": "", "row": "", "job_id": ""},
+    ]
+    report = t2.summary_report([("svc", {"error": "q", "error5xx": "q2"})], now=now)
+    assert "svc\terror\t1" in report  # old row excluded; error5xx not counted as error
+    assert "svc\terror5xx\t1" in report
+
+
+def test_uri_tag_cardinality_bounded():
+    from foremast_tpu.instrumentation import MetricsMiddleware
+    from foremast_tpu.examples.demo_app import demo_app
+
+    app = MetricsMiddleware(demo_app, app_name="demo", init_statuses=(), max_uris=3)
+    for i in range(10):
+        environ = {"PATH_INFO": f"/scan/{i}", "REQUEST_METHOD": "GET"}
+        list(app(environ, lambda s, h, e=None: None))
+    text = app.registry.render()
+    assert text.count("seconds_count") == 4  # 3 distinct + the /** bucket
+    assert 'uri="/**"' in text
+
+    templated = MetricsMiddleware(
+        demo_app, app_name="demo", init_statuses=(), uri_templates=["/ok"]
+    )
+    for p in ("/ok", "/random1", "/random2"):
+        list(templated(
+            {"PATH_INFO": p, "REQUEST_METHOD": "GET"}, lambda s, h, e=None: None
+        ))
+    text = templated.registry.render()
+    assert 'uri="/ok"' in text and 'uri="/random1"' not in text
+
+
+def test_label_escaping_in_renders():
+    from foremast_tpu.instrumentation import MetricsRegistry
+    from foremast_tpu.dataplane.wavefront_sink import WavefrontSink
+
+    r = MetricsRegistry()
+    r.counter("hits", {"uri": '/x"y\\z'})
+    out = r.render()
+    assert 'uri="/x\\"y\\\\z"' in out
+
+    exp = VerdictExporter()
+    exp.record_bounds('bad"app', "ns", "m", 1, 0, 0)
+    sent = []
+    WavefrontSink(exp, sender=sent.append).flush(now=1e9)
+    assert all('app="bad\\"app"' in l for l in sent[0])
+
+
+def test_wavefront_sink_renames_and_sends():
+    exp = VerdictExporter()
+    exp.record_bounds("demo", "default", "error5xx", 40.0, 10.0, 1.0)
+    exp.record_hpa_score("demo", "default", 72.0)
+    sent = []
+    sink = WavefrontSink(exp, sender=sent.append)
+    n = sink.flush(now=1_700_000_000.0)
+    assert n == 4
+    lines = sent[0]
+    names = {l.split(" ")[0] for l in lines}
+    assert names == {
+        "custom.iks.foremast.error5xx_upper",
+        "custom.iks.foremast.error5xx_lower",
+        "custom.iks.foremast.error5xx_anomaly",
+        "custom.iks.foremast.namespace_app_per_pod.hpa_score",
+    }
+    assert all('app="demo"' in l and "1700000000" in l for l in lines)
