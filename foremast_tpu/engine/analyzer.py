@@ -31,6 +31,8 @@ from ..dataplane.promql import (
     STRATEGY_HPA,
     materialize_placeholders,
 )
+from ..models import lstm_ae
+from ..ops import bivariate as bv
 from ..ops import forecast as fc
 from ..ops import hpa as hpa_ops
 from ..ops.windowing import (
@@ -66,6 +68,28 @@ class _BandItem:
 
 
 @dataclass
+class _BiItem:
+    """Two-metric joint job (ML_ALGORITHM=bivariate_normal; design.md:53-88)."""
+
+    job_id: str
+    metrics: tuple  # (name1, name2)
+    hist: tuple  # (Window, Window)
+    cur: tuple  # (Window, Window)
+    policies: tuple  # (MetricPolicy, MetricPolicy)
+
+
+@dataclass
+class _MultiItem:
+    """3+-metric LSTM-autoencoder job (faq.md:8-10)."""
+
+    job_id: str
+    cache_key: str  # app/namespace identity for the model cache
+    metrics: list
+    hist: list  # [Window]
+    cur: list  # [Window]
+
+
+@dataclass
 class _HpaItem:
     job_id: str
     metric: str
@@ -87,6 +111,30 @@ def _concat_trimmed(hist: Window, cur: Window):
     return vals, mask, h_vals.shape[0]
 
 
+def _joint_grid(hists: list, curs: list):
+    """Stack a job's metrics onto one shared concat grid.
+
+    Metrics of one job are fetched with identical start/end/step parameters,
+    so their grids line up; residual off-by-a-few length skew (scrape lag)
+    is resolved by trimming every series to the common length. Current
+    windows are HEAD-trimmed so concat index n_h + j maps to each current
+    window's own index j — the invariant the anomaly-timestamp math
+    (cur.start + (idx - n_h) * step) depends on. History keeps its tail
+    (most recent points). Returns (values (F, T), masks (F, T), n_h, n_c).
+    """
+    n_c = min(c.values.shape[0] for c in curs)
+    n_c = min(n_c, MAX_WINDOW_STEPS)
+    n_h = min(h.values.shape[0] for h in hists)
+    n_h = min(n_h, MAX_WINDOW_STEPS - n_c)
+    vals, masks = [], []
+    for h, c in zip(hists, curs):
+        hv = h.values[-n_h:] if n_h else h.values[:0]
+        hm = h.mask[-n_h:] if n_h else h.mask[:0]
+        vals.append(np.concatenate([hv, c.values[:n_c]]))
+        masks.append(np.concatenate([hm, c.mask[:n_c]]))
+    return np.stack(vals), np.stack(masks), n_h, n_c
+
+
 @dataclass
 class _JobState:
     doc: J.Document
@@ -104,6 +152,11 @@ class Analyzer:
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.breath = breath or hpa_ops.BreathState()
+        # LSTM-AE model cache (MAX_CACHE_SIZE semantics,
+        # foremast-brain/README.md:30): key -> (params, err_mu, err_sigma);
+        # insertion-ordered dict doubles as the LRU eviction queue.
+        self._lstm_cache: dict = {}
+        self._lstm_models: dict = {}  # (F, hidden, latent) -> module instance
 
     # ------------------------------------------------------------------ fetch
     def _fetch_window(self, url: str, now: float) -> Window | None:
@@ -121,8 +174,13 @@ class Analyzer:
         return resample_to_grid(ts, vals, start, end, 60)
 
     def _preprocess(self, doc: J.Document, now: float):
-        """Fetch all windows for a job; returns (pair, band, hpa) item lists."""
-        pairs, bands, hpas = [], [], []
+        """Fetch all windows for a job; returns (pair, band, bi, multi, hpa)
+        item lists. Band candidates route by the configured model family and
+        metric count (design.md:53-88): bivariate_normal pairs 2-metric jobs,
+        lstm_autoencoder pools 3+-metric jobs; everything else (and any job
+        not matching its family's metric count) scores univariate bands."""
+        pairs, bands, bis, multis, hpas = [], [], [], [], []
+        candidates = []  # (name, hist, cur, policy) judgeable by history
         for name, mq in doc.metrics.items():
             policy = self.config.policy_for(name)
             cur = self._fetch_window(mq.current, now)
@@ -141,8 +199,25 @@ class Analyzer:
             if base is not None and base.n_valid > 0:
                 pairs.append(_PairItem(doc.id, name, base, cur, policy))
             if hist is not None and hist.n_valid >= self.config.min_historical_points:
+                candidates.append((name, hist, cur, policy))
+        algo = self.config.algorithm
+        if algo.startswith("bivariate") and len(candidates) == 2:
+            (n1, h1, c1, p1), (n2, h2, c2, p2) = candidates
+            bis.append(_BiItem(doc.id, (n1, n2), (h1, h2), (c1, c2), (p1, p2)))
+        elif algo.startswith("lstm") and len(candidates) >= 3:
+            multis.append(
+                _MultiItem(
+                    doc.id,
+                    f"{doc.app_name}/{doc.namespace}",
+                    [c[0] for c in candidates],
+                    [c[1] for c in candidates],
+                    [c[2] for c in candidates],
+                )
+            )
+        else:
+            for name, hist, cur, policy in candidates:
                 bands.append(_BandItem(doc.id, name, hist, cur, policy))
-        return pairs, bands, hpas
+        return pairs, bands, bis, multis, hpas
 
     # ------------------------------------------------------------- scoring
     def _isolate(self, score_fn, items):
@@ -233,6 +308,11 @@ class Analyzer:
             fitm = hist_mask.copy()
             fitm[:, : 2 * period] = False
             _, preds = fc.fit_holt_winters(xv, hist_mask, fitm, period)
+        elif algo.startswith("seasonal_trend") or algo.startswith("prophet"):
+            period = min(self.config.hw_period, max(xv.shape[1] // 2, 2))
+            _, preds = fc.fit_seasonal_trend(
+                xv, hist_mask, hist_mask, period, self.config.st_order
+            )
         else:  # moving_average_all default
             preds = fc.moving_average_predictions(xv, hist_mask, self.config.ma_window)
         return np.asarray(preds), hist_mask
@@ -301,6 +381,174 @@ class Analyzer:
                     "lower": float(np.mean(lowers[i][region_sel])),
                     "anomaly_pairs": anomaly_pairs,
                 }
+        return results
+
+    def _score_bivariate(self, items: list[_BiItem]):
+        """Joint 2-metric scoring: one bivariate-normal program per bucket."""
+        results = {}
+        by_bucket: dict[int, list] = {}
+        prepped = {}
+        for it in items:
+            x, m, n_h, n_c = _joint_grid(list(it.hist), list(it.cur))
+            T = bucket_length(x.shape[1])
+            prepped[id(it)] = (x, m, n_h, n_c)
+            by_bucket.setdefault(T, []).append(it)
+        for T, group in by_bucket.items():
+            B = len(group)
+            x1 = np.zeros((B, T), np.float32)
+            x2 = np.zeros((B, T), np.float32)
+            m1 = np.zeros((B, T), bool)
+            m2 = np.zeros((B, T), bool)
+            region = np.zeros((B, T), bool)
+            thr = np.empty(B, np.float32)
+            mlb1 = np.empty(B, np.float32)
+            mlb2 = np.empty(B, np.float32)
+            bm1 = np.empty(B, np.int32)
+            bm2 = np.empty(B, np.int32)
+            for i, it in enumerate(group):
+                x, m, n_h, n_c = prepped[id(it)]
+                n = x.shape[1]
+                x1[i, :n], x2[i, :n] = x[0], x[1]
+                m1[i, :n], m2[i, :n] = m[0], m[1]
+                region[i, n_h:n] = True
+                # the pair shares one ellipse: use the stricter (smaller)
+                # radius of the two metric policies
+                thr[i] = min(it.policies[0].threshold, it.policies[1].threshold)
+                mlb1[i] = it.policies[0].min_lower_bound
+                mlb2[i] = it.policies[1].min_lower_bound
+                bm1[i] = it.policies[0].bound
+                bm2[i] = it.policies[1].bound
+            out = bv.bivariate_normal_anomalies(
+                x1, m1, x2, m2, region, thr, mlb1, mlb2, bm1, bm2
+            )
+            counts = np.asarray(out["count"])
+            firsts = np.asarray(out["first_index"])
+            checked = np.asarray(out["checked"])
+            flags = np.asarray(out["flags"])
+            upper1 = np.asarray(out["upper1"])
+            lower1 = np.asarray(out["lower1"])
+            upper2 = np.asarray(out["upper2"])
+            lower2 = np.asarray(out["lower2"])
+            for i, it in enumerate(group):
+                x, m, n_h, n_c = prepped[id(it)]
+                cur0 = it.cur[0]
+                gate = max(
+                    self.config.band_min_points,
+                    self.config.band_violation_fraction * float(checked[i]),
+                )
+                first = int(firsts[i])
+                anomalous_idx = np.nonzero(flags[i])[0]
+                anomaly_pairs = []
+                for j in anomalous_idx[:50]:
+                    ts = cur0.start + (int(j) - n_h) * cur0.step
+                    anomaly_pairs += [float(ts), float(x[0, int(j)])]
+                sel = region[i]
+                results[(it.job_id, "&".join(it.metrics), "bivariate")] = {
+                    "count": int(counts[i]),
+                    "unhealthy": int(counts[i]) >= gate,
+                    "first_ts": (
+                        float(cur0.start + (first - n_h) * cur0.step)
+                        if first >= 0
+                        else -1.0
+                    ),
+                    "anomaly_pairs": anomaly_pairs,
+                    "bounds": {
+                        it.metrics[0]: (
+                            float(np.mean(upper1[i][sel])),
+                            float(np.mean(lower1[i][sel])),
+                        ),
+                        it.metrics[1]: (
+                            float(np.mean(upper2[i][sel])),
+                            float(np.mean(lower2[i][sel])),
+                        ),
+                    },
+                }
+        return results
+
+    def _lstm_model(self, F: int):
+        key = (F, self.config.lstm_hidden, self.config.lstm_latent)
+        if key not in self._lstm_models:
+            self._lstm_models[key] = lstm_ae.LstmAutoencoder(
+                hidden=self.config.lstm_hidden,
+                latent=self.config.lstm_latent,
+                features=F,
+            )
+        return self._lstm_models[key]
+
+    def _score_multi(self, items: list[_MultiItem]):
+        """LSTM-autoencoder scoring for 3+-metric jobs (faq.md:8-10).
+
+        Per job: standardize each metric on its history, train the AE on
+        non-overlapping historical subwindows (cached per app, LRU-bounded by
+        MAX_CACHE_SIZE), then z-score the current window's reconstruction
+        error against the healthy-error distribution."""
+        import jax as _jax
+
+        cfg = self.config
+        results = {}
+        for it in items:
+            x, m, n_h, n_c = _joint_grid(it.hist, it.cur)
+            F, T = x.shape
+            W = min(cfg.lstm_window, max(n_h // 2, 1))
+            if W < 4 or n_h < 2 * W:
+                # not enough history to learn from: leave the job unjudged
+                # (COMPLETED_UNKNOWN at endTime), same as sparse band jobs
+                continue
+            hist_m = m[:, :n_h]
+            hw = hist_m.astype(np.float32)
+            n = np.maximum(hw.sum(axis=1), 1.0)
+            mu = (x[:, :n_h] * hw).sum(axis=1) / n
+            sd = np.sqrt((((x[:, :n_h] - mu[:, None]) * hw) ** 2).sum(axis=1) / n)
+            sd = np.maximum(sd, 1e-6)
+            xs = ((x - mu[:, None]) / sd[:, None]).T.astype(np.float32)  # (T, F)
+            ms = m.T  # (T, F)
+
+            k = n_h // W
+            h0 = n_h - k * W
+            hwin = xs[h0:n_h].reshape(k, W, F)
+            hmask = ms[h0:n_h].reshape(k, W, F)
+            # score windows tiling the WHOLE current region (not just the
+            # last W steps); a final tail window may dip into history — its
+            # history steps are mask-zeroed so they add no reconstruction
+            # error and cannot dilute the z-score
+            starts = list(range(n_h, T - W + 1, W))
+            if not starts or starts[-1] + W < T:
+                starts.append(max(T - W, 0))
+            cwin = np.stack([xs[s : s + W] for s in starts])
+            cmask = np.stack([ms[s : s + W] for s in starts])
+            for k_i, s in enumerate(starts):
+                if s < n_h:
+                    cmask[k_i, : n_h - s] = False
+
+            model = self._lstm_model(F)
+            cache_key = (it.cache_key, tuple(it.metrics), W)
+            entry = self._lstm_cache.pop(cache_key, None)
+            if entry is None:
+                state, tx = lstm_ae.init_state(model, _jax.random.PRNGKey(0), T=W)
+                state, _ = lstm_ae.train(
+                    model, state, tx, hwin, hmask, epochs=cfg.lstm_epochs
+                )
+                err_mu, err_sd = lstm_ae.fit_score_normalizer(
+                    state.params, hwin, hmask, model.apply
+                )
+                entry = (state.params, float(err_mu), float(err_sd))
+            self._lstm_cache[cache_key] = entry  # re-insert = mark recent
+            while len(self._lstm_cache) > cfg.max_cache_size:
+                self._lstm_cache.pop(next(iter(self._lstm_cache)))
+            params, err_mu, err_sd = entry
+            z = float(
+                np.max(
+                    np.asarray(
+                        lstm_ae.anomaly_scores(
+                            params, cwin, cmask, err_mu, err_sd, model.apply
+                        )
+                    )
+                )
+            )
+            results[(it.job_id, "+".join(it.metrics), "lstm")] = {
+                "unhealthy": z > cfg.lstm_threshold,
+                "z": z,
+            }
         return results
 
     def _score_hpa(self, items: list[_HpaItem]):
@@ -384,14 +632,18 @@ class Analyzer:
         states: dict[str, _JobState] = {}
         all_pairs: list[_PairItem] = []
         all_bands: list[_BandItem] = []
+        all_bis: list[_BiItem] = []
+        all_multis: list[_MultiItem] = []
         all_hpas: list[_HpaItem] = []
         for doc in claimed:
             st = _JobState(doc)
             states[doc.id] = st
             try:
-                pairs, bands, hpas = self._preprocess(doc, now)
+                pairs, bands, bis, multis, hpas = self._preprocess(doc, now)
                 all_pairs += pairs
                 all_bands += bands
+                all_bis += bis
+                all_multis += multis
                 all_hpas += hpas
             except FetchError as e:
                 st.failed = str(e)
@@ -415,8 +667,10 @@ class Analyzer:
         live = {k: v for k, v in states.items() if not v.failed}
         pair_res, pair_bad = self._isolate(self._score_pairs, all_pairs)
         band_res, band_bad = self._isolate(self._score_bands, all_bands)
+        bi_res, bi_bad = self._isolate(self._score_bivariate, all_bis)
+        multi_res, multi_bad = self._isolate(self._score_multi, all_multis)
         hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
-        scoring_failed = {**pair_bad, **band_bad, **hpa_bad}
+        scoring_failed = {**pair_bad, **band_bad, **bi_bad, **multi_bad, **hpa_bad}
 
         # fold per-metric results into per-job verdicts
         for it in all_pairs:
@@ -446,6 +700,41 @@ class Analyzer:
                         f"{r['count']} points outside "
                         f"[{r['lower']:.4g},{r['upper']:.4g}] from ts {r['first_ts']:.0f}",
                         r["anomaly_pairs"],
+                    )
+                )
+        for it in all_bis:
+            r = bi_res.get((it.job_id, "&".join(it.metrics), "bivariate"))
+            if r is None:
+                continue
+            st = live[it.job_id]
+            st.judged_any = True
+            for metric, (upper, lower) in r["bounds"].items():
+                self.exporter.record_bounds(
+                    st.doc.app_name, st.doc.namespace, metric,
+                    upper, lower, float(r["unhealthy"]),
+                )
+            if r["unhealthy"]:
+                st.unhealthy.append(
+                    (
+                        "&".join(it.metrics),
+                        f"{r['count']} points outside the joint "
+                        f"bivariate-normal ellipse from ts {r['first_ts']:.0f}",
+                        r["anomaly_pairs"],
+                    )
+                )
+        for it in all_multis:
+            r = multi_res.get((it.job_id, "+".join(it.metrics), "lstm"))
+            if r is None:
+                continue
+            st = live[it.job_id]
+            st.judged_any = True
+            if r["unhealthy"]:
+                st.unhealthy.append(
+                    (
+                        "+".join(it.metrics),
+                        f"LSTM-AE reconstruction z={r['z']:.2f} exceeds "
+                        f"{self.config.lstm_threshold:.1f}",
+                        [],
                     )
                 )
 

@@ -49,7 +49,14 @@ class EngineConfig:
     max_stuck_seconds: float = 90.0  # MAX_STUCK_IN_SECONDS
     max_cache_size: int = 1024  # MAX_CACHE_SIZE (model/window cache entries)
     ma_window: int = 30  # moving-average lookback (steps)
-    hw_period: int = 1440  # Holt-Winters season (steps; 1 day at 60s)
+    hw_period: int = 1440  # Holt-Winters / seasonal-trend period (steps; 1 day at 60s)
+    st_order: int = 3  # seasonal-trend (prophet) Fourier order
+    # LSTM-autoencoder multivariate mode (3+ metrics; faq.md:8-10)
+    lstm_window: int = 32  # subwindow length (steps) per training sample
+    lstm_epochs: int = 30
+    lstm_hidden: int = 32
+    lstm_latent: int = 16
+    lstm_threshold: float = 3.0  # recon-error z-score gate
     # band verdict gate: a window is unhealthy when
     # count >= max(band_min_points, band_violation_fraction * checked).
     # A single k-sigma excursion in a 30-point window is expected Gaussian
@@ -144,5 +151,13 @@ def from_env(env=None) -> EngineConfig:
         min_kruskal_points=_env_int(env, "MIN_KRUSKAL_DATA_POINTS", 5),
         max_stuck_seconds=_env_float(env, "MAX_STUCK_IN_SECONDS", 90.0),
         max_cache_size=_env_int(env, "MAX_CACHE_SIZE", 1024),
+        ma_window=_env_int(env, "MA_WINDOW", 30),
+        hw_period=_env_int(env, "HW_PERIOD", 1440),
+        st_order=_env_int(env, "ST_ORDER", 3),
+        lstm_window=_env_int(env, "LSTM_WINDOW", 32),
+        lstm_epochs=_env_int(env, "LSTM_EPOCHS", 30),
+        lstm_hidden=_env_int(env, "LSTM_HIDDEN", 32),
+        lstm_latent=_env_int(env, "LSTM_LATENT", 16),
+        lstm_threshold=_env_float(env, "LSTM_THRESHOLD", 3.0),
         policies=policies,
     )

@@ -10,3 +10,4 @@ from .pairwise import (  # noqa: F401
     wilcoxon_signed_rank,
 )
 from .stats import chi2_sf, kolmogorov_sf, norm_sf  # noqa: F401
+from .bivariate import bivariate_normal_anomalies  # noqa: F401

@@ -46,6 +46,7 @@ __all__ = [
     "des_predictions",
     "holt_winters_predictions",
     "fit_holt_winters",
+    "fit_seasonal_trend",
     "residual_sigma",
     "band_anomalies",
 ]
@@ -227,6 +228,49 @@ def fit_holt_winters(x, mask, fit_mask, period: int, grid=None):
         x, mask, period, params[:, 0], params[:, 1], params[:, 2]
     )
     return params, preds
+
+
+# ---------------------------------------------------------------------------
+# Prophet-style decomposable model: linear trend + Fourier seasonality.
+# ---------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("period", "order"))
+def fit_seasonal_trend(x, mask, fit_mask, period: int, order: int = 3,
+                       ridge: float = 1e-4):
+    """Fit trend+seasonality per series by masked ridge least squares.
+
+    The reference brain's menu lists Prophet for single-metric forecasting
+    (docs/guides/design.md:53-88). Prophet's core is a decomposable model
+    y(t) = g(t) + s(t): piecewise-linear trend plus a Fourier-series
+    seasonality, fit by regularized regression. This is that core, TPU-shaped:
+    one closed-form weighted least-squares solve per series — the normal
+    equations are batched (B, D, D) systems that XLA maps straight onto the
+    MXU, replacing Prophet's per-series Stan/L-BFGS optimizer loop.
+
+    Args:
+      x, mask:   (B, T) values + validity.
+      fit_mask:  (B, T) bool — points whose residuals define the fit
+                 (historical region).
+      period:    seasonal period in steps (static).
+      order:     Fourier order K (static); D = 2 + 2K design columns.
+      ridge:     Tikhonov weight keeping the solve well-posed when a series
+                 has few valid points or the window spans < one period.
+
+    Returns (beta (B, D), preds (B, T)).
+    """
+    B, T = x.shape
+    tn = jnp.arange(T, dtype=_F) / jnp.maximum(T - 1, 1)
+    cols = [jnp.ones(T, _F), tn]
+    w = 2.0 * jnp.pi * jnp.arange(T, dtype=_F) / period
+    for k in range(1, order + 1):
+        cols += [jnp.sin(k * w), jnp.cos(k * w)]
+    X = jnp.stack(cols, axis=-1)  # (T, D)
+    D = X.shape[-1]
+    sel = (mask & fit_mask).astype(_F)  # (B, T)
+    A = jnp.einsum("td,te,bt->bde", X, X, sel) + ridge * jnp.eye(D, dtype=_F)
+    rhs = jnp.einsum("td,bt->bd", X, sel * x.astype(_F))
+    beta = jnp.linalg.solve(A, rhs[..., None])[..., 0]  # (B, D)
+    preds = jnp.einsum("td,bd->bt", X, beta)
+    return beta, preds
 
 
 # ---------------------------------------------------------------------------

@@ -288,3 +288,55 @@ def test_exporter_sanitizes_metric_names():
     assert "fake_series 99" not in text.replace("x_y__1_fake_series_99", "")
     for line in text.strip().splitlines():
         assert line.startswith("foremastbrain:"), line
+
+
+# -------------------------------------------------- multivariate (LSTM) mode
+def _multi_job(fixtures, *, bad, n_h=256, n_c=16):
+    t_h = np.arange(n_h)
+    t_c = n_h + np.arange(n_c)
+    rng = np.random.default_rng(11)
+    for i, name in enumerate(("latency", "cpu", "tps")):
+        wave_h = np.sin(2 * np.pi * t_h / 32 + i) + rng.normal(0, 0.05, n_h)
+        wave_c = np.sin(2 * np.pi * t_c / 32 + i) + rng.normal(0, 0.05, n_c)
+        if bad and name == "tps":
+            wave_c = wave_c + 6.0  # decorrelated level shift
+        fixtures[f"h{i}"] = ((t_h * STEP).tolist(), wave_h.tolist())
+        fixtures[f"c{i}"] = ((t_c * STEP).tolist(), wave_c.tolist())
+    return Document(
+        id="multi", app_name="app", namespace="d", strategy="canary",
+        start_time=to_rfc3339(0), end_time=to_rfc3339(0),
+        metrics={
+            name: MetricQueries(current=f"c{i}", historical=f"h{i}")
+            for i, name in enumerate(("latency", "cpu", "tps"))
+        },
+    )
+
+
+def _lstm_cfg():
+    return EngineConfig(algorithm="lstm_autoencoder", lstm_window=16,
+                        lstm_epochs=60, lstm_hidden=8, lstm_latent=4,
+                        policies={})
+
+
+def test_engine_lstm_mode_flags_multivariate_anomaly():
+    fixtures = {}
+    store = JobStore()
+    store.create(_multi_job(fixtures, bad=True))
+    analyzer = Analyzer(_lstm_cfg(), FixtureDataSource(fixtures), store)
+    out = analyzer.run_cycle(now=1_000_000.0)
+    assert out["multi"] == J.COMPLETED_UNHEALTH
+    assert "LSTM-AE" in store.get("multi").reason
+
+
+def test_engine_lstm_mode_passes_healthy_and_caches_model():
+    fixtures = {}
+    store = JobStore()
+    store.create(_multi_job(fixtures, bad=False))
+    analyzer = Analyzer(_lstm_cfg(), FixtureDataSource(fixtures), store)
+    out = analyzer.run_cycle(now=1_000_000.0)
+    assert out["multi"] == J.COMPLETED_HEALTH
+    assert len(analyzer._lstm_cache) == 1
+    # second job for the same app reuses the cached model (no retrain)
+    store.create(_multi_job(fixtures, bad=False))
+    analyzer.run_cycle(now=1_000_001.0)
+    assert len(analyzer._lstm_cache) == 1

@@ -214,3 +214,52 @@ def test_residual_sigma_no_history_fails_open():
         np.float32([-np.inf]),
     )
     assert int(out["count"][0]) == 0  # cannot judge -> nothing flagged
+
+
+def test_seasonal_trend_recovers_signal():
+    """Prophet-core fit: trend + sinusoid recovered near-exactly without noise,
+    and predictions extrapolate into a masked-out 'current' region."""
+    B, T, period = 3, 256, 32
+    t = np.arange(T, dtype=np.float32)
+    rng = np.random.default_rng(0)
+    xs = []
+    for b in range(B):
+        a0, a1 = rng.normal(5, 1), rng.normal(0.02, 0.01)
+        amp = rng.normal(2, 0.2)
+        xs.append(a0 + a1 * t + amp * np.sin(2 * np.pi * t / period))
+    x = np.stack(xs).astype(np.float32)
+    mask = np.ones((B, T), bool)
+    fit = mask.copy()
+    fit[:, -32:] = False  # last chunk is "current": excluded from the fit
+    _, preds = fc.fit_seasonal_trend(x, mask, fit, period, order=3)
+    preds = np.asarray(preds)
+    np.testing.assert_allclose(preds[:, -32:], x[:, -32:], atol=0.05)
+
+
+def test_seasonal_trend_matches_numpy_lstsq():
+    """Parity with an unregularized numpy least-squares fit on masked data."""
+    B, T, period, order = 2, 128, 24, 2
+    rng = np.random.default_rng(1)
+    x = rng.normal(10, 2, (B, T)).astype(np.float32)
+    mask = rng.random((B, T)) > 0.2
+    _, preds = fc.fit_seasonal_trend(x, mask, mask, period, order=order,
+                                     ridge=1e-8)
+    tn = np.arange(T) / (T - 1)
+    w = 2 * np.pi * np.arange(T) / period
+    cols = [np.ones(T), tn]
+    for k in range(1, order + 1):
+        cols += [np.sin(k * w), np.cos(k * w)]
+    X = np.stack(cols, axis=-1)
+    for b in range(B):
+        sel = mask[b]
+        beta, *_ = np.linalg.lstsq(X[sel], x[b, sel], rcond=None)
+        np.testing.assert_allclose(np.asarray(preds)[b], X @ beta, atol=1e-2)
+
+
+def test_seasonal_trend_sparse_series_stays_finite():
+    # ridge keeps the solve well-posed with almost no valid points
+    x = np.zeros((1, 64), np.float32)
+    mask = np.zeros((1, 64), bool)
+    mask[0, 5] = True
+    _, preds = fc.fit_seasonal_trend(x, mask, mask, 16)
+    assert np.all(np.isfinite(np.asarray(preds)))
