@@ -13,7 +13,13 @@ open job. Sources are pluggable:
     (the reference's equivalent seam was the injectable HTTP DoFunc,
     foremast-barrelman/pkg/client/analyst/analystclient.go:24).
 
-All sources return (timestamps: list[float], values: list[float]).
+All sources return (timestamps, values) sequences (lists, or numpy arrays
+when the native parser handled the response).
+
+Parsing goes through the C++ extension (foremast_tpu.native: single-pass
+extracting scanner + duplicate-averaging merge) when it is available, with
+the json.loads path kept as the pure-Python fallback — same results either
+way (tests/test_native.py asserts exact parity).
 """
 from __future__ import annotations
 
@@ -23,6 +29,8 @@ import time
 import urllib.request
 from collections import OrderedDict
 from typing import Callable
+
+from .. import native
 
 
 class FetchError(Exception):
@@ -48,9 +56,22 @@ class PrometheusDataSource:
     def fetch(self, url: str):
         try:
             with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                payload = json.loads(r.read())
+                raw = r.read()
         except Exception as e:  # noqa: BLE001 - network boundary
             raise FetchError(f"prometheus fetch failed: {e}") from e
+        # fast path: single-pass native scan (no DOM). The status probe only
+        # scans a prefix: Prometheus serializes the top-level "status" first,
+        # and a full-body scan would false-positive on series whose LABELS
+        # contain status="error" (common on the error metrics we monitor),
+        # permanently disabling the fast path for them. Error responses also
+        # arrive with non-2xx codes (urlopen raised above) — this probe is
+        # belt-and-braces for proxies that flatten the status code.
+        head = raw[:256]
+        if b'"status":"error"' not in head and b'"status": "error"' not in head:
+            parsed = native.parse_series(raw, native.FLAVOR_PROMETHEUS)
+            if parsed is not None:
+                return parsed
+        payload = json.loads(raw)
         if payload.get("status") not in (None, "success"):
             raise FetchError(f"prometheus error: {payload}")
         result = payload.get("data", {}).get("result", [])
@@ -72,9 +93,13 @@ class WavefrontDataSource:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                payload = json.loads(r.read())
+                raw = r.read()
         except Exception as e:  # noqa: BLE001
             raise FetchError(f"wavefront fetch failed: {e}") from e
+        parsed = native.parse_series(raw, native.FLAVOR_WAVEFRONT)
+        if parsed is not None:
+            return parsed
+        payload = json.loads(raw)
         series = [
             [(float(ts), float(v)) for ts, v in item.get("data", [])]
             for item in payload.get("timeseries", [])

@@ -135,6 +135,17 @@ def _joint_grid(hists: list, curs: list):
     return np.stack(vals), np.stack(masks), n_h, n_c
 
 
+def _concat_ts(cur: Window, n_h: int, j: int) -> float:
+    """Translate a concat-grid index onto the CURRENT window's own time grid.
+
+    Anomalies lie in the current region; the historical grid ends days
+    earlier, so extrapolating it would stamp anomalies in the future. Valid
+    because concat index n_h + k maps to current index k (history is
+    tail-kept, current head-kept — _concat_trimmed/_joint_grid invariant).
+    """
+    return float(cur.start + (j - n_h) * cur.step)
+
+
 @dataclass
 class _JobState:
     doc: J.Document
@@ -164,13 +175,15 @@ class Analyzer:
             return None
         url = materialize_placeholders(url, now)
         ts, vals = self.source.fetch(url)
-        if not ts:
+        if len(ts) == 0:
             return Window(np.zeros(1, np.float32), np.zeros(1, bool), 0)
         # clamp the grid span to the largest compiled bucket, keeping the
         # most recent samples: a user query returning >11 days of data must
-        # not produce an unbucketable window (and with it a poisoned batch)
-        end = align_step(max(ts)) + 60
-        start = max(align_step(min(ts)), end - MAX_WINDOW_STEPS * 60)
+        # not produce an unbucketable window (and with it a poisoned batch).
+        # np.max/np.min: ts may be a 10k-point ndarray off the native parser
+        # (builtin max would box every element)
+        end = align_step(float(np.max(ts))) + 60
+        start = max(align_step(float(np.min(ts))), end - MAX_WINDOW_STEPS * 60)
         return resample_to_grid(ts, vals, start, end, 60)
 
     def _preprocess(self, doc: J.Document, now: float):
@@ -355,33 +368,32 @@ class Analyzer:
             checked = np.asarray(out["checked"])
             for i, it in enumerate(group):
                 n_h = trimmed_n_h[id(it)]
-
-                def concat_ts(j: int) -> float:
-                    # anomalies lie in the current region: translate the
-                    # concat index onto the CURRENT window's own time grid
-                    # (the historical grid ends 7 days later; extrapolating
-                    # it would stamp anomalies in the future)
-                    return float(it.current.start + (j - n_h) * it.current.step)
-
                 anomalous_idx = np.nonzero(flags[i])[0]
                 anomaly_pairs = []
                 for j in anomalous_idx[:50]:
-                    anomaly_pairs += [concat_ts(int(j)), float(xv[i, j])]
+                    anomaly_pairs += [_concat_ts(it.current, n_h, int(j)),
+                                      float(xv[i, j])]
                 region_sel = regions[i]
-                gate = max(
-                    self.config.band_min_points,
-                    self.config.band_violation_fraction * float(checked[i]),
-                )
                 first = int(firsts[i])
                 results[(it.job_id, it.metric, "band")] = {
                     "count": int(counts[i]),
-                    "unhealthy": int(counts[i]) >= gate,
-                    "first_ts": concat_ts(first) if first >= 0 else -1.0,
+                    "unhealthy": int(counts[i]) >= self._gate(checked[i]),
+                    "first_ts": (
+                        _concat_ts(it.current, n_h, first) if first >= 0 else -1.0
+                    ),
                     "upper": float(np.mean(uppers[i][region_sel])),
                     "lower": float(np.mean(lowers[i][region_sel])),
                     "anomaly_pairs": anomaly_pairs,
                 }
         return results
+
+    def _gate(self, checked) -> float:
+        """Unhealthy-verdict gate: min anomalous points for a band-style
+        scorer to condemn a window (see EngineConfig.band_min_points)."""
+        return max(
+            self.config.band_min_points,
+            self.config.band_violation_fraction * float(checked),
+        )
 
     def _score_bivariate(self, items: list[_BiItem]):
         """Joint 2-metric scoring: one bivariate-normal program per bucket."""
@@ -432,24 +444,18 @@ class Analyzer:
             for i, it in enumerate(group):
                 x, m, n_h, n_c = prepped[id(it)]
                 cur0 = it.cur[0]
-                gate = max(
-                    self.config.band_min_points,
-                    self.config.band_violation_fraction * float(checked[i]),
-                )
                 first = int(firsts[i])
                 anomalous_idx = np.nonzero(flags[i])[0]
                 anomaly_pairs = []
                 for j in anomalous_idx[:50]:
-                    ts = cur0.start + (int(j) - n_h) * cur0.step
-                    anomaly_pairs += [float(ts), float(x[0, int(j)])]
+                    anomaly_pairs += [_concat_ts(cur0, n_h, int(j)),
+                                      float(x[0, int(j)])]
                 sel = region[i]
                 results[(it.job_id, "&".join(it.metrics), "bivariate")] = {
                     "count": int(counts[i]),
-                    "unhealthy": int(counts[i]) >= gate,
+                    "unhealthy": int(counts[i]) >= self._gate(checked[i]),
                     "first_ts": (
-                        float(cur0.start + (first - n_h) * cur0.step)
-                        if first >= 0
-                        else -1.0
+                        _concat_ts(cur0, n_h, first) if first >= 0 else -1.0
                     ),
                     "anomaly_pairs": anomaly_pairs,
                     "bounds": {

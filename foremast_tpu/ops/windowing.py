@@ -65,11 +65,18 @@ def resample_to_grid(
     """
     start = align_step(start, step)
     end = align_step(end + step - 1, step)
+    ts = np.asarray(timestamps, dtype=np.float64)
+    vs = np.asarray(values, dtype=np.float64)
+    if ts.size >= 512:
+        # large (historical) windows: single-pass C resampler when built
+        from .. import native
+
+        res = native.resample(ts, vs, start, end, step)
+        if res is not None:
+            return Window(values=res[0], mask=res[1], start=start, step=step)
     T = max(1, (end - start) // step)
     vals = np.zeros(T, dtype=np.float32)
     mask = np.zeros(T, dtype=bool)
-    ts = np.asarray(timestamps, dtype=np.float64)
-    vs = np.asarray(values, dtype=np.float64)
     if ts.size:
         finite = np.isfinite(vs) & np.isfinite(ts)
         ts, vs = ts[finite], vs[finite]

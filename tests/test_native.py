@@ -1,0 +1,200 @@
+"""Native C++ data-plane extension: build, exact parity with the Python
+fallbacks, and graceful degradation on malformed input.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from foremast_tpu import native
+from foremast_tpu.dataplane.fetch import _avg_series
+from foremast_tpu.ops.windowing import resample_to_grid
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native extension unavailable (no toolchain)"
+)
+
+
+def _prom_payload(series):
+    return json.dumps(
+        {
+            "status": "success",
+            "data": {
+                "resultType": "matrix",
+                "result": [
+                    {
+                        "metric": {"app": f"s{i}", "pod": "x" * 10},
+                        "values": [[t, str(v)] for t, v in s],
+                    }
+                    for i, s in enumerate(series)
+                ],
+            },
+        }
+    ).encode()
+
+
+def _py_prom(raw):
+    payload = json.loads(raw)
+    result = payload.get("data", {}).get("result", [])
+    series = [
+        [(float(ts), float(v)) for ts, v in item.get("values", [])]
+        for item in result
+    ]
+    return _avg_series(series)
+
+
+def test_parse_prometheus_parity_with_python():
+    rng = np.random.default_rng(0)
+    base = 1_700_000_000
+    s1 = [(base + 60 * i + 0.781, float(rng.normal(10, 2))) for i in range(500)]
+    s2 = [(base + 60 * i + 0.781, float(rng.normal(5, 1))) for i in range(250)]
+    raw = _prom_payload([s1, s2])
+    ts_n, v_n = native.parse_series(raw, native.FLAVOR_PROMETHEUS)
+    ts_p, v_p = _py_prom(raw)
+    np.testing.assert_array_equal(ts_n, np.asarray(ts_p))
+    np.testing.assert_array_equal(v_n, np.asarray(v_p))
+    # duplicates across series were averaged
+    assert len(ts_n) == 500
+
+
+def test_parse_special_values_and_escapes():
+    raw = json.dumps(
+        {
+            "status": "success",
+            "data": {
+                "result": [
+                    {
+                        "metric": {"weird \"key\"": "va\\lue\nnewlineé"},
+                        "values": [
+                            [1000, "NaN"],
+                            [1060, "+Inf"],
+                            [1120, "-Inf"],
+                            [1180, "42.5"],
+                        ],
+                    }
+                ]
+            },
+        }
+    ).encode()
+    ts, v = native.parse_series(raw, native.FLAVOR_PROMETHEUS)
+    assert list(ts) == [1000, 1060, 1120, 1180]
+    assert np.isnan(v[0]) and np.isposinf(v[1]) and np.isneginf(v[2])
+    assert v[3] == 42.5
+
+
+def test_parse_numeric_values_and_empty():
+    # wavefront flavor: plain-number samples under "data"
+    raw = json.dumps(
+        {"timeseries": [{"label": "x", "data": [[100, 1.5], [160, 2.5]]}]}
+    ).encode()
+    ts, v = native.parse_series(raw, native.FLAVOR_WAVEFRONT)
+    assert list(ts) == [100, 160] and list(v) == [1.5, 2.5]
+    ts, v = native.parse_series(
+        b'{"status":"success","data":{"result":[]}}', native.FLAVOR_PROMETHEUS
+    )
+    assert len(ts) == 0 and len(v) == 0
+
+
+def test_parse_malformed_returns_none():
+    assert native.parse_series(b'{"data": {"result": [', 0) is None
+    assert native.parse_series(b"", 0) is None
+    assert native.parse_series(b"not json at all", 0) is None
+
+
+def test_resample_parity_with_python():
+    rng = np.random.default_rng(1)
+    n = 2000
+    start, end, step = 0, 1200 * 60, 60
+    ts = rng.uniform(-3600, end + 3600, n)
+    # exercise half-step boundaries (np.round half-to-even semantics)
+    ts[:200] = (np.arange(200) * 60) + 30.0
+    vals = rng.normal(0, 1, n)
+    vals[::17] = np.nan
+    w_native = native.resample(ts, vals, start, end, step)
+    # small python reference (forced: size<512 path would not trigger here,
+    # so call with the native layer disabled via a length-1 shim)
+    T = (end - start) // step
+    ref_vals = np.zeros(T, np.float32)
+    ref_mask = np.zeros(T, bool)
+    finite = np.isfinite(vals) & np.isfinite(ts)
+    tsf, vsf = ts[finite], vals[finite]
+    keep = (tsf >= start) & (tsf < end)
+    tsf, vsf = tsf[keep], vsf[keep]
+    idx = np.clip(np.round((tsf - start) / step).astype(np.int64), 0, T - 1)
+    ref_vals[idx] = vsf.astype(np.float32)
+    ref_mask[idx] = True
+    np.testing.assert_array_equal(w_native[0], ref_vals)
+    np.testing.assert_array_equal(w_native[1], ref_mask)
+
+
+def test_resample_to_grid_uses_native_for_long_series():
+    rng = np.random.default_rng(2)
+    n = 1024
+    ts = np.arange(n) * 60.0
+    vals = rng.normal(10, 1, n)
+    w = resample_to_grid(ts.tolist(), vals.tolist(), 0, n * 60)
+    assert w.n_valid == n
+    np.testing.assert_allclose(w.values[:n], vals.astype(np.float32))
+
+
+def test_fetch_prometheus_native_path(monkeypatch):
+    """PrometheusDataSource returns identical data through the native path
+    and the forced-fallback path."""
+    import foremast_tpu.dataplane.fetch as F
+
+    raw = _prom_payload([[(1000 + 60 * i, float(i)) for i in range(50)]])
+
+    class FakeResp:
+        def __init__(self, b):
+            self.b = b
+
+        def read(self):
+            return self.b
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(
+        F.urllib.request, "urlopen", lambda url, timeout=None: FakeResp(raw)
+    )
+    src = F.PrometheusDataSource()
+    ts1, v1 = src.fetch("http://x")
+    monkeypatch.setattr(F.native, "parse_series", lambda *a: None)
+    ts2, v2 = src.fetch("http://x")
+    np.testing.assert_array_equal(np.asarray(ts1), np.asarray(ts2))
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+
+def test_fetch_prometheus_error_status_raises(monkeypatch):
+    import foremast_tpu.dataplane.fetch as F
+
+    raw = json.dumps({"status": "error", "errorType": "bad_data"}).encode()
+
+    class FakeResp:
+        def __init__(self, b):
+            self.b = b
+
+        def read(self):
+            return self.b
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(
+        F.urllib.request, "urlopen", lambda url, timeout=None: FakeResp(raw)
+    )
+    with pytest.raises(F.FetchError):
+        F.PrometheusDataSource().fetch("http://x")
+
+
+def test_deeply_nested_body_falls_back_not_segfault():
+    # 200k unclosed brackets: must return None (depth-limited), not SIGSEGV
+    assert native.parse_series(b"[" * 200_000, native.FLAVOR_PROMETHEUS) is None
+    deep = b"[" * 200_000 + b"]" * 200_000
+    assert native.parse_series(deep, native.FLAVOR_PROMETHEUS) is None
