@@ -242,6 +242,14 @@ class ForemastService:
     def metrics(self):
         return 200, self.exporter.render()
 
+    def dashboard(self):
+        try:
+            from ..dashboard import index_html
+
+            return 200, index_html()
+        except OSError as e:
+            return 500, {"error": f"dashboard assets unavailable: {e}"}
+
 
 def make_server(service: ForemastService, host: str = "0.0.0.0", port: int = 8099):
     class Handler(BaseHTTPRequestHandler):
@@ -272,6 +280,12 @@ def make_server(service: ForemastService, host: str = "0.0.0.0", port: int = 809
             try:
                 if parsed.path == "/healthz":
                     self._send(200, {"status": "ok"})
+                elif parsed.path in ("/", "/dashboard") or parsed.path.startswith(
+                    "/dashboard/"
+                ):
+                    status, payload = service.dashboard()
+                    ct = "text/html; charset=utf-8" if status == 200 else None
+                    self._send(status, payload, content_type=ct)
                 elif parsed.path == "/metrics":
                     self._send(*service.metrics())
                 elif parts[:3] == ["v1", "healthcheck", "id"] and len(parts) == 4:

@@ -1,0 +1,178 @@
+"""Dashboard (L7): served page, embedded-JS structural sanity, and the
+query-proxy contract the page's fetches depend on.
+
+No browser exists in this image, so rendering is exercised by checking the
+served document and by replaying the exact /api/v1/query_range requests the
+page issues against a stub metric store through the real service proxy.
+"""
+import json
+import re
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from foremast_tpu.dashboard import index_html
+from foremast_tpu.engine.jobs import JobStore
+from foremast_tpu.service.api import ForemastService, make_server
+
+
+@pytest.fixture()
+def port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_page_contains_reference_series_contract():
+    html = index_html()
+    # the reference METRICS_MAP metric + foremastbrain: series names
+    # (foremast-dashboard/src/config/metrics.js:12-107)
+    assert "namespace_app_pod_http_server_requests_errors_5xx" in html
+    assert "namespace_app_pod_http_server_requests_latency" in html
+    assert "namespace_app_pod_cpu_usage_seconds_total" in html
+    assert "namespace_app_pod_memory_usage_bytes" in html
+    assert "foremastbrain:" in html
+    assert "namespace_app_per_pod:hpa_score" in html
+    assert "kube_pod_labels" in html  # version annotations (metrics.js:104)
+    assert "/api/v1/query_range" in html  # proxy contract
+    # no external resources: the page must be self-contained (zero egress)
+    assert "<script src=" not in html and "<link" not in html
+    assert "@import" not in html and "url(" not in html
+    for proto in ("http://", "https://"):
+        for idx in range(len(html)):
+            if html.startswith(proto, idx):
+                # only allowed inside comments (reference citations)
+                before = html[:idx]
+                assert before.rfind("<!--") > before.rfind("-->"), (
+                    f"external URL outside comments at offset {idx}: "
+                    f"{html[idx:idx + 60]!r}"
+                )
+
+
+def test_embedded_js_brackets_balanced():
+    """Lint-lite: every (), [], {} balanced outside strings/comments — the
+    strongest syntax check available without a JS engine in the image."""
+    html = index_html()
+    m = re.search(r"<script>(.*)</script>", html, re.S)
+    assert m, "no inline script"
+    src = m.group(1)
+    stack = []
+    pairs = {")": "(", "]": "[", "}": "{"}
+    i, n = 0, len(src)
+    mode = None  # None | "'" | '"' | "`" | "//" | "/*"
+    while i < n:
+        c = src[i]
+        if mode in ("'", '"', "`"):
+            if c == "\\":
+                i += 2
+                continue
+            if c == mode:
+                mode = None
+            elif mode == "`" and c == "$" and i + 1 < n and src[i + 1] == "{":
+                stack.append("{`")  # marker: closing this brace resumes `
+                mode = None  # template expression: back to code mode
+                i += 1
+        elif mode == "//":
+            if c == "\n":
+                mode = None
+        elif mode == "/*":
+            if c == "*" and i + 1 < n and src[i + 1] == "/":
+                mode = None
+                i += 1
+        else:
+            if c in "'\"`":
+                mode = c
+            elif c == "/" and i + 1 < n and src[i + 1] in "/*":
+                mode = "//" if src[i + 1] == "/" else "/*"
+                i += 1
+            elif c == "/":
+                # regex literal vs division: regex when the previous
+                # significant char cannot end an expression
+                j = i - 1
+                while j >= 0 and src[j] in " \t\n\r":
+                    j -= 1
+                if j < 0 or src[j] in "(,=:[!&|?{};":
+                    i += 1
+                    in_class = False
+                    while i < n:
+                        if src[i] == "\\":
+                            i += 1
+                        elif src[i] == "[":
+                            in_class = True
+                        elif src[i] == "]":
+                            in_class = False
+                        elif src[i] == "/" and not in_class:
+                            break
+                        i += 1
+            elif c in "([{":
+                stack.append(c)
+            elif c in ")]}":
+                assert stack and stack[-1].startswith(pairs[c]), (
+                    f"unbalanced {c!r} at offset {i}: ...{src[max(0, i - 60):i + 10]!r}"
+                )
+                top = stack.pop()
+                if top == "{`":  # closed a ${...}: resume the template literal
+                    mode = "`"
+        i += 1
+    assert not stack, f"unclosed {stack[-3:]}"
+    assert mode in (None, "//"), f"unterminated {mode}"
+
+
+class _StubProm(BaseHTTPRequestHandler):
+    def do_GET(self):
+        u = urlparse(self.path)
+        qs = parse_qs(u.query)
+        q = qs.get("query", [""])[0]
+        start = int(float(qs.get("start", ["0"])[0]))
+        vals = [[start + 15 * i, str(1.0 + i)] for i in range(4)]
+        body = json.dumps(
+            {"status": "success",
+             "data": {"resultType": "matrix",
+                      "result": [{"metric": {"q": q[:40]}, "values": vals}]}}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_dashboard_served_and_proxy_contract(port):
+    prom = ThreadingHTTPServer(("127.0.0.1", 0), _StubProm)
+    prom_port = prom.server_address[1]
+    threading.Thread(target=prom.serve_forever, daemon=True).start()
+    svc = ForemastService(
+        JobStore(), query_endpoint=f"http://127.0.0.1:{prom_port}"
+    )
+    srv = make_server(svc, "127.0.0.1", port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        for path in ("/", "/dashboard"):
+            r = urllib.request.urlopen(f"http://127.0.0.1:{port}{path}")
+            assert r.status == 200
+            assert "text/html" in r.headers["Content-Type"]
+            assert b"foremast-tpu" in r.read()
+        # replay the exact query the page issues (base series of chart 1)
+        q = ('namespace_app_pod_http_server_requests_errors_5xx'
+             '%7Bnamespace%3D%22d%22%2C%20app%3D%22demo%22%7D')
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/v1/query_range?query={q}"
+            "&start=0&end=60&step=15"
+        )
+        payload = json.loads(r.read())
+        if isinstance(payload, str):  # the page handles double-encoding too
+            payload = json.loads(payload)
+        assert payload["data"]["result"][0]["values"]
+    finally:
+        srv.shutdown()
+        prom.shutdown()
