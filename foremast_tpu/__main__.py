@@ -1,4 +1,6 @@
-"""`python -m foremast_tpu` — run the combined service + engine process."""
-from .runtime import main
+"""`python -m foremast_tpu [serve|operator|watch|unwatch|status|demo]`."""
+import sys
 
-main()
+from .cli import main
+
+sys.exit(main())

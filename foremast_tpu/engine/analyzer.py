@@ -298,10 +298,16 @@ class Analyzer:
             )
             unhealthy = np.asarray(out["unhealthy"])
             min_p = np.asarray(out["min_p"])
+            pw = np.asarray(out["pairwise_unhealthy"])
+            band = np.asarray(out["band_unhealthy"])
+            band_count = np.asarray(out["band_count"])
             for i, it in enumerate(group):
                 results[(it.job_id, it.metric, "pair")] = {
                     "unhealthy": bool(unhealthy[i]),
                     "min_p": float(min_p[i]),
+                    "pairwise_unhealthy": bool(pw[i]),
+                    "band_unhealthy": bool(band[i]),
+                    "band_count": int(band_count[i]),
                 }
         return results
 
@@ -686,9 +692,14 @@ class Analyzer:
             st = live[it.job_id]
             st.judged_any = True
             if r["unhealthy"]:
-                st.unhealthy.append(
-                    (it.metric, f"pairwise rejection p={r['min_p']:.2e}", [])
-                )
+                causes = []
+                if r["pairwise_unhealthy"]:
+                    causes.append(f"pairwise rejection p={r['min_p']:.2e}")
+                if r["band_unhealthy"]:
+                    causes.append(
+                        f"{r['band_count']} points outside the baseline band"
+                    )
+                st.unhealthy.append((it.metric, "; ".join(causes), []))
         for it in all_bands:
             r = band_res.get((it.job_id, it.metric, "band"))
             if r is None:

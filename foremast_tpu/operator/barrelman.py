@@ -59,13 +59,19 @@ class _CachedMetadata:
 
 class Barrelman:
     def __init__(self, kube, analyst, mode: str = MODE_HPA_AND_HEALTHY,
-                 hpa_strategy: str = "hpa_exists", operator_namespace: str = "foremast"):
+                 hpa_strategy: str = "hpa_exists", operator_namespace: str = "foremast",
+                 watch_namespaces=None):
         self.kube = kube
         self.analyst = analyst
         self.mode = mode
         self.hpa_strategy = hpa_strategy
         self.operator_namespace = operator_namespace
+        # non-empty set -> reconcile ONLY these namespaces (WATCH_NAMESPACES)
+        self.watch_namespaces = set(watch_namespaces or ())
         self._md_cache: dict[tuple, _CachedMetadata] = {}
+
+    def watches_namespace(self, ns: str) -> bool:
+        return not self.watch_namespaces or ns in self.watch_namespaces
 
     # ------------------------------------------------------------ metadata
     def get_deployment_metadata(self, ns: str, app: str,
@@ -293,6 +299,8 @@ class Barrelman:
         now = time.time() if now is None else now
         touched = {}
         for ns in self.kube.list_namespaces():
+            if not self.watches_namespace(ns):
+                continue
             for monitor in self.kube.list_monitors(ns):
                 key = f"{ns}/{monitor.name}"
                 if monitor.status.phase == PHASE_RUNNING:

@@ -96,6 +96,8 @@ class DeploymentController:
     def is_monitored_namespace(self, ns: str) -> bool:
         if ns in NAMESPACE_BLACKLIST:
             return False
+        if not self.barrelman.watches_namespace(ns):
+            return False
         return self.kube.namespace_annotations(ns).get(MONITORING_ANNOTATION) != "false"
 
     def _app_name(self, deployment: dict) -> str:

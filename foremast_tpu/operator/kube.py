@@ -100,6 +100,17 @@ class FakeKube:
         self._notify("monitor", monitor)
         return monitor
 
+    def patch_monitor(self, ns: str, name: str, patch: dict) -> None:
+        """Merge-PATCH a subset of a monitor (KubeClient contract)."""
+        m = self.monitors.get((ns, name))
+        if m is None:
+            raise KubeError(f"deploymentmonitor {ns}/{name} not found")
+        obj = _monitor_to_k8s(m)
+        _deep_merge(obj, patch)
+        merged = _monitor_from_k8s(obj)
+        self.monitors[(ns, name)] = merged
+        self._notify("monitor", merged)
+
     def delete_monitor(self, ns: str, name: str):
         self.monitors.pop((ns, name), None)
 
@@ -239,16 +250,48 @@ class KubeClient:
         return [_monitor_from_k8s(i) for i in obj.get("items", [])]
 
     def upsert_monitor(self, monitor: DeploymentMonitor) -> DeploymentMonitor:
+        path = self._crd(monitor.namespace, "deploymentmonitors", monitor.name)
         body = _monitor_to_k8s(monitor)
+        # merge-PATCH spec+metadata, falling back to POST on not-found: no
+        # GET round-trip, no resourceVersion bookkeeping, and no clobbering
+        # of fields this caller didn't set
         try:
             self._req(
-                "PUT",
-                self._crd(monitor.namespace, "deploymentmonitors", monitor.name),
-                body,
+                "PATCH",
+                path,
+                {"metadata": {"annotations": body["metadata"]["annotations"]},
+                 "spec": body["spec"]},
+                content_type="application/merge-patch+json",
             )
         except KubeError:
-            self._req("POST", self._crd(monitor.namespace, "deploymentmonitors"), body)
+            self._req(
+                "POST", self._crd(monitor.namespace, "deploymentmonitors"), body
+            )
+        # status is a subresource (deploy/crds/deploymentmonitor.yaml): the
+        # write above silently DROPS .status, so persist it with a separate
+        # PATCH against /status or phases/verdicts never survive in-cluster
+        try:
+            self._req(
+                "PATCH",
+                path + "/status",
+                {"status": body["status"]},
+                content_type="application/merge-patch+json",
+            )
+        except KubeError:
+            pass  # CRD installed without the status subresource
         return monitor
+
+    def patch_monitor(self, ns: str, name: str, patch: dict) -> None:
+        """Merge-PATCH a subset of a monitor (e.g. {'spec': {'continuous':
+        True}}) without touching any other field — the safe path for
+        spec-only writers like the watch/unwatch CLI, which must not
+        round-trip a possibly-stale status copy."""
+        self._req(
+            "PATCH",
+            self._crd(ns, "deploymentmonitors", name),
+            patch,
+            content_type="application/merge-patch+json",
+        )
 
     def delete_monitor(self, ns: str, name: str):
         try:

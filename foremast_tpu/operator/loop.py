@@ -21,9 +21,11 @@ from .types import PHASE_UNHEALTHY
 
 class OperatorLoop:
     def __init__(self, kube, analyst, mode: str = "hpa_and_healthy_monitoring",
-                 hpa_strategy: str = "hpa_exists"):
+                 hpa_strategy: str = "hpa_exists", watch_namespaces=None):
         self.kube = kube
-        self.barrelman = Barrelman(kube, analyst, mode=mode, hpa_strategy=hpa_strategy)
+        self.barrelman = Barrelman(kube, analyst, mode=mode,
+                                   hpa_strategy=hpa_strategy,
+                                   watch_namespaces=watch_namespaces)
         self.deployments = DeploymentController(kube, self.barrelman)
         self.monitors = MonitorController(kube, self.barrelman)
         self.hpas = HpaController(kube, self.barrelman)
@@ -78,6 +80,8 @@ class OperatorLoop:
     def _diff_hpas(self):
         seen = {}
         for ns in self.kube.list_namespaces():
+            if not self.barrelman.watches_namespace(ns):
+                continue
             for h in self.kube.list_hpas(ns):
                 key = (ns, h["metadata"]["name"])
                 seen[key] = copy.deepcopy(h)
@@ -91,6 +95,8 @@ class OperatorLoop:
     # -- monitors (remediation on phase flips) --
     def _sweep_monitors(self):
         for m in self.kube.list_monitors():
+            if not self.barrelman.watches_namespace(m.namespace):
+                continue
             key = (m.namespace, m.name)
             old_phase = self._monitor_phases.get(key)
             if m.status.phase == PHASE_UNHEALTHY and old_phase != PHASE_UNHEALTHY:

@@ -137,6 +137,9 @@ def _pair_verdict(
         "pvalues": pvals,
         "band_count": band_count,
         "min_p": min_p,
+        # which detector fired, so verdict reasons can say the true cause
+        "pairwise_unhealthy": pairwise_unhealthy,
+        "band_unhealthy": band_unhealthy,
     }
 
 

@@ -1,0 +1,275 @@
+"""deploy/ manifest suite: every YAML parses, the CRD openAPI schemas
+round-trip the operator's actual wire shapes, and the recording rules
+define exactly the series the engine's query builder reads.
+
+The reference ships its manifests untested; here the manifests are pinned
+to the code so schema drift fails CI (CRD source of truth:
+foremast_tpu/operator/kube.py codecs; series contract:
+foremast_tpu/dataplane/promql.py:52-58).
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+import yaml
+
+from foremast_tpu.operator import kube as K
+from foremast_tpu.operator import types as T
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy")
+
+
+def _load_all():
+    docs = {}
+    for path in glob.glob(os.path.join(DEPLOY, "**", "*.yaml"), recursive=True):
+        with open(path) as f:
+            docs[os.path.relpath(path, DEPLOY)] = list(yaml.safe_load_all(f))
+    return docs
+
+
+ALL = _load_all()
+
+
+def test_all_manifests_parse_and_have_kind():
+    assert len(ALL) >= 9
+    for path, docs in ALL.items():
+        for doc in docs:
+            assert isinstance(doc, dict), path
+            assert doc.get("kind"), path
+            assert doc.get("apiVersion"), path
+
+
+def _validate(schema: dict, obj, path="$"):
+    """Minimal openAPIV3 structural-schema validator: types, enums,
+    properties, items. Unknown fields are violations unless the schema
+    opts out via x-kubernetes-preserve-unknown-fields."""
+    t = schema.get("type")
+    if t == "object":
+        assert isinstance(obj, dict), f"{path}: expected object, got {type(obj)}"
+        props = schema.get("properties", {})
+        if not schema.get("x-kubernetes-preserve-unknown-fields"):
+            unknown = set(obj) - set(props)
+            assert not unknown, f"{path}: fields not in CRD schema: {unknown}"
+        for k, v in obj.items():
+            if k in props:
+                _validate(props[k], v, f"{path}.{k}")
+    elif t == "array":
+        assert isinstance(obj, list), f"{path}: expected array"
+        for i, v in enumerate(obj):
+            _validate(schema.get("items", {}), v, f"{path}[{i}]")
+    elif t == "string":
+        assert isinstance(obj, str), f"{path}: expected string, got {obj!r}"
+    elif t == "boolean":
+        assert isinstance(obj, bool), f"{path}: expected bool, got {obj!r}"
+    elif t == "integer":
+        assert isinstance(obj, int) and not isinstance(obj, bool), \
+            f"{path}: expected integer, got {obj!r}"
+    elif t == "number":
+        assert isinstance(obj, (int, float)) and not isinstance(obj, bool), \
+            f"{path}: expected number, got {obj!r}"
+    if "enum" in schema:
+        assert obj in schema["enum"], f"{path}: {obj!r} not in {schema['enum']}"
+
+
+def _crd_schema(filename: str) -> dict:
+    [crd] = ALL[os.path.join("crds", filename)]
+    [version] = crd["spec"]["versions"]
+    assert version["served"] and version["storage"]
+    return version["schema"]["openAPIV3Schema"]
+
+
+def _full_monitor() -> T.DeploymentMonitor:
+    return T.DeploymentMonitor(
+        name="demo",
+        namespace="default",
+        annotations={"foremast.ai/strategy": "canary"},
+        spec=T.MonitorSpec(
+            selector={"app": "demo"},
+            analyst=T.Analyst(endpoint="http://runtime:8099/v1/healthcheck/"),
+            start_time="2026-07-29T00:00:00Z",
+            wait_until="2026-07-29T00:30:00Z",
+            metrics=T.Metrics(
+                data_source_type="prometheus",
+                endpoint="http://prom:9090/api/v1/",
+                monitoring=[
+                    T.Monitoring("http_server_requests_errors_5xx", "gauge", "error5xx")
+                ],
+            ),
+            continuous=True,
+            remediation=T.RemediationAction(
+                option=T.REMEDIATION_AUTO_ROLLBACK, parameters={"revision": "3"}
+            ),
+            rollback_revision=3,
+            hpa_score_template="cpu_bound",
+        ),
+        status=T.MonitorStatus(
+            observed_generation=7,
+            job_id="abc123",
+            phase=T.PHASE_UNHEALTHY,
+            remediation_taken=True,
+            anomaly=T.Anomaly.from_flat({"error5xx": [1700000000, 4.2, 1700000060, 5.0]}),
+            timestamp="2026-07-29T00:10:00Z",
+            expired=False,
+            hpa_score_enabled=True,
+            hpa_logs=[
+                T.HpaLogEntry(
+                    timestamp="2026-07-29T00:10:00Z",
+                    hpascore=78.0,
+                    reason="cpu above band",
+                    details=[{"metricType": "cpu", "current": 0.9,
+                              "upper": 0.7, "lower": 0.2}],
+                )
+            ],
+        ),
+    )
+
+
+def test_monitor_crd_schema_roundtrips_wire_shape():
+    schema = _crd_schema("deploymentmonitor.yaml")
+    wire = K._monitor_to_k8s(_full_monitor())
+    _validate(schema, {k: v for k, v in wire.items() if k != "metadata"}
+              | {"metadata": {}}, "$")
+    # and the wire shape decodes back losslessly
+    back = K._monitor_from_k8s(wire)
+    assert back == _full_monitor()
+
+
+def test_monitor_crd_phase_enum_matches_types():
+    schema = _crd_schema("deploymentmonitor.yaml")
+    phases = schema["properties"]["status"]["properties"]["phase"]["enum"]
+    assert set(phases) == {
+        T.PHASE_HEALTHY, T.PHASE_RUNNING, T.PHASE_FAILED, T.PHASE_UNHEALTHY,
+        T.PHASE_WARNING, T.PHASE_EXPIRED, T.PHASE_ABORT,
+    }
+    opts = schema["properties"]["spec"]["properties"]["remediation"][
+        "properties"]["option"]["enum"]
+    assert set(opts) == {
+        T.REMEDIATION_NONE, T.REMEDIATION_AUTO_ROLLBACK,
+        T.REMEDIATION_AUTO_PAUSE, T.REMEDIATION_AUTO,
+    }
+
+
+def test_metadata_crd_schema_accepts_default_record():
+    schema = _crd_schema("deploymentmetadata.yaml")
+    [default] = ALL[os.path.join("stack", "50-deployment-metadata-default.yaml")]
+    assert default["kind"] == "DeploymentMetadata"
+    assert default["metadata"]["name"] == "deployment-metadata-default"
+    _validate(schema, {"apiVersion": default["apiVersion"],
+                       "kind": default["kind"], "metadata": {},
+                       "spec": default["spec"]}, "$")
+    # the record must decode through the operator codec
+    md = K._metadata_from_k8s(default)
+    assert md.template_named("cpu_bound") is not None
+    assert [m.metric_alias for m in md.metrics.monitoring] == ["error5xx", "latency"]
+
+
+def test_recording_rules_cover_engine_series_contract():
+    [rules] = ALL[os.path.join("prometheus", "recording-rules.yaml")]
+    records = [
+        r["record"]
+        for g in rules["spec"]["groups"]
+        for r in g["rules"]
+    ]
+    assert len(records) >= 25  # reference rule-count parity (SURVEY.md §2.7)
+    # pod-level series for every default-metadata metric (canary queries,
+    # promql.py:52-54 reads namespace_pod_<metric>)
+    # app-level series (continuous/hpa queries, promql.py:57-58)
+    for metric in ("http_server_requests_errors_5xx",
+                   "http_server_requests_latency",
+                   "http_server_requests_count",
+                   "cpu_usage_seconds_total", "memory_usage_bytes"):
+        assert f"namespace_app_pod_{metric}" in records, metric
+    for metric in ("cpu_usage_seconds_total", "memory_usage_bytes",
+                   "cpu_utilization", "memory_utilization",
+                   # pod-level HTTP series: canary jobs on the default
+                   # metadata metrics query these directly
+                   "http_server_requests_errors_5xx",
+                   "http_server_requests_latency",
+                   "http_server_requests_errors_4xx",
+                   "http_server_requests_count"):
+        assert f"namespace_pod_{metric}" in records, metric
+    assert "namespace_app_pod_count" in records
+    assert "namespace_app_per_pod:http_server_requests_count" in records
+
+
+def test_adapter_config_exposes_exporter_series():
+    import re
+
+    [cm] = ALL[os.path.join("custom-metrics", "adapter-config.yaml")]
+    cfg = yaml.safe_load(cm["data"]["config.yaml"])
+    regexes = [
+        r["seriesQuery"].split('"')[1]
+        for r in cfg["rules"]
+        if "__name__" in r["seriesQuery"]
+    ]
+    # every series family the HPA path needs is matched by some rule
+    for series in ("foremastbrain:namespace_app_per_pod:hpa_score",
+                   "foremastbrain:http_server_requests_latency_upper",
+                   "namespace_app_per_pod:http_server_requests_count",
+                   "namespace_app_pod_cpu_usage_seconds_total"):
+        assert any(re.match(rx, series) for rx in regexes), series
+
+
+def test_example_manifests_parse_and_decode():
+    ex = os.path.join(REPO, "examples", "k8s")
+    docs = []
+    for path in glob.glob(os.path.join(ex, "*.yaml")):
+        with open(path) as f:
+            docs += [d for d in yaml.safe_load_all(f) if d]
+    kinds = {d["kind"] for d in docs}
+    assert {"Deployment", "Service", "HorizontalPodAutoscaler",
+            "DeploymentMonitor"} <= kinds
+    # the continuous example decodes through the operator codec
+    mon = next(d for d in docs if d["kind"] == "DeploymentMonitor")
+    m = K._monitor_from_k8s(mon)
+    assert m.spec.continuous is True
+    assert m.spec.remediation.option == "AutoPause"
+    # the monitor CRD schema accepts it
+    schema = _crd_schema("deploymentmonitor.yaml")
+    _validate(schema, {**{k: v for k, v in mon.items() if k != "metadata"},
+                       "metadata": {}}, "$")
+    # the HPA demo targets the exporter's hpa_score series at 50
+    hpas = [d for d in docs if d["kind"] == "HorizontalPodAutoscaler"]
+    score_hpa = next(
+        h for h in hpas
+        if h["spec"]["metrics"][0]["external"]["metric"]["name"]
+        == "foremastbrain:namespace_app_per_pod:hpa_score"
+    )
+    assert score_hpa["spec"]["metrics"][0]["external"]["target"]["value"] == "50"
+    # v1 vs v2 demo deployments differ only in env (the operator's diff)
+    def tmpl(name):
+        with open(os.path.join(ex, name)) as f:
+            d = next(x for x in yaml.safe_load_all(f) if x["kind"] == "Deployment")
+        return d["spec"]["template"]["spec"]["containers"][0]
+    v1, v2 = tmpl("demo-v1.yaml"), tmpl("demo-v2-bad.yaml")
+    assert v1["image"] == v2["image"]
+    e1 = {e["name"]: e["value"] for e in v1["env"]}
+    e2 = {e["name"]: e["value"] for e in v2["env"]}
+    assert e1["DEMO_ERROR5XX_PER_SECOND"] == "0"
+    assert float(e2["DEMO_ERROR5XX_PER_SECOND"]) > 0
+
+
+def test_stack_wiring_is_consistent():
+    runtime_docs = ALL[os.path.join("stack", "20-runtime.yaml")]
+    operator_docs = ALL[os.path.join("stack", "30-operator.yaml")]
+    dep = next(d for d in runtime_docs if d["kind"] == "Deployment")
+    svc = next(d for d in runtime_docs if d["kind"] == "Service")
+    assert svc["spec"]["selector"] == dep["spec"]["selector"]["matchLabels"]
+    [op] = operator_docs
+    env = {e["name"]: e.get("value", "") for e in
+           op["spec"]["template"]["spec"]["containers"][0]["env"]}
+    # operator must point at the runtime service, in the stack namespace
+    assert svc["metadata"]["name"] in env["ANALYST_ENDPOINT"]
+    assert svc["metadata"]["namespace"] == "foremast-tpu"
+    assert op["spec"]["template"]["spec"]["serviceAccountName"] == \
+        "foremast-tpu-operator"
+    # RBAC binds that service account
+    rbac = ALL[os.path.join("stack", "10-rbac.yaml")]
+    binding = next(d for d in rbac if d["kind"] == "ClusterRoleBinding")
+    assert binding["subjects"][0]["name"] == "foremast-tpu-operator"
+    role = next(d for d in rbac if d["kind"] == "ClusterRole")
+    crd_rule = next(r for r in role["rules"]
+                    if "deployment.foremast.ai" in r.get("apiGroups", []))
+    assert {"deploymentmonitors", "deploymentmetadatas"} <= set(crd_rule["resources"])

@@ -599,3 +599,78 @@ def test_flagship_rollout_unhealthy_rollback_e2e():
     d = kube.get_deployment("default", "demo")
     assert d["spec"]["template"]["spec"]["containers"][0]["image"] == "app:r1"
     assert any(e["reason"] == "ForemastRollback" for e in kube.events)
+
+
+def test_kubeclient_upsert_writes_status_subresource():
+    """The CRD declares a status subresource, so upsert must write /status
+    separately or verdicts are dropped; spec and status ride disjoint
+    merge-patches so neither write clobbers the other's fields."""
+    from foremast_tpu.operator.kube import KubeClient
+
+    calls = []
+
+    def fake_req(method, path, body=None, content_type=None):
+        calls.append((method, path, body, content_type))
+        return {}
+
+    client = KubeClient.__new__(KubeClient)
+    client._req = fake_req
+    m = DeploymentMonitor(name="demo", namespace="default")
+    m.status.phase = PHASE_RUNNING
+    client.upsert_monitor(m)
+    base = "/apis/deployment.foremast.ai/v1alpha1/namespaces/default/deploymentmonitors"
+    assert [(c[0], c[1]) for c in calls] == [
+        ("PATCH", f"{base}/demo"),
+        ("PATCH", f"{base}/demo/status"),
+    ]
+    spec_patch, status_patch = calls[0][2], calls[1][2]
+    assert "status" not in spec_patch and spec_patch["spec"] is not None
+    assert set(status_patch) == {"status"}
+    assert status_patch["status"]["phase"] == PHASE_RUNNING
+    assert all(c[3] == "application/merge-patch+json" for c in calls)
+
+    # create path: PATCH misses -> POST full body -> PATCH /status
+    calls.clear()
+
+    def fake_req2(method, path, body=None, content_type=None):
+        calls.append((method, path, body, content_type))
+        if method == "PATCH" and not path.endswith("/status") and len(calls) == 1:
+            from foremast_tpu.operator.kube import KubeError
+            raise KubeError("404")
+        return {}
+
+    client._req = fake_req2
+    client.upsert_monitor(m)
+    assert [(c[0], c[1]) for c in calls] == [
+        ("PATCH", f"{base}/demo"),
+        ("POST", base),
+        ("PATCH", f"{base}/demo/status"),
+    ]
+
+
+def test_kubeclient_patch_monitor_is_subset_merge():
+    from foremast_tpu.operator.kube import KubeClient
+
+    calls = []
+    client = KubeClient.__new__(KubeClient)
+    client._req = lambda m, p, b=None, content_type=None: calls.append(
+        (m, p, b, content_type)
+    )
+    client.patch_monitor("default", "demo", {"spec": {"continuous": True}})
+    (method, path, body, ct) = calls[0]
+    assert method == "PATCH" and path.endswith("/deploymentmonitors/demo")
+    assert body == {"spec": {"continuous": True}}
+    assert ct == "application/merge-patch+json"
+
+
+def test_fakekube_patch_monitor_preserves_untouched_fields():
+    kube = FakeKube()
+    m = DeploymentMonitor(name="demo", namespace="default")
+    m.status.phase = PHASE_RUNNING
+    m.status.job_id = "j-9"
+    kube.upsert_monitor(m)
+    kube.patch_monitor("default", "demo", {"spec": {"continuous": True}})
+    got = kube.get_monitor("default", "demo")
+    assert got.spec.continuous is True
+    assert got.status.phase == PHASE_RUNNING  # untouched by the spec patch
+    assert got.status.job_id == "j-9"
