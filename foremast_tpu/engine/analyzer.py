@@ -44,6 +44,7 @@ from ..ops.windowing import (
     resample_to_grid,
 )
 from ..parallel import fleet as fl
+from ..utils import tracing
 from ..utils.timeutils import from_rfc3339
 from . import jobs as J
 from .config import EngineConfig, MetricPolicy
@@ -637,28 +638,34 @@ class Analyzer:
     # ------------------------------------------------------------- verdict
     def run_cycle(self, worker: str = "worker-0", now: float | None = None) -> dict:
         """One engine cycle. Returns {job_id: new_status} for observability."""
+        with tracing.span("engine.cycle", worker=worker):
+            return self._run_cycle(worker, now)
+
+    def _run_cycle(self, worker: str, now: float | None) -> dict:
         now = time.time() if now is None else now
-        claimed = self.store.claim_open_jobs(
-            worker, max_stuck_seconds=self.config.max_stuck_seconds
-        )
+        with tracing.span("engine.claim"):
+            claimed = self.store.claim_open_jobs(
+                worker, max_stuck_seconds=self.config.max_stuck_seconds
+            )
         states: dict[str, _JobState] = {}
         all_pairs: list[_PairItem] = []
         all_bands: list[_BandItem] = []
         all_bis: list[_BiItem] = []
         all_multis: list[_MultiItem] = []
         all_hpas: list[_HpaItem] = []
-        for doc in claimed:
-            st = _JobState(doc)
-            states[doc.id] = st
-            try:
-                pairs, bands, bis, multis, hpas = self._preprocess(doc, now)
-                all_pairs += pairs
-                all_bands += bands
-                all_bis += bis
-                all_multis += multis
-                all_hpas += hpas
-            except FetchError as e:
-                st.failed = str(e)
+        with tracing.span("engine.preprocess", jobs=len(claimed)):
+            for doc in claimed:
+                st = _JobState(doc)
+                states[doc.id] = st
+                try:
+                    pairs, bands, bis, multis, hpas = self._preprocess(doc, now)
+                    all_pairs += pairs
+                    all_bands += bands
+                    all_bis += bis
+                    all_multis += multis
+                    all_hpas += hpas
+                except FetchError as e:
+                    st.failed = str(e)
         for doc_id, st in states.items():
             if st.failed:
                 if st.doc.strategy in CONTINUOUS_STRATEGIES:
@@ -677,11 +684,14 @@ class Analyzer:
                 self.store.transition(doc_id, J.POSTPROCESS_INPROGRESS, worker=worker)
 
         live = {k: v for k, v in states.items() if not v.failed}
-        pair_res, pair_bad = self._isolate(self._score_pairs, all_pairs)
-        band_res, band_bad = self._isolate(self._score_bands, all_bands)
-        bi_res, bi_bad = self._isolate(self._score_bivariate, all_bis)
-        multi_res, multi_bad = self._isolate(self._score_multi, all_multis)
-        hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
+        with tracing.span("engine.score", pairs=len(all_pairs),
+                          bands=len(all_bands), bis=len(all_bis),
+                          multis=len(all_multis), hpas=len(all_hpas)):
+            pair_res, pair_bad = self._isolate(self._score_pairs, all_pairs)
+            band_res, band_bad = self._isolate(self._score_bands, all_bands)
+            bi_res, bi_bad = self._isolate(self._score_bivariate, all_bis)
+            multi_res, multi_bad = self._isolate(self._score_multi, all_multis)
+            hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
         scoring_failed = {**pair_bad, **band_bad, **bi_bad, **multi_bad, **hpa_bad}
 
         # fold per-metric results into per-job verdicts

@@ -7,7 +7,9 @@ common tags, the CommonMetricsFilter whitelist/blacklist/prefix/tag-rule
 semantics with runtime enable/disable, and a WSGI middleware exporting
 /actuator/prometheus.
 """
+from .asgi import AsgiMetricsMiddleware
 from .registry import CommonMetricsFilter, MetricsRegistry
 from .wsgi import MetricsMiddleware
 
-__all__ = ["MetricsRegistry", "CommonMetricsFilter", "MetricsMiddleware"]
+__all__ = ["MetricsRegistry", "CommonMetricsFilter", "MetricsMiddleware",
+           "AsgiMetricsMiddleware"]

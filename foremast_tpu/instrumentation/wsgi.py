@@ -13,48 +13,23 @@ actuator endpoints (SURVEY.md §2.5):
   * GET /actuator/prometheus — scrape endpoint.
   * POST|GET /k8s-metrics/enable/<metric> and /disable/<metric> — the
     runtime toggle actuator (K8sMetricsEndpoint.java:10-35).
+
+Registration, uri-tag bounding, and toggle parsing live in
+base.MetricsMiddlewareBase, shared with the ASGI twin.
 """
 from __future__ import annotations
 
-import os
 import time
 
-from .registry import CommonMetricsFilter, MetricsRegistry
+from .base import DEFAULT_INIT_STATUSES, HTTP_SERVER_REQUESTS, MetricsMiddlewareBase
 
-HTTP_SERVER_REQUESTS = "http_server_requests"
+__all__ = ["MetricsMiddleware", "HTTP_SERVER_REQUESTS", "CALLER_HEADER",
+           "DEFAULT_INIT_STATUSES"]
+
 CALLER_HEADER = "HTTP_X_CALLER"
-DEFAULT_INIT_STATUSES = (403, 404, 501, 502)
 
 
-class MetricsMiddleware:
-    def __init__(self, app, registry: MetricsRegistry | None = None,
-                 app_name: str | None = None,
-                 caller_enabled: bool = True,
-                 init_statuses=DEFAULT_INIT_STATUSES,
-                 scrape_path: str = "/actuator/prometheus",
-                 toggle_prefix: str = "/k8s-metrics",
-                 uri_templates: list | None = None,
-                 max_uris: int = 100):
-        self.app = app
-        name = app_name or os.environ.get("APP_NAME", "")
-        common = {"app": name} if name else {}
-        self.registry = registry or MetricsRegistry(common_tags=common)
-        self.caller_enabled = caller_enabled
-        self.scrape_path = scrape_path
-        self.toggle_prefix = toggle_prefix
-        # uri-tag cardinality bound: raw paths are attacker-controlled, so
-        # either a route whitelist (the starter tags templated routes) or a
-        # distinct-path cap; overflow lands in the '/**' bucket
-        self.uri_templates = uri_templates
-        self.max_uris = max_uris
-        self._seen_uris: set[str] = set()
-        for code in init_statuses or ():
-            tags = {"exception": "None", "method": "GET", "status": str(code),
-                    "uri": "/**"}
-            if caller_enabled:
-                tags["caller"] = "*"
-            self.registry.timer(HTTP_SERVER_REQUESTS, tags, seconds=None)
-
+class MetricsMiddleware(MetricsMiddlewareBase):
     def __call__(self, environ, start_response):
         path = environ.get("PATH_INFO", "/")
         if path == self.scrape_path:
@@ -66,7 +41,13 @@ class MetricsMiddleware:
             )
             return [body]
         if path.startswith(self.toggle_prefix + "/"):
-            return self._toggle(path, start_response)
+            status, msg = self._toggle_action(path)
+            body = msg.encode()
+            start_response(
+                "200 OK" if status == 200 else "404 Not Found",
+                [("Content-Length", str(len(body)))],
+            )
+            return [body]
 
         t0 = time.perf_counter()
         status_holder = {"status": "200", "exc": "None"}
@@ -85,16 +66,6 @@ class MetricsMiddleware:
         self._record(environ, status_holder, t0)
         return result
 
-    def _uri_tag(self, path: str) -> str:
-        if self.uri_templates is not None:
-            return path if path in self.uri_templates else "/**"
-        if path in self._seen_uris:
-            return path
-        if len(self._seen_uris) < self.max_uris:
-            self._seen_uris.add(path)
-            return path
-        return "/**"
-
     def _record(self, environ, holder, t0):
         tags = {
             "exception": holder["exc"],
@@ -105,20 +76,3 @@ class MetricsMiddleware:
         if self.caller_enabled:
             tags["caller"] = environ.get(CALLER_HEADER, "unknown")
         self.registry.timer(HTTP_SERVER_REQUESTS, tags, time.perf_counter() - t0)
-
-    def _toggle(self, path, start_response):
-        rest = path[len(self.toggle_prefix) + 1:]
-        action, _, metric = rest.partition("/")
-        if action == "enable" and metric:
-            self.registry.filter.enable_metric(metric)
-            msg = f"enabled {metric}"
-        elif action == "disable" and metric:
-            self.registry.filter.disable_metric(metric)
-            msg = f"disabled {metric}"
-        else:
-            body = b"not found"
-            start_response("404 Not Found", [("Content-Length", "9")])
-            return [body]
-        body = msg.encode()
-        start_response("200 OK", [("Content-Length", str(len(body)))])
-        return [body]

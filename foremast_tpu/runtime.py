@@ -103,6 +103,18 @@ class Runtime:
 
 
 def main():
+    from .parallel.distributed import host_info, initialize
+
+    # multi-host (DCN) deploys join the jax.distributed world here; plain
+    # single-host deploys fall straight through
+    if initialize():
+        hi = host_info()
+        print(
+            f"[foremast-tpu] multi-host: process {hi.process_id}/"
+            f"{hi.num_processes}, {hi.local_devices} local / "
+            f"{hi.global_devices} global devices",
+            flush=True,
+        )
     rt = Runtime(
         snapshot_path=os.environ.get("SNAPSHOT_PATH") or None,
         query_endpoint=os.environ.get("QUERY_SERVICE_ENDPOINT", ""),

@@ -31,7 +31,7 @@ import re
 import threading
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from ..dataplane.exporter import VerdictExporter
 from ..dataplane.promql import (
@@ -240,7 +240,15 @@ class ForemastService:
             return 502, {"error": f"query proxy failed: {e}"}
 
     def metrics(self):
-        return 200, self.exporter.render()
+        from ..utils.tracing import tracer
+
+        # verdict series + host-side span aggregates in one scrape
+        return 200, self.exporter.render() + tracer.render_metrics()
+
+    def debug_traces(self, limit: int = 50):
+        from ..utils.tracing import tracer
+
+        return 200, {"traces": tracer.snapshot(limit), "stats": tracer.stats()}
 
     def dashboard(self):
         try:
@@ -288,6 +296,13 @@ def make_server(service: ForemastService, host: str = "0.0.0.0", port: int = 809
                     self._send(status, payload, content_type=ct)
                 elif parsed.path == "/metrics":
                     self._send(*service.metrics())
+                elif parsed.path == "/debug/traces":
+                    q = parse_qs(parsed.query)
+                    try:
+                        limit = int(q.get("limit", ["50"])[0])
+                    except ValueError:
+                        limit = 50
+                    self._send(*service.debug_traces(limit))
                 elif parts[:3] == ["v1", "healthcheck", "id"] and len(parts) == 4:
                     self._send(*service.status(parts[3]))
                 elif parts[:1] == ["alert"] and len(parts) == 4:

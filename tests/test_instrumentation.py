@@ -145,3 +145,102 @@ def test_demo_generators_produce_error_series():
         if "seconds_count" in l and 'status="502"' in l and 'caller="errorgen"' in l
     )
     assert float(line.rsplit(" ", 1)[1]) == 25
+
+
+# ---------------------------------------------------------------- ASGI twin
+def _run(coro):
+    import asyncio
+
+    return asyncio.run(coro)
+
+
+def _asgi_call(mw, path, method="GET", headers=(), raise_exc=False):
+    """Drive one request; returns (status, body)."""
+    out = {"status": None, "body": b""}
+
+    async def app(scope, receive, send):
+        if raise_exc:
+            raise RuntimeError("boom")
+        await send({"type": "http.response.start",
+                    "status": 502 if path == "/error5xx" else 200,
+                    "headers": []})
+        await send({"type": "http.response.body", "body": b"ok"})
+
+    async def send(message):
+        if message["type"] == "http.response.start":
+            out["status"] = message["status"]
+        else:
+            out["body"] += message.get("body", b"")
+
+    async def receive():
+        return {"type": "http.request"}
+
+    m = mw(app)
+    scope = {"type": "http", "path": path, "method": method,
+             "headers": [(k.encode(), v.encode()) for k, v in headers]}
+
+    async def drive():
+        await m(scope, receive, send)
+
+    if raise_exc:
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            _run(drive())
+    else:
+        _run(drive())
+    return m, out["status"], out["body"]
+
+
+def test_asgi_records_same_series_as_wsgi():
+    from foremast_tpu.instrumentation import AsgiMetricsMiddleware
+
+    registry = MetricsRegistry(common_tags={"app": "demo"})
+    mw = lambda app: AsgiMetricsMiddleware(app, registry=registry)  # noqa: E731
+    _asgi_call(mw, "/error5xx", headers=[("x-caller", "loadgen")])
+    text = registry.render()
+    assert 'status="502"' in text
+    assert 'caller="loadgen"' in text
+    assert 'app="demo"' in text
+    assert "http_server_requests_seconds_count" in text
+    # pre-registered error statuses exist at zero (starter parity)
+    assert 'status="404"' in text
+
+
+def test_asgi_scrape_and_toggle_endpoints():
+    from foremast_tpu.instrumentation import AsgiMetricsMiddleware
+
+    registry = MetricsRegistry()
+    m, status, body = _asgi_call(
+        lambda app: AsgiMetricsMiddleware(app, registry=registry), "/")
+    # scrape endpoint returns the rendered registry
+    _, status2, body2 = _asgi_call(lambda app: m, "/actuator/prometheus")
+    assert status2 == 200 and b"http_server_requests" in body2
+    _, status3, body3 = _asgi_call(lambda app: m, "/k8s-metrics/disable/http_server_requests")
+    assert status3 == 200 and b"disabled" in body3
+    _, status4, _ = _asgi_call(lambda app: m, "/k8s-metrics/bogus")
+    assert status4 == 404
+
+
+def test_asgi_exception_tagged_500():
+    from foremast_tpu.instrumentation import AsgiMetricsMiddleware
+
+    registry = MetricsRegistry()
+    _asgi_call(lambda app: AsgiMetricsMiddleware(app, registry=registry),
+               "/x", raise_exc=True)
+    text = registry.render()
+    assert 'status="500"' in text
+    assert 'exception="RuntimeError"' in text
+
+
+def test_asgi_passes_through_non_http_scopes():
+    from foremast_tpu.instrumentation import AsgiMetricsMiddleware
+
+    called = {}
+
+    async def app(scope, receive, send):
+        called["scope"] = scope["type"]
+
+    m = AsgiMetricsMiddleware(app, registry=MetricsRegistry())
+    _run(m({"type": "lifespan"}, None, None))
+    assert called["scope"] == "lifespan"

@@ -1,0 +1,192 @@
+"""Tracing spans + multi-host helpers — the aux subsystems the reference
+lacks (SURVEY.md §5: no tracing implemented; distribution = shared-nothing
+workers). Covers span nesting/aggregation, the /debug/traces and /metrics
+surfaces, engine-cycle instrumentation, and process-slice math.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from foremast_tpu.utils.tracing import Tracer
+
+
+def test_span_nesting_builds_one_trace_tree():
+    tr = Tracer()
+    with tr.span("cycle", worker="w0"):
+        with tr.span("claim"):
+            pass
+        with tr.span("score", pairs=3):
+            with tr.span("batch"):
+                pass
+    [trace] = tr.snapshot()
+    assert trace["name"] == "cycle"
+    assert trace["attrs"] == {"worker": "w0"}
+    names = [c["name"] for c in trace["children"]]
+    assert names == ["claim", "score"]
+    score = trace["children"][1]
+    assert [c["name"] for c in score["children"]] == ["batch"]
+    assert trace["duration_ms"] >= score["duration_ms"] >= 0
+
+
+def test_stats_aggregate_and_render():
+    tr = Tracer()
+    for _ in range(3):
+        with tr.span("fetch"):
+            pass
+    st = tr.stats()["fetch"]
+    assert st["count"] == 3
+    assert st["max_seconds"] <= st["total_seconds"] + 1e-9
+    text = tr.render_metrics()
+    assert 'foremast_trace_count{span="fetch"} 3' in text
+
+
+def test_span_records_even_when_body_raises():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    [trace] = tr.snapshot()
+    assert trace["name"] == "boom" and trace["duration_ms"] >= 0
+    assert tr.stats()["boom"]["count"] == 1
+
+
+def test_ring_buffer_bounded():
+    tr = Tracer(max_traces=5)
+    for i in range(12):
+        with tr.span(f"t{i}"):
+            pass
+    snap = tr.snapshot()
+    assert len(snap) == 5
+    assert snap[-1]["name"] == "t11"
+
+
+def test_threads_get_independent_span_stacks():
+    tr = Tracer()
+    errs = []
+
+    def work(i):
+        try:
+            with tr.span(f"root{i}"):
+                with tr.span("child"):
+                    pass
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    roots = {t["name"] for t in tr.snapshot()}
+    assert roots == {f"root{i}" for i in range(8)}
+    # every root got exactly its own child, none were cross-adopted
+    assert all(len(t.get("children", [])) == 1 for t in tr.snapshot())
+
+
+def test_engine_cycle_emits_spans_and_service_exposes_them():
+    from foremast_tpu.dataplane import FixtureDataSource, VerdictExporter
+    from foremast_tpu.engine import Analyzer, Document, EngineConfig, JobStore, MetricQueries
+    from foremast_tpu.service.api import ForemastService
+    from foremast_tpu.utils.tracing import tracer
+
+    tracer.reset()
+    rng = np.random.default_rng(0)
+    ts = list(np.arange(30) * 60.0)
+    fixtures = {
+        "u-cur": (ts, list(rng.normal(5.0, 0.3, 30))),
+        "u-base": (ts, list(rng.normal(0.5, 0.05, 30))),
+    }
+    store = JobStore()
+    store.create(Document(id="j", app_name="a", namespace="d", strategy="canary",
+                          start_time="1970-01-01T00:00:00Z",
+                          end_time="1970-01-01T00:30:00Z",
+                          metrics={"error5xx": MetricQueries(current="u-cur",
+                                                             baseline="u-base")}))
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store,
+                        VerdictExporter())
+    analyzer.run_cycle(now=10_000.0)
+    [trace] = [t for t in tracer.snapshot() if t["name"] == "engine.cycle"]
+    child_names = {c["name"] for c in trace["children"]}
+    assert {"engine.claim", "engine.preprocess", "engine.score"} <= child_names
+
+    svc = ForemastService(store, exporter=VerdictExporter())
+    status, payload = svc.debug_traces()
+    assert status == 200
+    assert any(t["name"] == "engine.cycle" for t in payload["traces"])
+    status, text = svc.metrics()
+    assert 'foremast_trace_count{span="engine.cycle"}' in text
+
+
+# ---------------------------------------------------------------- distributed
+def test_process_batch_slice_partitions_evenly():
+    from foremast_tpu.parallel.distributed import HostInfo, process_batch_slice
+
+    slices = [
+        process_batch_slice(32, HostInfo(process_id=i, num_processes=4,
+                                         local_devices=2, global_devices=8))
+        for i in range(4)
+    ]
+    covered = []
+    for s in slices:
+        covered += list(range(32))[s]
+    assert covered == list(range(32))
+    with pytest.raises(ValueError):
+        process_batch_slice(33, HostInfo(0, 4, 2, 8))
+
+
+def test_initialize_single_host_is_noop():
+    from foremast_tpu.parallel import distributed
+
+    assert distributed.initialize(env={}) is False  # no coordinator config
+
+
+def test_initialize_partial_config_degrades_to_single_host(capsys):
+    """A templated NUM_PROCESSES=1 or a lone COORDINATOR_ADDRESS must not
+    crash the runtime at boot — warn and continue local."""
+    from foremast_tpu.parallel import distributed
+
+    assert distributed.initialize(env={"NUM_PROCESSES": "1"}) is False
+    assert distributed.initialize(
+        env={"COORDINATOR_ADDRESS": "10.0.0.2:8476"}) is False
+    assert "incomplete multi-host config" in capsys.readouterr().out
+
+
+def test_initialize_passes_explicit_world(monkeypatch):
+    from foremast_tpu.parallel import distributed
+
+    calls = {}
+
+    def fake_init(**kw):
+        calls.update(kw)
+
+    monkeypatch.setattr(distributed.jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    ok = distributed.initialize(env={
+        "COORDINATOR_ADDRESS": "10.0.0.2:8476",
+        "NUM_PROCESSES": "4",
+        "PROCESS_ID": "1",
+        "LOCAL_DEVICE_IDS": "0,1",
+    })
+    assert ok is True
+    assert calls == {
+        "coordinator_address": "10.0.0.2:8476",
+        "num_processes": 4,
+        "process_id": 1,
+        "local_device_ids": [0, 1],
+    }
+    # second call is a no-op
+    assert distributed.initialize(env={}) is False
+    monkeypatch.setattr(distributed, "_initialized", False)
+
+
+def test_global_fleet_mesh_spans_all_devices():
+    import jax
+
+    from foremast_tpu.parallel.distributed import global_fleet_mesh
+
+    mesh = global_fleet_mesh()
+    assert mesh.devices.size == len(jax.devices())
