@@ -26,6 +26,8 @@ import threading
 import time
 from dataclasses import asdict, dataclass, field
 
+from .archive import _match
+
 
 # --- internal status machine -------------------------------------------------
 INITIAL = "initial"
@@ -113,6 +115,7 @@ class Document:
     modified_at: float = field(default_factory=time.time)
     lease_holder: str = ""
     lease_at: float = 0.0
+    archived_at: float = 0.0  # >0 once the archive confirmed the write
 
     def to_json(self) -> dict:
         d = asdict(self)
@@ -138,13 +141,21 @@ class HpaLog:
 
 
 class JobStore:
-    """Thread-safe job + hpalog store with lease-based work stealing."""
+    """Thread-safe job + hpalog store with lease-based work stealing.
 
-    def __init__(self, snapshot_path: str | None = None):
+    `archive` (engine/archive.py) is an optional write-behind sink: every
+    terminal transition and hpalog is mirrored there, which is what makes
+    `gc()` safe — terminal jobs older than the retention window are pruned
+    from memory because their record of truth lives in the archive (ES's
+    role in the reference; it never pruned, but it also wasn't RAM).
+    """
+
+    def __init__(self, snapshot_path: str | None = None, archive=None):
         self._lock = threading.RLock()
         self._jobs: dict[str, Document] = {}
         self._hpalogs: list[HpaLog] = []
         self._snapshot_path = snapshot_path
+        self.archive = archive
         self._dirty = False
         self._last_write = 0.0
         if snapshot_path:
@@ -183,7 +194,17 @@ class JobStore:
                 doc.lease_holder = worker
                 doc.lease_at = doc.modified_at
             self._persist()
-            return doc
+            archive_rec = (
+                doc.to_json()
+                if self.archive is not None and new_status in TERMINAL_STATUSES
+                else None
+            )
+        # archive I/O OUTSIDE the lock: a slow/unreachable archive must not
+        # stall claim/create/status for every other worker and API thread.
+        # Terminal docs never transition again, so the record is stable.
+        if archive_rec is not None and self.archive.index_job(archive_rec):
+            doc.archived_at = time.time()
+        return doc
 
     def claim_open_jobs(self, worker: str, limit: int = 1024,
                         max_stuck_seconds: float = 90.0) -> list[Document]:
@@ -230,6 +251,70 @@ class JobStore:
             if len(self._hpalogs) > keep_last:
                 self._hpalogs = self._hpalogs[-keep_last:]
             self._persist()
+        if self.archive is not None:
+            self.archive.index_hpalog(asdict(log))
+
+    def gc(self, max_age_seconds: float = 24 * 3600.0,
+           now: float | None = None) -> int:
+        """Prune terminal jobs older than the retention window.
+
+        A job is only dropped once the archive has CONFIRMED holding its
+        terminal record (archived_at > 0) — jobs resumed from an
+        older snapshot, or whose archive write failed, are (re)archived
+        here first and survive in RAM until that succeeds. Without an
+        archive nothing is ever pruned. Returns the number dropped.
+        """
+        if self.archive is None:
+            return 0
+        now = time.time() if now is None else now
+        with self._lock:
+            candidates = [
+                doc for doc in self._jobs.values()
+                if doc.status in TERMINAL_STATUSES
+                and now - doc.modified_at > max_age_seconds
+            ]
+        dropped = 0
+        for doc in candidates:  # archive I/O outside the lock
+            if doc.archived_at <= 0:
+                if not self.archive.index_job(doc.to_json()):
+                    continue  # archive unavailable: keep the job in RAM
+                doc.archived_at = time.time()
+            with self._lock:
+                if self._jobs.get(doc.id) is doc:  # not re-created meanwhile
+                    del self._jobs[doc.id]
+                    dropped += 1
+        if dropped:
+            with self._lock:
+                self._persist()
+        return dropped
+
+    def search(self, app=None, namespace=None, status=None, strategy=None,
+               limit: int = 50) -> list[dict]:
+        """Live store + archive, newest first, deduped by job id.
+
+        `status` may be a single internal status or a list of them (one
+        pass either way — the archive is read once).
+        """
+        statuses = ([status] if isinstance(status, str) else
+                    list(status) if status else None)
+        with self._lock:
+            live = [
+                d.to_json() for d in self._jobs.values()
+                if _match({"app_name": d.app_name, "namespace": d.namespace,
+                           "status": d.status, "strategy": d.strategy},
+                          app, namespace, statuses, strategy)
+            ]
+        seen = {r["id"] for r in live}
+        if self.archive is not None:
+            for rec in self.archive.search(app=app, namespace=namespace,
+                                           status=statuses, strategy=strategy,
+                                           limit=limit):
+                rec = {k: v for k, v in rec.items() if k != "_type"}
+                if rec.get("id") not in seen:
+                    live.append(rec)
+                    seen.add(rec.get("id"))
+        live.sort(key=lambda r: r.get("modified_at", 0.0), reverse=True)
+        return live[:limit]
 
     def hpalogs_for(self, job_id: str, limit: int = 20) -> list[HpaLog]:
         with self._lock:

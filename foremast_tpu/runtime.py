@@ -13,6 +13,10 @@ Env surface (union of the reference services'):
   QUERY_SERVICE_ENDPOINT metric-store base for the dashboard proxy
                          (foremast-service/cmd/manager/main.go:301-309)
   SNAPSHOT_PATH          job-store checkpoint file (ES's durability role)
+  ARCHIVE_PATH           JSONL write-behind archive of terminal jobs/hpalogs
+  ES_ENDPOINT            ES-compatible archive instead (reference indices
+                         documents/hpalogs); takes precedence over ARCHIVE_PATH
+  JOB_RETENTION_SECONDS  prune archived terminal jobs from RAM after this
   PORT                   HTTP port (reference :8099)
   CYCLE_SECONDS          engine cycle cadence (brain poll loop)
   WAVEFRONT_PROXY        host[:port] of a Wavefront proxy to mirror the
@@ -43,13 +47,16 @@ class Runtime:
         query_endpoint: str = "",
         cache: bool = True,
         wavefront_sink=None,
+        archive=None,
+        job_retention_seconds: float = 24 * 3600.0,
     ):
         self.config = config or from_env()
         source = data_source or PrometheusDataSource()
         if cache:
             source = CachingDataSource(source, max_entries=self.config.max_cache_size)
         self.source = source
-        self.store = JobStore(snapshot_path=snapshot_path)
+        self.store = JobStore(snapshot_path=snapshot_path, archive=archive)
+        self.job_retention_seconds = job_retention_seconds
         self.exporter = VerdictExporter()
         self.analyzer = Analyzer(
             self.config, self.source, self.store, exporter=self.exporter
@@ -83,6 +90,7 @@ class Runtime:
                 self.analyzer.run_cycle(worker=worker)
                 if self.wavefront_sink is not None:
                     self.wavefront_sink.flush()
+                self.store.gc(max_age_seconds=self.job_retention_seconds)
             except Exception as e:  # noqa: BLE001 - worker must survive a bad cycle
                 print(f"[foremast-tpu] cycle error: {e}", flush=True)
             self._stop.wait(max(0.0, cycle_seconds - (time.time() - t0)))
@@ -102,6 +110,18 @@ class Runtime:
             self.stop()
 
 
+def _env_seconds(name: str, default: float) -> float:
+    """Tolerant env float: empty/malformed values fall back to the default
+    (a templated-empty var must not crashloop the pod)."""
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        print(f"[foremast-tpu] ignoring invalid {name}={raw!r}; "
+              f"using {default}", flush=True)
+        return default
+
+
 def main():
     from .parallel.distributed import host_info, initialize
 
@@ -115,9 +135,22 @@ def main():
             f"{hi.global_devices} global devices",
             flush=True,
         )
+    archive = None
+    es = os.environ.get("ES_ENDPOINT", "")
+    archive_path = os.environ.get("ARCHIVE_PATH", "")
+    if es:
+        from .engine.archive import EsArchive
+
+        archive = EsArchive(es)
+    elif archive_path:
+        from .engine.archive import FileArchive
+
+        archive = FileArchive(archive_path)
     rt = Runtime(
         snapshot_path=os.environ.get("SNAPSHOT_PATH") or None,
         query_endpoint=os.environ.get("QUERY_SERVICE_ENDPOINT", ""),
+        archive=archive,
+        job_retention_seconds=_env_seconds("JOB_RETENTION_SECONDS", 24 * 3600.0),
     )
     proxy = os.environ.get("WAVEFRONT_PROXY", "")
     if proxy:

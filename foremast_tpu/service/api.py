@@ -192,7 +192,23 @@ class ForemastService:
     def status(self, job_id: str):
         doc = self.store.get(job_id)
         if doc is None:
-            return 404, {"error": f"job {job_id} not found"}
+            # a terminal job may have been gc'd from RAM after archival:
+            # the id must stay resolvable as long as /search returns it
+            archive = getattr(self.store, "archive", None)
+            rec = archive.get(job_id) if archive is not None else None
+            if rec is None:
+                return 404, {"error": f"job {job_id} not found"}
+            return 200, {
+                "jobId": rec.get("id", job_id),
+                "appName": rec.get("app_name", ""),
+                "namespace": rec.get("namespace", ""),
+                "strategy": rec.get("strategy", ""),
+                "status": J.to_external(rec.get("status", "")),
+                "statusCode": "200",
+                "reason": rec.get("reason", ""),
+                "anomaly": rec.get("anomaly", {}),
+                "hpalogs": [],
+            }
         logs = self.store.hpalogs_for(job_id)
         return 200, {
             "jobId": doc.id,
@@ -238,6 +254,48 @@ class ForemastService:
                 return 200, r.read().decode()
         except Exception as e:  # noqa: BLE001 - proxy boundary
             return 502, {"error": f"query proxy failed: {e}"}
+
+    def search(self, params: dict):
+        """GET /v1/healthcheck/search — the job-audit surface ES/Kibana
+        provided in the reference (design.md:49-51 there): live store plus
+        the write-behind archive, filterable by app/namespace/status/
+        strategy. `status` accepts internal or external names."""
+        def one(key):
+            v = params.get(key, [""])[0]
+            return v or None
+
+        status = one("status")
+        statuses = None
+        if status:
+            # accept internal names and external aliases; an external name
+            # ("abort") fans out to every internal it covers
+            statuses = [k for k, v in J.EXTERNAL_STATUS.items()
+                        if k == status or v == status]
+            if not statuses:
+                raise ApiError(400, f"unknown status {status!r}")
+        try:
+            limit = int(params.get("limit", ["50"])[0])
+        except ValueError:
+            raise ApiError(400, "invalid limit") from None
+        if not 1 <= limit <= 500:
+            raise ApiError(400, f"limit must be in [1, 500], got {limit}")
+        out = [
+            {
+                "jobId": rec.get("id", ""),
+                "appName": rec.get("app_name", ""),
+                "namespace": rec.get("namespace", ""),
+                "strategy": rec.get("strategy", ""),
+                "status": J.to_external(rec.get("status", "")),
+                "internalStatus": rec.get("status", ""),
+                "reason": rec.get("reason", ""),
+                "modifiedAt": rec.get("modified_at", 0.0),
+            }
+            for rec in self.store.search(
+                app=one("appName"), namespace=one("namespace"),
+                status=statuses, strategy=one("strategy"), limit=limit,
+            )
+        ]
+        return 200, {"jobs": out}
 
     def metrics(self):
         from ..utils.tracing import tracer
@@ -303,6 +361,8 @@ def make_server(service: ForemastService, host: str = "0.0.0.0", port: int = 809
                     except ValueError:
                         limit = 50
                     self._send(*service.debug_traces(limit))
+                elif parts == ["v1", "healthcheck", "search"]:
+                    self._send(*service.search(parse_qs(parsed.query)))
                 elif parts[:3] == ["v1", "healthcheck", "id"] and len(parts) == 4:
                     self._send(*service.status(parts[3]))
                 elif parts[:1] == ["alert"] and len(parts) == 4:

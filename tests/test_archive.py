@@ -1,0 +1,238 @@
+"""Job archive (the reference's Elasticsearch role): write-behind of
+terminal jobs + hpalogs, RAM pruning made safe by it, and the
+/v1/healthcheck/search audit surface over live + archived records.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from foremast_tpu.engine import Document, JobStore, MetricQueries
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.archive import EsArchive, FileArchive
+from foremast_tpu.service.api import ApiError, ForemastService
+
+
+def _doc(i, status_chain=(), store=None, app="a", ns="d", modified=None):
+    d = Document(id=f"j{i}", app_name=app, namespace=ns, strategy="canary",
+                 start_time="", end_time="",
+                 metrics={"m": MetricQueries(current="u")})
+    store.create(d)
+    for s in status_chain:
+        store.transition(f"j{i}", s)
+    if modified is not None:
+        d.modified_at = modified
+    return d
+
+
+TERMINAL_CHAIN = (J.PREPROCESS_INPROGRESS, J.PREPROCESS_COMPLETED,
+                  J.POSTPROCESS_INPROGRESS, J.COMPLETED_UNHEALTH)
+
+
+# ---------------------------------------------------------------- FileArchive
+def test_file_archive_roundtrip_and_dedupe(tmp_path):
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    a.index_job({"id": "x", "app_name": "a", "namespace": "d",
+                 "status": "completed_health", "modified_at": 1.0})
+    a.index_job({"id": "x", "app_name": "a", "namespace": "d",
+                 "status": "completed_unhealth", "modified_at": 2.0})
+    a.index_job({"id": "y", "app_name": "b", "namespace": "d",
+                 "status": "completed_health", "modified_at": 3.0})
+    a.index_hpalog({"job_id": "x", "hpascore": 60.0})
+    # last write wins per id; hpalogs don't leak into document search
+    res = a.search()
+    assert [r["id"] for r in res] == ["y", "x"]
+    assert res[1]["status"] == "completed_unhealth"
+    assert a.search(app="b") and a.search(app="b")[0]["id"] == "y"
+    assert a.search(status="completed_unhealth")[0]["id"] == "x"
+    assert a.search(app="nope") == []
+
+
+def test_file_archive_rotation_keeps_one_generation(tmp_path):
+    path = str(tmp_path / "arch.jsonl")
+    a = FileArchive(path, max_bytes=400)
+    for i in range(30):
+        a.index_job({"id": f"j{i}", "app_name": "a", "namespace": "d",
+                     "status": "completed_health", "modified_at": float(i)})
+    import os
+
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 400
+    # newest records always retrievable; oldest may have rotated away
+    res = a.search(limit=500)
+    assert res[0]["id"] == "j29"
+
+
+def test_file_archive_survives_torn_tail_line(tmp_path):
+    path = str(tmp_path / "arch.jsonl")
+    a = FileArchive(path)
+    a.index_job({"id": "ok", "app_name": "a", "namespace": "d",
+                 "status": "completed_health", "modified_at": 1.0})
+    with open(path, "a") as f:
+        f.write('{"_type": "document", "id": "torn"')  # crash mid-write
+    assert [r["id"] for r in a.search()] == ["ok"]
+
+
+# ---------------------------------------------------------------- store hooks
+def test_terminal_transition_indexes_into_archive(tmp_path):
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    store = JobStore(archive=a)
+    _doc(1, TERMINAL_CHAIN, store)
+    recs = a.search()
+    assert len(recs) == 1 and recs[0]["id"] == "j1"
+    assert recs[0]["status"] == J.COMPLETED_UNHEALTH
+    # open jobs are not archived
+    _doc(2, (), store)
+    assert len(a.search(limit=10)) == 1
+
+
+def test_gc_prunes_only_archived_terminal_jobs(tmp_path):
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    store = JobStore(archive=a)
+    _doc(1, TERMINAL_CHAIN, store)
+    _doc(2, (), store)
+    store.get("j1").modified_at = 100.0
+    store.get("j2").modified_at = 100.0
+    assert store.gc(max_age_seconds=3600, now=100.0 + 7200) == 1
+    assert store.get("j1") is None  # pruned from RAM...
+    assert a.search()[0]["id"] == "j1"  # ...but the archive holds it
+    assert store.get("j2") is not None  # open job untouched
+
+    # without an archive gc must refuse to drop history
+    store2 = JobStore()
+    _doc(3, TERMINAL_CHAIN, store2)
+    store2.get("j3").modified_at = 0.0
+    assert store2.gc(max_age_seconds=1, now=1e9) == 0
+    assert store2.get("j3") is not None
+
+
+def test_gc_archives_pre_archive_jobs_before_pruning(tmp_path):
+    """Terminal jobs restored from a snapshot that predates the archive
+    (archived_at == 0) must be written to the archive by gc itself before
+    being dropped — the exact enable-archive rollout scenario."""
+    snap = str(tmp_path / "snap.json")
+    store0 = JobStore(snapshot_path=snap)  # NO archive yet
+    _doc(1, TERMINAL_CHAIN, store0)
+    store0.get("j1").modified_at = 100.0
+    store0.flush()
+
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    store = JobStore(snapshot_path=snap, archive=a)  # archive enabled later
+    assert store.get("j1").archived_at == 0.0
+    assert store.gc(max_age_seconds=3600, now=100.0 + 7200) == 1
+    assert store.get("j1") is None
+    assert a.search()[0]["id"] == "j1"  # archived by gc, not lost
+
+
+def test_gc_keeps_jobs_when_archive_write_fails(tmp_path):
+    class DownArchive:
+        def index_job(self, doc):
+            return False
+
+        def index_hpalog(self, log):
+            return False
+
+        def search(self, **kw):
+            return []
+
+        def get(self, job_id):
+            return None
+
+    store = JobStore(archive=DownArchive())
+    _doc(1, TERMINAL_CHAIN, store)
+    store.get("j1").modified_at = 0.0
+    store.get("j1").archived_at = 0.0  # pretend the write-behind failed too
+    assert store.gc(max_age_seconds=1, now=1e9) == 0
+    assert store.get("j1") is not None  # never dropped without a record
+
+
+def test_store_search_merges_live_and_archive(tmp_path):
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    store = JobStore(archive=a)
+    _doc(1, TERMINAL_CHAIN, store)
+    store.gc(max_age_seconds=1, now=1e9)  # j1 now archive-only
+    _doc(2, (), store)  # created (and thus modified) after j1's archival
+    recs = store.search()
+    assert [r["id"] for r in recs] == ["j2", "j1"]
+    # a job both live and archived appears once (live wins)
+    _doc(3, TERMINAL_CHAIN, store)
+    ids = [r["id"] for r in store.search()]
+    assert ids.count("j3") == 1
+
+
+# ---------------------------------------------------------------- service API
+def test_service_search_endpoint_external_statuses(tmp_path):
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    store = JobStore(archive=a)
+    _doc(1, TERMINAL_CHAIN, store)
+    _doc(2, (), store, app="b")
+    svc = ForemastService(store)
+    status, payload = svc.search({"status": ["anomaly"]})
+    assert status == 200
+    assert [j["jobId"] for j in payload["jobs"]] == ["j1"]
+    assert payload["jobs"][0]["status"] == "anomaly"
+    assert payload["jobs"][0]["internalStatus"] == J.COMPLETED_UNHEALTH
+    status, payload = svc.search({"appName": ["b"]})
+    assert [j["jobId"] for j in payload["jobs"]] == ["j2"]
+    # "abort" is externally overloaded: matches every aborting internal
+    _doc(3, (J.PREPROCESS_INPROGRESS, J.PREPROCESS_FAILED), store)
+    status, payload = svc.search({"status": ["abort"]})
+    assert [j["jobId"] for j in payload["jobs"]] == ["j3"]
+    with pytest.raises(ApiError):
+        svc.search({"status": ["bogus"]})
+    with pytest.raises(ApiError):
+        svc.search({"limit": ["many"]})
+    with pytest.raises(ApiError):
+        svc.search({"limit": ["-1"]})  # would slice live[:-1] unbounded
+    with pytest.raises(ApiError):
+        svc.search({"limit": ["0"]})
+
+
+def test_status_endpoint_falls_back_to_archive(tmp_path):
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    store = JobStore(archive=a)
+    _doc(1, TERMINAL_CHAIN, store)
+    store.get("j1").modified_at = 0.0
+    store.gc(max_age_seconds=1, now=1e9)
+    assert store.get("j1") is None
+    svc = ForemastService(store)
+    status, payload = svc.status("j1")
+    assert status == 200
+    assert payload["jobId"] == "j1"
+    assert payload["status"] == "anomaly"
+    status, _ = svc.status("never-existed")
+    assert status == 404
+
+
+# ---------------------------------------------------------------- EsArchive
+def test_es_archive_requests_and_error_tolerance(monkeypatch):
+    calls = []
+    a = EsArchive("http://es:9200")
+
+    def fake_req(method, path, body=None):
+        calls.append((method, path, body))
+        if path.endswith("/_search"):
+            return {"hits": {"hits": [{"_source": {"id": "j1",
+                                                   "app_name": "a"}}]}}
+        return {}
+
+    monkeypatch.setattr(a, "_req", fake_req)
+    a.index_job({"id": "j1", "app_name": "a"})
+    a.index_hpalog({"job_id": "j1"})
+    res = a.search(app="a", status="completed_health")
+    assert res == [{"id": "j1", "app_name": "a"}]
+    methods_paths = [(m, p) for m, p, _ in calls]
+    assert ("PUT", "/documents/_doc/j1") in methods_paths
+    assert ("POST", "/hpalogs/_doc") in methods_paths
+    (_, _, search_body) = calls[-1]
+    assert {"term": {"app_name.keyword": "a"}} in search_body["query"]["bool"]["must"]
+
+    # network failure: swallowed, counted, never raises
+    def boom(method, path, body=None):
+        raise OSError("down")
+
+    monkeypatch.setattr(a, "_req", boom)
+    a.index_job({"id": "j2"})
+    assert a.search() == []
+    assert a.errors == 2
