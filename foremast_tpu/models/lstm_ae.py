@@ -30,7 +30,8 @@ import jax.numpy as jnp
 import optax
 from flax import linen as nn
 
-__all__ = ["LstmAutoencoder", "TrainState", "init_state", "train_step", "train", "anomaly_scores", "fit_score_normalizer"]
+__all__ = ["LstmAutoencoder", "TrainState", "init_state", "train_step", "train",
+           "anomaly_scores", "fit_score_normalizer", "param_shardings"]
 
 _F = jnp.float32
 
@@ -78,6 +79,33 @@ def _loss_fn(params, model, x, mask, apply_fn):
     se = (recon - x) ** 2 * m
     denom = jnp.maximum(jnp.sum(m), 1.0)
     return jnp.sum(se) / denom
+
+
+def param_shardings(params, mesh, model_axis: str = "model"):
+    """Tensor-parallel NamedSharding pytree for the scorer's parameters.
+
+    Megatron-style column split: every kernel whose output (last) dim is a
+    multiple of the `model` axis size is sharded on that dim — the LSTM
+    gate matmuls and the latent Dense head — while biases and indivisible
+    leaves replicate (the reconstruction head's output dim is the feature
+    count, typically 3-4, so it stays replicated at model_parallel=2).
+    Handing these to jax.device_put /
+    jit's in_shardings is enough: XLA GSPMD partitions the per-step
+    matmuls and inserts the gate all-reduces over ICI, so a scorer whose
+    hidden state outgrows one chip spans several without model changes
+    (the `model` mesh axis reserved in parallel/mesh.py).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis_size = mesh.shape[model_axis]
+
+    def rule(x):
+        if getattr(x, "ndim", 0) >= 2 and x.shape[-1] % axis_size == 0:
+            spec = [None] * (x.ndim - 1) + [model_axis]
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(rule, params)
 
 
 def init_state(model: LstmAutoencoder, rng, T: int, lr: float = 1e-3):
