@@ -34,6 +34,7 @@ from ..dataplane.promql import (
 from ..models import lstm_ae
 from ..ops import bivariate as bv
 from ..ops import forecast as fc
+from ..ops import seqscan as sq
 from ..ops import hpa as hpa_ops
 from ..ops.windowing import (
     MAX_WINDOW_STEPS,
@@ -312,13 +313,25 @@ class Analyzer:
                 }
         return results
 
-    def _predict(self, xv, xm, region):
-        """Forecaster dispatch on config.algorithm (history-only fit)."""
+    def _predict(self, xv, xm, region, data_steps: int | None = None):
+        """Forecaster dispatch on config.algorithm (history-only fit).
+
+        `data_steps` is the UNPADDED series length: the long-window gate
+        must see real data size, not the bucket the batch was padded to,
+        or padding alone would flip the kernel choice.
+        """
         algo = self.config.algorithm
         hist_mask = xm & ~region
         B = xv.shape[0]
+        # long windows: same smoother, time-parallel (associative scan).
+        # SES only — the DES associative form compounds f32 rounding on
+        # trending series (~4e-3 relative at T>=4096, enough to flip a
+        # borderline band verdict), so DES always runs sequentially here.
+        long = (data_steps if data_steps is not None
+                else xv.shape[1]) >= self.config.long_window_steps
         if algo.startswith("exponential_smoothing"):
-            preds = fc.ses_predictions(xv, hist_mask, np.full(B, 0.3, np.float32))
+            ses = sq.ses_predictions_assoc if long else fc.ses_predictions
+            preds = ses(xv, hist_mask, np.full(B, 0.3, np.float32))
         elif algo.startswith("double_exponential"):
             preds = fc.des_predictions(
                 xv, hist_mask, np.full(B, 0.5, np.float32), np.full(B, 0.1, np.float32)
@@ -359,7 +372,8 @@ class Analyzer:
                 concats.append(Window(vals, mask, h.start, h.step))
                 regions[i, n_h : vals.shape[0]] = True
             xv, xm = pack_windows(concats, pad_to=T)
-            preds, hist_mask = self._predict(xv, xm, regions)
+            data_steps = max(w.values.shape[0] for w in concats)
+            preds, hist_mask = self._predict(xv, xm, regions, data_steps)
             sigma = np.asarray(fc.residual_sigma(xv, preds, hist_mask, ~regions))
             out = fc.band_anomalies(
                 xv, xm, regions, preds, sigma,

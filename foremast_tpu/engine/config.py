@@ -49,6 +49,9 @@ class EngineConfig:
     max_stuck_seconds: float = 90.0  # MAX_STUCK_IN_SECONDS
     max_cache_size: int = 1024  # MAX_CACHE_SIZE (model/window cache entries)
     ma_window: int = 30  # moving-average lookback (steps)
+    # windows at/above this length use the time-parallel associative-scan
+    # smoothers (ops/seqscan.py) instead of sequential lax.scan
+    long_window_steps: int = 4096  # LONG_WINDOW_STEPS
     hw_period: int = 1440  # Holt-Winters / seasonal-trend period (steps; 1 day at 60s)
     st_order: int = 3  # seasonal-trend (prophet) Fourier order
     # LSTM-autoencoder multivariate mode (3+ metrics; faq.md:8-10)
@@ -152,6 +155,7 @@ def from_env(env=None) -> EngineConfig:
         max_stuck_seconds=_env_float(env, "MAX_STUCK_IN_SECONDS", 90.0),
         max_cache_size=_env_int(env, "MAX_CACHE_SIZE", 1024),
         ma_window=_env_int(env, "MA_WINDOW", 30),
+        long_window_steps=_env_int(env, "LONG_WINDOW_STEPS", 4096),
         hw_period=_env_int(env, "HW_PERIOD", 1440),
         st_order=_env_int(env, "ST_ORDER", 3),
         lstm_window=_env_int(env, "LSTM_WINDOW", 32),

@@ -81,26 +81,34 @@ def _loss_fn(params, model, x, mask, apply_fn):
     return jnp.sum(se) / denom
 
 
-def param_shardings(params, mesh, model_axis: str = "model"):
+def param_shardings(params, mesh, model_axis: str | None = None,
+                    min_shard_width: int = 8):
     """Tensor-parallel NamedSharding pytree for the scorer's parameters.
 
     Megatron-style column split: every kernel whose output (last) dim is a
-    multiple of the `model` axis size is sharded on that dim — the LSTM
-    gate matmuls and the latent Dense head — while biases and indivisible
-    leaves replicate (the reconstruction head's output dim is the feature
-    count, typically 3-4, so it stays replicated at model_parallel=2).
-    Handing these to jax.device_put /
-    jit's in_shardings is enough: XLA GSPMD partitions the per-step
-    matmuls and inserts the gate all-reduces over ICI, so a scorer whose
-    hidden state outgrows one chip spans several without model changes
-    (the `model` mesh axis reserved in parallel/mesh.py).
+    multiple of the `model` axis size AND at least `min_shard_width` wide
+    is sharded on that dim — the LSTM gate matmuls and the latent Dense
+    head — while biases, indivisible leaves, and narrow heads replicate.
+    The width floor keeps the reconstruction head (output dim = feature
+    count, typically 3-4) replicated: splitting a 4-wide output saves no
+    compute and would cost an all-gather per decode step.
+
+    Handing these to jax.device_put / jit's in_shardings is enough: XLA
+    GSPMD partitions the per-step matmuls and inserts the gate all-reduces
+    over ICI, so a scorer whose hidden state outgrows one chip spans
+    several without model changes (the `model` mesh axis reserved in
+    parallel/mesh.py — the default axis name comes from there).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..parallel.mesh import MODEL_AXIS
+
+    model_axis = MODEL_AXIS if model_axis is None else model_axis
     axis_size = mesh.shape[model_axis]
 
     def rule(x):
-        if getattr(x, "ndim", 0) >= 2 and x.shape[-1] % axis_size == 0:
+        if (getattr(x, "ndim", 0) >= 2 and x.shape[-1] % axis_size == 0
+                and x.shape[-1] >= min_shard_width):
             spec = [None] * (x.ndim - 1) + [model_axis]
             return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, P())

@@ -82,10 +82,11 @@ def test_param_shardings_tensor_parallel_train_and_score():
     n_sharded = 0
     for path, leaf in leaves:
         spec = shard_leaves[jax.tree_util.keystr(path)].spec
-        if leaf.ndim >= 2 and leaf.shape[-1] % 2 == 0:
+        if leaf.ndim >= 2 and leaf.shape[-1] % 2 == 0 and leaf.shape[-1] >= 8:
             assert spec[-1] == MODEL_AXIS, (path, leaf.shape, spec)
             n_sharded += 1
         else:
+            # narrow heads (e.g. the F-wide reconstruction kernel) replicate
             assert all(s is None for s in spec), (path, leaf.shape, spec)
     assert n_sharded >= 3  # encoder/decoder gates + a Dense head
 
