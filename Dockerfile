@@ -1,0 +1,27 @@
+# Runtime image for every process in the stack: the deploy manifests run
+# this image with different args (serve | operator | demo-app). Base image
+# must carry the JAX TPU stack; python:3.12 works for CPU-only functional
+# testing.
+ARG BASE=python:3.12-slim
+FROM ${BASE}
+
+# g++ lets the native data-plane extension build on first use
+# (foremast_tpu/native/__init__.py); harmless to omit — pure-Python
+# fallbacks take over.
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/foremast-tpu
+COPY pyproject.toml README.md ./
+COPY foremast_tpu ./foremast_tpu
+RUN pip install --no-cache-dir .
+
+# warm the native extension at build time so pods don't pay the compile.
+# -I (isolated) keeps cwd off sys.path, so this imports — and writes the
+# .so into — the site-packages install the runtime actually uses, not the
+# COPY'd source tree that happens to shadow it from this WORKDIR.
+RUN python -I -c "from foremast_tpu import native; native.available()" || true
+
+EXPOSE 8099
+ENTRYPOINT ["foremast-tpu"]
+CMD ["serve"]

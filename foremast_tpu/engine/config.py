@@ -50,7 +50,8 @@ class EngineConfig:
     max_cache_size: int = 1024  # MAX_CACHE_SIZE (model/window cache entries)
     ma_window: int = 30  # moving-average lookback (steps)
     # windows at/above this length use the time-parallel associative-scan
-    # smoothers (ops/seqscan.py) instead of sequential lax.scan
+    # SES smoother (ops/seqscan.py) instead of sequential lax.scan; DES
+    # always stays sequential (f32 drift — see seqscan.py docstring)
     long_window_steps: int = 4096  # LONG_WINDOW_STEPS
     hw_period: int = 1440  # Holt-Winters / seasonal-trend period (steps; 1 day at 60s)
     st_order: int = 3  # seasonal-trend (prophet) Fourier order
