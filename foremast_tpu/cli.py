@@ -113,9 +113,14 @@ def cmd_status(args) -> int:
 
 
 def cmd_demo(args) -> int:
-    from .examples.demo_app import run_demo
+    if args.hpa:
+        from .examples.demo_app import run_demo_hpa
 
-    result = run_demo(unhealthy=not args.healthy)
+        result = run_demo_hpa()
+    else:
+        from .examples.demo_app import run_demo
+
+        result = run_demo(unhealthy=not args.healthy)
     print(json.dumps(result, indent=2, default=str))
     return 0
 
@@ -139,8 +144,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("-n", "--namespace", default="default")
         sp.set_defaults(func=fn)
     d = sub.add_parser("demo", help="local end-to-end demo, no cluster")
-    d.add_argument("--healthy", action="store_true",
-                   help="run the healthy variant (no error generator)")
+    variant = d.add_mutually_exclusive_group()
+    variant.add_argument("--healthy", action="store_true",
+                         help="run the healthy variant (no error generator)")
+    variant.add_argument("--hpa", action="store_true",
+                         help="run the HPA autoscaling-score loop instead")
     d.set_defaults(func=cmd_demo)
     return p
 

@@ -301,5 +301,139 @@ def main() -> None:
     _wsgi_server("", port, app).serve_forever()
 
 
+def run_demo_hpa(cycles: int = 4, now: float | None = None) -> dict:
+    """The HPA scoring loop, hermetically (examples/hpa/README.MD scenario):
+
+      1. FakeKube holds the demo Deployment, its monitor, metadata with the
+         cpu_bound score template, and an HPA object targeting the
+         deployment on the hpa_score metric.
+      2. The operator tick sees the HPA, stamps the monitor's score
+         template, and starts the perpetual hpa-strategy job through the
+         real service handlers (deterministic id demo:default:hpa).
+      3. Engine cycles score rising traffic against a healthy-latency SLA:
+         breath-gated 50 first, then scale-up scores with hpalogs.
+      4. A desiredReplicas bump on the HPA makes the operator render the
+         scaling-explanation letter from the recent logs.
+    """
+    import time as _t
+
+    import numpy as np
+
+    from ..dataplane import FixtureDataSource, VerdictExporter
+    from ..engine import Analyzer, EngineConfig, JobStore
+    from ..operator.analyst import InProcessAnalyst
+    from ..operator.kube import FakeKube
+    from ..operator.loop import OperatorLoop
+    from ..operator.types import (
+        Analyst,
+        DeploymentMetadata,
+        DeploymentMonitor,
+        HpaScoreTemplate,
+        Metrics,
+        MonitorSpec,
+    )
+    from ..service.api import ForemastService
+
+    now = _t.time() if now is None else now
+    rng = np.random.default_rng(0)
+    T = 240
+    ts = [now - (T - i) * 60.0 for i in range(T)]
+    # precomputed (deterministic across refetches): a traffic surge at the
+    # tail that the seasonal model did not forecast, cpu climbing with it,
+    # latency still inside the SLA — the canonical scale-up story
+    surge = np.zeros(T)
+    surge[-30:] = np.linspace(0, 250.0, 30)
+    tps_series = list(100.0 + 20.0 * np.sin(np.arange(T) / 30.0) + surge
+                      + rng.normal(0, 3.0, T))
+    cpu_series = list(0.5 + surge / 500.0 + rng.normal(0, 0.02, T))
+    lat_series = list(rng.normal(80.0, 5.0, T))
+
+    def resolve(url: str):
+        from urllib.parse import unquote
+
+        q = unquote(url)
+        if "tps" in q:
+            return ts, tps_series
+        if "latency" in q:
+            return ts, lat_series
+        if "cpu" in q:
+            return ts, cpu_series
+        return [], []
+
+    store = JobStore()
+    exporter = VerdictExporter()
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(resolver=resolve),
+                        store, exporter)
+    service = ForemastService(store, exporter=exporter)
+
+    kube = FakeKube()
+    kube.deployments[("default", "demo")] = {
+        "metadata": {"name": "demo", "namespace": "default",
+                     "labels": {"app": "demo"}},
+        "spec": {"selector": {"matchLabels": {"app": "demo"}},
+                 "template": {"spec": {"containers": [
+                     {"name": "main", "image": "demo:v1"}]}}},
+    }
+    kube.upsert_monitor(DeploymentMonitor(
+        name="demo", namespace="default",
+        annotations={"deployment.foremast.ai/name": "demo"},
+        spec=MonitorSpec(selector={"app": "demo"}),
+    ))
+    kube.upsert_metadata(DeploymentMetadata(
+        name="demo", namespace="default",
+        analyst=Analyst(endpoint="in-process"),
+        metrics=Metrics(data_source_type="prometheus",
+                        endpoint="http://prom/api/v1/"),
+        hpa_score_templates=[
+            HpaScoreTemplate(name="cpu_bound", metrics=["cpu", "tps", "latency"])
+        ],
+    ))
+
+    def hpa(desired, current):
+        return {
+            "metadata": {"name": "demo", "namespace": "default"},
+            "spec": {
+                "scaleTargetRef": {"name": "demo"},
+                "metrics": [{"type": "Object", "object": {"metric": {
+                    "name": "namespace_app_pod_hpa_score"}}}],
+            },
+            "status": {"desiredReplicas": desired, "currentReplicas": current},
+        }
+
+    kube.hpas[("default", "demo")] = hpa(2, 2)
+    loop = OperatorLoop(kube, InProcessAnalyst(service))
+    loop.tick(now=now)
+    monitor = kube.get_monitor("default", "demo")
+    job_id = monitor.status.job_id
+
+    scores = []
+    for c in range(cycles):
+        analyzer.run_cycle(now=now + 60.0 * c)
+        loop.tick(now=now + 60.0 * c)  # polls status, applies hpalogs
+        logs = store.hpalogs_for(job_id, limit=1)
+        if logs:
+            scores.append(logs[0].hpascore)
+
+    # the HPA controller reacts to the scale-up with an explanation letter
+    kube.hpas[("default", "demo")] = hpa(4, 2)
+    loop.tick(now=now + 60.0 * cycles)
+
+    monitor = kube.get_monitor("default", "demo")
+    return {
+        "job_id": job_id,
+        "template": monitor.spec.hpa_score_template,
+        "hpa_score_enabled": monitor.status.hpa_score_enabled,
+        "scores": scores,
+        "monitor_hpalogs": len(monitor.status.hpa_logs),
+        "alert_letters": len(loop.hpas.alerts),
+        "letter_preview": (loop.hpas.alerts[-1].strip().splitlines()[0]
+                           if loop.hpas.alerts else ""),
+        "score_series_exported": any(
+            s[0] == "foremastbrain:namespace_app_per_pod:hpa_score"
+            for s in exporter.samples()
+        ),
+    }
+
+
 if __name__ == "__main__":
     main()

@@ -100,3 +100,21 @@ def test_operator_watch_namespaces_restricts(kube):
     loop.tick(now=1000.0)
     assert kube.get_monitor("prod", "a") is not None
     assert kube.get_monitor("staging", "b") is None
+
+
+def test_demo_hpa_scale_up_story():
+    """Hermetic HPA loop: template stamped by the operator, breath-gated 50
+    first, sustained surge pushes the score above 50, hpalogs reach the
+    monitor, and the replica bump renders an explanation letter."""
+    from foremast_tpu.examples.demo_app import run_demo_hpa
+
+    r = run_demo_hpa(cycles=5)
+    assert r["job_id"] == "demo:default:hpa"
+    assert r["template"] == "cpu_bound"
+    assert r["hpa_score_enabled"] is True
+    assert r["scores"][0] == 50.0  # breath cooldown gates the first cycle
+    assert r["scores"][-1] > 50.0  # sustained surge passes the gate
+    assert r["monitor_hpalogs"] >= 4
+    assert r["alert_letters"] == 1
+    assert "scaled up from 2 to 4 pods" in r["letter_preview"]
+    assert r["score_series_exported"] is True
