@@ -1,4 +1,4 @@
-"""`foremast-tpu` command line: serve | operator | watch | unwatch | status | demo.
+"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | demo.
 
 One entrypoint covers the reference's process zoo and kubectl plugins:
 
@@ -7,6 +7,8 @@ One entrypoint covers the reference's process zoo and kubectl plugins:
   operator  the reconcile loop against a real cluster — replaces
             foremast-barrelman (cmd/manager/main.go env surface: MODE,
             HPA_STRATEGY, NAMESPACE).
+  trigger   the non-K8s poller — replaces foremast-trigger (REQUESTS_FILE
+            CSV -> perpetual rollover analyses + daily reports).
   watch / unwatch <app>   toggle spec.continuous on the app's
             DeploymentMonitor — the bin/kubectl-watch & kubectl-unwatch
             plugins (bin/kubectl-watch:3 in the reference patched the CRD
@@ -112,6 +114,13 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_trigger(args) -> int:
+    from .trigger.trigger import main
+
+    main()
+    return 0
+
+
 def cmd_demo(args) -> int:
     if args.hpa:
         from .examples.demo_app import run_demo_hpa
@@ -134,6 +143,10 @@ def build_parser() -> argparse.ArgumentParser:
     op = sub.add_parser("operator", help="run the K8s operator loop")
     op.add_argument("--analyst", default="", help="job API endpoint")
     op.set_defaults(func=cmd_operator)
+    sub.add_parser(
+        "trigger",
+        help="run the non-K8s poller (REQUESTS_FILE CSV -> rolling analyses)",
+    ).set_defaults(func=cmd_trigger)
     for name, fn, help_ in (
         ("watch", cmd_watch, "enable continuous monitoring for an app"),
         ("unwatch", cmd_unwatch, "disable continuous monitoring for an app"),

@@ -57,7 +57,12 @@ def _map_status(status: str) -> str:
 
 class HttpAnalyst:
     def __init__(self, endpoint: str, do_func=None, timeout: float = 10.0):
-        self.endpoint = endpoint.rstrip("/")
+        # accept both configured forms — the bare service base
+        # ("http://svc:8099") and the reference metadata convention with the
+        # path baked in ("http://svc:8099/v1/healthcheck/",
+        # deployment-metadata-default.yaml) — by normalizing to the base;
+        # the request methods append the canonical /v1/healthcheck/* paths
+        self.endpoint = endpoint.rstrip("/").removesuffix("/v1/healthcheck")
         self.do_func = do_func  # (method, url, body_bytes) -> (status, bytes)
         self.timeout = timeout
 

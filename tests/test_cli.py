@@ -49,7 +49,8 @@ def test_status_prints_monitor_json(kube, capsys):
 
 def test_parser_covers_all_processes():
     p = cli.build_parser()
-    for verb in ("serve", "operator", "watch", "unwatch", "status", "demo"):
+    for verb in ("serve", "operator", "trigger", "watch", "unwatch", "status",
+                 "demo"):
         args = p.parse_args([verb] + (["x"] if verb in
                                       ("watch", "unwatch", "status") else []))
         assert callable(args.func)

@@ -674,3 +674,35 @@ def test_fakekube_patch_monitor_preserves_untouched_fields():
     assert got.spec.continuous is True
     assert got.status.phase == PHASE_RUNNING  # untouched by the spec patch
     assert got.status.job_id == "j-9"
+
+
+def test_http_analyst_against_live_service_both_endpoint_forms():
+    """Real HTTP (no do_func seam): both configured endpoint conventions —
+    bare base and reference-style .../v1/healthcheck/ — must reach the
+    service. The seam-only tests missed a 404 here once."""
+    from foremast_tpu.engine import JobStore
+    from foremast_tpu.operator.analyst import HttpAnalyst
+    from foremast_tpu.service.api import ForemastService, serve_background
+
+    store = JobStore()
+    service = ForemastService(store)
+    server = serve_background(service, port=0)
+    port = server.server_address[1]
+    try:
+        req = {
+            "appName": "live", "strategy": "canary",
+            "startTime": "1970-01-01T00:00:00Z",
+            "endTime": "1970-01-01T00:30:00Z",
+            "metricsInfo": {"current": {"m": {"url": "u-cur"}},
+                            "baseline": {"m": {"url": "u-base"}}},
+        }
+        for endpoint in (f"http://127.0.0.1:{port}",
+                         f"http://127.0.0.1:{port}/v1/healthcheck/"):
+            analyst = HttpAnalyst(endpoint)
+            job_id = analyst.start_analyzing(req)
+            assert store.get(job_id) is not None
+            status = analyst.get_status(job_id)
+            assert status.phase == "Running"
+    finally:
+        server.shutdown()
+        server.server_close()
