@@ -1,0 +1,21 @@
+"""foremast_tpu — TPU-native application-health / canary-analysis framework.
+
+A ground-up re-design of the capabilities of classicvalues/foremast
+(K8s app health manager: canary analysis, anomaly detection, remediation,
+HPA scoring) with the entire anomaly engine built as jit-compiled JAX/XLA
+kernels vmapped over a (service x metric x window) batch axis and sharded
+across TPU chips via shard_map, instead of the reference's per-request CPU
+Python worker (reference: foremast-brain, spec at SURVEY.md §2.4).
+
+Layout:
+  ops/       pure-JAX numerics: masked rank stats, pairwise tests, forecasters
+  models/    flax models (LSTM autoencoder multivariate scorer)
+  parallel/  mesh construction, shard_map fleet scoring, ICI reductions
+  engine/    job state machine, micro-batching scheduler, analyzer
+  dataplane/ Prometheus/Wavefront query builders + fetchers, metric exporter
+  service/   HTTP job API (contract of foremast-service /v1/healthcheck/*)
+  operator/  K8s control plane (contract of foremast-barrelman)
+  utils/     ids, time helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+"""`python -m foremast_tpu [serve|operator|watch|unwatch|status|demo]`."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,157 @@
+"""`foremast-tpu` command line: serve | operator | watch | unwatch | status | demo.
+
+One entrypoint covers the reference's process zoo and kubectl plugins:
+
+  serve     the runtime (job API + TPU engine + exporter + dashboard) —
+            replaces foremast-service + foremast-brain (+ES).
+  operator  the reconcile loop against a real cluster — replaces
+            foremast-barrelman (cmd/manager/main.go env surface: MODE,
+            HPA_STRATEGY, NAMESPACE).
+  watch / unwatch <app>   toggle spec.continuous on the app's
+            DeploymentMonitor — the bin/kubectl-watch & kubectl-unwatch
+            plugins (bin/kubectl-watch:3 in the reference patched the CRD
+            with kubectl; here we speak to the API server directly).
+  status <app>            print the monitor's phase / job / anomaly.
+  demo      self-contained local loop: chaos app + fake metric source +
+            engine, no cluster (examples/demo_app.py).
+
+Kube access: in-cluster service account when present, else KUBE_API/
+KUBE_TOKEN env (operator/kube.py:KubeClient).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _kube():
+    from .operator.kube import KubeClient
+
+    return KubeClient()
+
+
+def cmd_serve(args) -> int:
+    from .runtime import main
+
+    main()
+    return 0
+
+
+def cmd_operator(args) -> int:
+    from .operator.analyst import HttpAnalyst
+    from .operator.loop import OperatorLoop
+
+    endpoint = args.analyst or os.environ.get(
+        "ANALYST_ENDPOINT", "http://localhost:8099/v1/healthcheck/"
+    )
+    watch = [n.strip() for n in os.environ.get("WATCH_NAMESPACES", "").split(",")
+             if n.strip()]
+    loop = OperatorLoop(
+        _kube(),
+        HttpAnalyst(endpoint),
+        mode=os.environ.get("MODE", "hpa_and_healthy_monitoring"),
+        hpa_strategy=os.environ.get("HPA_STRATEGY", "hpa_exists"),
+        watch_namespaces=watch or None,
+    )
+    # NAMESPACE keeps the reference's meaning (Barrelman.go:402): where the
+    # deployment-metadata-default fallback record lives
+    ns = os.environ.get("OPERATOR_NAMESPACE") or os.environ.get("NAMESPACE", "")
+    if ns:
+        loop.barrelman.operator_namespace = ns
+    tick = float(os.environ.get("TICK_SECONDS", "10"))
+    print(f"[foremast-tpu] operator: analyst={endpoint} tick={tick}s", flush=True)
+    loop.run_forever(interval=tick)
+    return 0
+
+
+def _toggle_continuous(args, value: bool) -> int:
+    from .operator.kube import KubeError
+
+    kube = _kube()
+    if kube.get_monitor(args.namespace, args.app) is None:
+        print(f"no DeploymentMonitor {args.namespace}/{args.app}", file=sys.stderr)
+        return 1
+    try:
+        # spec-only merge patch: must NOT round-trip a stale status copy
+        kube.patch_monitor(args.namespace, args.app,
+                           {"spec": {"continuous": value}})
+    except KubeError as e:
+        print(f"patch failed: {e}", file=sys.stderr)
+        return 1
+    print(f"{args.namespace}/{args.app}: continuous={value}")
+    return 0
+
+
+def cmd_watch(args) -> int:
+    return _toggle_continuous(args, True)
+
+
+def cmd_unwatch(args) -> int:
+    return _toggle_continuous(args, False)
+
+
+def cmd_status(args) -> int:
+    monitor = _kube().get_monitor(args.namespace, args.app)
+    if monitor is None:
+        print(f"no DeploymentMonitor {args.namespace}/{args.app}", file=sys.stderr)
+        return 1
+    s = monitor.status
+    out = {
+        "app": args.app,
+        "namespace": args.namespace,
+        "phase": s.phase,
+        "jobId": s.job_id,
+        "continuous": monitor.spec.continuous,
+        "remediationTaken": s.remediation_taken,
+        "expired": s.expired,
+        "anomalousMetrics": [m.name for m in s.anomaly.anomalous_metrics],
+    }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_demo(args) -> int:
+    from .examples.demo_app import run_demo
+
+    result = run_demo(unhealthy=not args.healthy)
+    print(json.dumps(result, indent=2, default=str))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="foremast-tpu", description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="command")
+    sub.add_parser("serve", help="run the runtime (job API + engine)").set_defaults(
+        func=cmd_serve
+    )
+    op = sub.add_parser("operator", help="run the K8s operator loop")
+    op.add_argument("--analyst", default="", help="job API endpoint")
+    op.set_defaults(func=cmd_operator)
+    for name, fn, help_ in (
+        ("watch", cmd_watch, "enable continuous monitoring for an app"),
+        ("unwatch", cmd_unwatch, "disable continuous monitoring for an app"),
+        ("status", cmd_status, "print an app's monitor status"),
+    ):
+        sp = sub.add_parser(name, help=help_)
+        sp.add_argument("app")
+        sp.add_argument("-n", "--namespace", default="default")
+        sp.set_defaults(func=fn)
+    d = sub.add_parser("demo", help="local end-to-end demo, no cluster")
+    d.add_argument("--healthy", action="store_true",
+                   help="run the healthy variant (no error generator)")
+    d.set_defaults(func=cmd_demo)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "command", None):
+        args = parser.parse_args(["serve"])
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Dashboard assets: the L7 UI served by the foremast-tpu service.
+
+The reference shipped a React build behind nginx with an /api proxy to
+foremast-service (foremast-dashboard/nginx.conf, deploy/foremast/3_brain/
+foremast-browser.yaml:22-33). Here the service serves one dependency-free
+static page and already owns the /api/v1 query proxy, so the whole L7 layer
+is a file.
+"""
+from __future__ import annotations
+
+import os
+
+_STATIC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
+
+
+def index_html() -> str:
+    with open(os.path.join(_STATIC, "index.html"), encoding="utf-8") as f:
+        return f.read()

@@ -1,0 +1,76 @@
+"""Verdict exporter: the foremastbrain:* Prometheus series.
+
+The reference brain exports its model bounds, anomaly markers and HPA score
+back into Prometheus (series consumed by the dashboard at
+foremast-dashboard/src/config/metrics.js:21-29, by the custom-metrics
+adapter at deploy/custom-metrics/custom-metrics-config-map.yaml:27-37, and
+scraped from :8000/metrics per foremast-brain.yaml:88,110-122):
+
+    foremastbrain:<metric>_upper / _lower / _anomaly    {app, namespace}
+    foremastbrain:namespace_app_per_pod:hpa_score       {app, namespace}
+
+This registry renders the Prometheus text exposition format; the service
+mounts it at /metrics. A Wavefront mirror (custom.iks.foremast.* per
+foremast-trigger/pkg/foremasttrigger/trigger.go:166-168) can subscribe to
+the same registry via `samples()`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils.promtext import escape_label_value as _esc
+from ..utils.promtext import sanitize_metric_name as _sanitize_name
+
+
+class VerdictExporter:
+    def __init__(self, stale_seconds: float = 3600.0):
+        self._lock = threading.Lock()
+        self._gauges: dict[tuple, tuple[float, float]] = {}  # key -> (value, at)
+        self.stale_seconds = stale_seconds
+
+    def _set(self, name: str, labels: dict, value: float):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = (float(value), time.time())
+
+    def record_bounds(self, app: str, namespace: str, metric: str,
+                      upper: float, lower: float, anomaly: float):
+        labels = {"app": app, "namespace": namespace}
+        metric = _sanitize_name(metric)
+        self._set(f"foremastbrain:{metric}_upper", labels, upper)
+        self._set(f"foremastbrain:{metric}_lower", labels, lower)
+        self._set(f"foremastbrain:{metric}_anomaly", labels, anomaly)
+
+    def record_hpa_score(self, app: str, namespace: str, score: float):
+        self._set(
+            "foremastbrain:namespace_app_per_pod:hpa_score",
+            {"app": app, "namespace": namespace},
+            score,
+        )
+
+    def samples(self):
+        """[(name, labels-dict, value)] for alternate sinks (Wavefront)."""
+        now = time.time()
+        with self._lock:
+            # evict, don't just filter: label sets come from user-submitted
+            # jobs, so unexpired-but-unevicted keys are an unbounded leak
+            dead = [k for k, (_, at) in self._gauges.items()
+                    if now - at > self.stale_seconds]
+            for k in dead:
+                del self._gauges[k]
+            return [
+                (name, dict(labels), value)
+                for (name, labels), (value, at) in self._gauges.items()
+            ]
+
+    def render(self) -> str:
+        """Prometheus text exposition (0.0.4)."""
+        lines = []
+        for name, labels, value in sorted(
+            self.samples(), key=lambda s: (s[0], sorted(s[1].items()))
+        ):
+            lab = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
+            # ':' is legal in prometheus metric names (recording-rule style)
+            lines.append(f"{name}{{{lab}}} {value}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,168 @@
+"""Data sources: fetch (timestamps, values) series for a query URL.
+
+The engine's hot loop fetches current/baseline/historical windows for every
+open job. Sources are pluggable:
+
+  * PrometheusDataSource — real HTTP `query_range` (urllib; response shape
+    {"data":{"result":[{"values":[[ts,"v"],...]}]}}). Multiple result series
+    are averaged element-wise (the reference's recording rules pre-aggregate
+    to one series per query; the average keeps us safe if a selector matches
+    several).
+  * WavefrontDataSource — chart-API shape {"timeseries":[{"data":[[ts,v],...]}]}.
+  * FixtureDataSource — dict/url -> series or a callable; the test/demo seam
+    (the reference's equivalent seam was the injectable HTTP DoFunc,
+    foremast-barrelman/pkg/client/analyst/analystclient.go:24).
+
+All sources return (timestamps, values) sequences (lists, or numpy arrays
+when the native parser handled the response).
+
+Parsing goes through the C++ extension (foremast_tpu.native: single-pass
+extracting scanner + duplicate-averaging merge) when it is available, with
+the json.loads path kept as the pure-Python fallback — same results either
+way (tests/test_native.py asserts exact parity).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from collections import OrderedDict
+from typing import Callable
+
+from .. import native
+
+
+class FetchError(Exception):
+    pass
+
+
+def _avg_series(series: list[list[tuple[float, float]]]):
+    """Element-wise average of several [(ts, v)] series by timestamp."""
+    if not series:
+        return [], []
+    acc: dict[float, list[float]] = {}
+    for s in series:
+        for ts, v in s:
+            acc.setdefault(float(ts), []).append(float(v))
+    out_ts = sorted(acc)
+    return out_ts, [sum(acc[t]) / len(acc[t]) for t in out_ts]
+
+
+class PrometheusDataSource:
+    def __init__(self, timeout: float = 10.0):
+        self.timeout = timeout
+
+    def fetch(self, url: str):
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                raw = r.read()
+        except Exception as e:  # noqa: BLE001 - network boundary
+            raise FetchError(f"prometheus fetch failed: {e}") from e
+        # fast path: single-pass native scan (no DOM). The status probe only
+        # scans a prefix: Prometheus serializes the top-level "status" first,
+        # and a full-body scan would false-positive on series whose LABELS
+        # contain status="error" (common on the error metrics we monitor),
+        # permanently disabling the fast path for them. Error responses also
+        # arrive with non-2xx codes (urlopen raised above) — this probe is
+        # belt-and-braces for proxies that flatten the status code.
+        head = raw[:256]
+        if b'"status":"error"' not in head and b'"status": "error"' not in head:
+            parsed = native.parse_series(raw, native.FLAVOR_PROMETHEUS)
+            if parsed is not None:
+                return parsed
+        payload = json.loads(raw)
+        if payload.get("status") not in (None, "success"):
+            raise FetchError(f"prometheus error: {payload}")
+        result = payload.get("data", {}).get("result", [])
+        series = [
+            [(float(ts), float(v)) for ts, v in item.get("values", [])]
+            for item in result
+        ]
+        return _avg_series(series)
+
+
+class WavefrontDataSource:
+    def __init__(self, token: str = "", timeout: float = 10.0):
+        self.token = token
+        self.timeout = timeout
+
+    def fetch(self, url: str):
+        req = urllib.request.Request(url)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                raw = r.read()
+        except Exception as e:  # noqa: BLE001
+            raise FetchError(f"wavefront fetch failed: {e}") from e
+        parsed = native.parse_series(raw, native.FLAVOR_WAVEFRONT)
+        if parsed is not None:
+            return parsed
+        payload = json.loads(raw)
+        series = [
+            [(float(ts), float(v)) for ts, v in item.get("data", [])]
+            for item in payload.get("timeseries", [])
+        ]
+        return _avg_series(series)
+
+
+class FixtureDataSource:
+    """URL -> canned series; or a resolver callable(url) -> (ts, vals)."""
+
+    def __init__(self, fixtures: dict | None = None,
+                 resolver: Callable[[str], tuple] | None = None):
+        # keep the caller's dict object (tests mutate it after construction);
+        # `fixtures or {}` would silently detach an initially-empty dict
+        self.fixtures = {} if fixtures is None else fixtures
+        self.resolver = resolver
+        self.requests: list[str] = []
+
+    def fetch(self, url: str):
+        self.requests.append(url)
+        if url in self.fixtures:
+            ts, vals = self.fixtures[url]
+            return list(ts), list(vals)
+        if self.resolver is not None:
+            return self.resolver(url)
+        raise FetchError(f"no fixture for {url}")
+
+
+class CachingDataSource:
+    """LRU+TTL wrapper, bounded by MAX_CACHE_SIZE — the reference brain's
+    in-memory model/window cache (foremast-brain/README.md:30), rebuilt from
+    historical queries on miss.
+
+    The TTL is load-bearing, not an optimization detail: the engine re-fetches
+    the SAME current-window URL every cycle until endTime (fail-fast recheck,
+    design.md:43). A TTL-less cache would freeze the first — mostly empty —
+    response and judge stale data forever."""
+
+    def __init__(self, inner, max_entries: int = 1024, ttl_seconds: float = 55.0):
+        # default just under the 60 s metric step: one fresh fetch per new
+        # sample, cycle-frequency dedupe in between
+        self.inner = inner
+        self.max_entries = max_entries
+        self.ttl_seconds = ttl_seconds
+        self._cache: OrderedDict[str, tuple] = OrderedDict()  # url -> (res, at)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def fetch(self, url: str):
+        now = time.time()
+        with self._lock:
+            if url in self._cache:
+                res, at = self._cache[url]
+                if now - at <= self.ttl_seconds:
+                    self._cache.move_to_end(url)
+                    self.hits += 1
+                    return res
+                del self._cache[url]
+        res = self.inner.fetch(url)
+        with self._lock:
+            self.misses += 1
+            self._cache[url] = (res, now)
+            if len(self._cache) > self.max_entries:
+                self._cache.popitem(last=False)
+        return res

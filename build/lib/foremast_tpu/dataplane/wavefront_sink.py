@@ -1,0 +1,74 @@
+"""Wavefront mirror of the verdict series.
+
+The reference brain writes its bounds/anomaly verdicts to Wavefront as
+custom.iks.foremast.<metric>_{upper,lower,anomaly} alongside the
+foremastbrain:* Prometheus series (foremast-trigger/pkg/foremasttrigger/
+trigger.go:166-168, :292 — trigger dashboards and anomaly counts read
+them). This sink subscribes to the same VerdictExporter registry and
+forwards renamed samples in Wavefront line protocol
+(`name value ts source=... key="val"`), via a pluggable sender (TCP proxy
+socket in production, a list in tests).
+"""
+from __future__ import annotations
+
+import socket
+import time
+
+from ..utils.promtext import escape_label_value, sanitize_metric_name
+
+PREFIX = "custom.iks.foremast."
+
+
+def _rename(name: str) -> str | None:
+    """foremastbrain:<metric>_suffix -> custom.iks.foremast.<metric>_suffix;
+    the hpa score keeps its recording-rule-ish name under the prefix."""
+    if not name.startswith("foremastbrain:"):
+        return None
+    rest = name[len("foremastbrain:"):]
+    rest = rest.replace(":", ".").lower()
+    return PREFIX + rest
+
+
+def mirror_name(metric: str, suffix: str) -> str:
+    """The Wavefront series this sink will emit for a RAW metric name.
+
+    Consumers (trigger dashboards/reports) must build names through this so
+    they track the exporter's sanitization ('.'/'-' -> '_') and the sink's
+    rename — two hand-rolled copies of the mangling already diverged once.
+    """
+    return _rename(f"foremastbrain:{sanitize_metric_name(metric)}_{suffix}")
+
+
+class WavefrontSink:
+    def __init__(self, exporter, sender=None, host: str = "", port: int = 2878,
+                 source: str = "foremast-tpu"):
+        self.exporter = exporter
+        self.sender = sender  # callable(list[str]) — overrides the socket
+        self.host = host
+        self.port = port
+        self.source = source
+
+    def lines(self, now: float | None = None) -> list[str]:
+        now = time.time() if now is None else now
+        out = []
+        for name, labels, value in self.exporter.samples():
+            wf = _rename(name)
+            if wf is None:
+                continue
+            tags = " ".join(
+                f'{k}="{escape_label_value(v)}"' for k, v in sorted(labels.items())
+            )
+            out.append(f"{wf} {value} {int(now)} source={self.source} {tags}".strip())
+        return out
+
+    def flush(self, now: float | None = None) -> int:
+        lines = self.lines(now)
+        if not lines:
+            return 0
+        if self.sender is not None:
+            self.sender(lines)
+        elif self.host:
+            payload = ("\n".join(lines) + "\n").encode()
+            with socket.create_connection((self.host, self.port), timeout=5) as s:
+                s.sendall(payload)
+        return len(lines)

@@ -1,0 +1,865 @@
+"""The analysis engine: jobs -> device batches -> verdicts.
+
+This collapses the reference's L3 brain worker loop (poll ES -> fetch
+Prometheus -> scipy per job -> write verdict, SURVEY.md §2.4/§3.1) into a
+batched cycle: every runnable job's windows are fetched, packed into dense
+(B, T) buckets, and scored by ONE jitted program per bucket — pairwise tests
+and forecast-band checks fused (parallel.fleet), HPA scores batched
+(ops.hpa). Verdict semantics preserved:
+
+  * two judgment modes (foremast-brain/README.md:7-10): pairwise
+    baseline-vs-current, and historical-model band anomaly detection.
+  * fail-fast: completed_unhealth the moment an anomaly is seen; otherwise
+    keep re-checking until endTime (docs/guides/design.md:43) — implemented
+    by re-queuing unfinished healthy jobs each cycle.
+  * insufficient data by endTime -> completed_unknown.
+  * continuous jobs re-materialize START_TIME/END_TIME windows per cycle
+    (foremast-service/cmd/manager/main.go:59-63); hpa jobs additionally emit
+    hpalogs + the foremastbrain:..hpa_score series every cycle.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..dataplane.exporter import VerdictExporter
+from ..dataplane.fetch import FetchError
+from ..dataplane.promql import (
+    CONTINUOUS_STRATEGIES,
+    STRATEGY_HPA,
+    materialize_placeholders,
+)
+from ..models import lstm_ae
+from ..ops import bivariate as bv
+from ..ops import forecast as fc
+from ..ops import seqscan as sq
+from ..ops import hpa as hpa_ops
+from ..ops.windowing import (
+    MAX_WINDOW_STEPS,
+    Window,
+    align_step,
+    bucket_length,
+    pack_windows,
+    resample_to_grid,
+)
+from ..parallel import fleet as fl
+from ..utils import tracing
+from ..utils.timeutils import from_rfc3339
+from . import jobs as J
+from .config import EngineConfig, MetricPolicy
+
+
+@dataclass
+class _PairItem:
+    job_id: str
+    metric: str
+    baseline: Window
+    current: Window
+    policy: MetricPolicy
+
+
+@dataclass
+class _BandItem:
+    job_id: str
+    metric: str
+    historical: Window
+    current: Window
+    policy: MetricPolicy
+
+
+@dataclass
+class _BiItem:
+    """Two-metric joint job (ML_ALGORITHM=bivariate_normal; design.md:53-88)."""
+
+    job_id: str
+    metrics: tuple  # (name1, name2)
+    hist: tuple  # (Window, Window)
+    cur: tuple  # (Window, Window)
+    policies: tuple  # (MetricPolicy, MetricPolicy)
+
+
+@dataclass
+class _MultiItem:
+    """3+-metric LSTM-autoencoder job (faq.md:8-10)."""
+
+    job_id: str
+    cache_key: str  # app/namespace identity for the model cache
+    metrics: list
+    hist: list  # [Window]
+    cur: list  # [Window]
+
+
+@dataclass
+class _HpaItem:
+    job_id: str
+    metric: str
+    historical: Window
+    current: Window
+    is_increase: bool = True
+    priority: int = 0
+
+
+def _concat_trimmed(hist: Window, cur: Window):
+    """(values, mask, n_h) of hist+current, hist left-trimmed so the concat
+    fits the largest compiled bucket (static-shape ceiling)."""
+    n_c = cur.values.shape[0]
+    max_h = max(MAX_WINDOW_STEPS - n_c, 0)
+    h_vals = hist.values[-max_h:] if max_h else hist.values[:0]
+    h_mask = hist.mask[-max_h:] if max_h else hist.mask[:0]
+    vals = np.concatenate([h_vals, cur.values[: MAX_WINDOW_STEPS]])
+    mask = np.concatenate([h_mask, cur.mask[: MAX_WINDOW_STEPS]])
+    return vals, mask, h_vals.shape[0]
+
+
+def _joint_grid(hists: list, curs: list):
+    """Stack a job's metrics onto one shared concat grid.
+
+    Metrics of one job are fetched with identical start/end/step parameters,
+    so their grids line up; residual off-by-a-few length skew (scrape lag)
+    is resolved by trimming every series to the common length. Current
+    windows are HEAD-trimmed so concat index n_h + j maps to each current
+    window's own index j — the invariant the anomaly-timestamp math
+    (cur.start + (idx - n_h) * step) depends on. History keeps its tail
+    (most recent points). Returns (values (F, T), masks (F, T), n_h, n_c).
+    """
+    n_c = min(c.values.shape[0] for c in curs)
+    n_c = min(n_c, MAX_WINDOW_STEPS)
+    n_h = min(h.values.shape[0] for h in hists)
+    n_h = min(n_h, MAX_WINDOW_STEPS - n_c)
+    vals, masks = [], []
+    for h, c in zip(hists, curs):
+        hv = h.values[-n_h:] if n_h else h.values[:0]
+        hm = h.mask[-n_h:] if n_h else h.mask[:0]
+        vals.append(np.concatenate([hv, c.values[:n_c]]))
+        masks.append(np.concatenate([hm, c.mask[:n_c]]))
+    return np.stack(vals), np.stack(masks), n_h, n_c
+
+
+def _concat_ts(cur: Window, n_h: int, j: int) -> float:
+    """Translate a concat-grid index onto the CURRENT window's own time grid.
+
+    Anomalies lie in the current region; the historical grid ends days
+    earlier, so extrapolating it would stamp anomalies in the future. Valid
+    because concat index n_h + k maps to current index k (history is
+    tail-kept, current head-kept — _concat_trimmed/_joint_grid invariant).
+    """
+    return float(cur.start + (j - n_h) * cur.step)
+
+
+@dataclass
+class _JobState:
+    doc: J.Document
+    unhealthy: list = field(default_factory=list)  # (metric, detail, anomaly pairs)
+    judged_any: bool = False
+    failed: str = ""
+
+
+class Analyzer:
+    def __init__(self, config: EngineConfig, data_source, store: J.JobStore,
+                 exporter: VerdictExporter | None = None,
+                 breath: hpa_ops.BreathState | None = None):
+        self.config = config
+        self.source = data_source
+        self.store = store
+        self.exporter = exporter or VerdictExporter()
+        self.breath = breath or hpa_ops.BreathState()
+        # LSTM-AE model cache (MAX_CACHE_SIZE semantics,
+        # foremast-brain/README.md:30): key -> (params, err_mu, err_sigma);
+        # insertion-ordered dict doubles as the LRU eviction queue.
+        self._lstm_cache: dict = {}
+        self._lstm_models: dict = {}  # (F, hidden, latent) -> module instance
+
+    # ------------------------------------------------------------------ fetch
+    def _fetch_window(self, url: str, now: float) -> Window | None:
+        if not url:
+            return None
+        url = materialize_placeholders(url, now)
+        ts, vals = self.source.fetch(url)
+        if len(ts) == 0:
+            return Window(np.zeros(1, np.float32), np.zeros(1, bool), 0)
+        # clamp the grid span to the largest compiled bucket, keeping the
+        # most recent samples: a user query returning >11 days of data must
+        # not produce an unbucketable window (and with it a poisoned batch).
+        # np.max/np.min: ts may be a 10k-point ndarray off the native parser
+        # (builtin max would box every element)
+        end = align_step(float(np.max(ts))) + 60
+        start = max(align_step(float(np.min(ts))), end - MAX_WINDOW_STEPS * 60)
+        return resample_to_grid(ts, vals, start, end, 60)
+
+    def _preprocess(self, doc: J.Document, now: float):
+        """Fetch all windows for a job; returns (pair, band, bi, multi, hpa)
+        item lists. Band candidates route by the configured model family and
+        metric count (design.md:53-88): bivariate_normal pairs 2-metric jobs,
+        lstm_autoencoder pools 3+-metric jobs; everything else (and any job
+        not matching its family's metric count) scores univariate bands."""
+        pairs, bands, bis, multis, hpas = [], [], [], [], []
+        candidates = []  # (name, hist, cur, policy) judgeable by history
+        for name, mq in doc.metrics.items():
+            policy = self.config.policy_for(name)
+            cur = self._fetch_window(mq.current, now)
+            base = self._fetch_window(mq.baseline, now)
+            hist = self._fetch_window(mq.historical, now)
+            if cur is None or cur.n_valid == 0:
+                # no current data -> nothing judgeable for this metric; the
+                # job ends COMPLETED_UNKNOWN at endTime, never "healthy"
+                continue
+            if doc.strategy == STRATEGY_HPA:
+                if hist is not None:
+                    hpas.append(
+                        _HpaItem(doc.id, name, hist, cur, mq.is_increase, mq.priority)
+                    )
+                continue
+            if base is not None and base.n_valid > 0:
+                pairs.append(_PairItem(doc.id, name, base, cur, policy))
+            if hist is not None and hist.n_valid >= self.config.min_historical_points:
+                candidates.append((name, hist, cur, policy))
+        algo = self.config.algorithm
+        if algo.startswith("bivariate") and len(candidates) == 2:
+            (n1, h1, c1, p1), (n2, h2, c2, p2) = candidates
+            bis.append(_BiItem(doc.id, (n1, n2), (h1, h2), (c1, c2), (p1, p2)))
+        elif algo.startswith("lstm") and len(candidates) >= 3:
+            multis.append(
+                _MultiItem(
+                    doc.id,
+                    f"{doc.app_name}/{doc.namespace}",
+                    [c[0] for c in candidates],
+                    [c[1] for c in candidates],
+                    [c[2] for c in candidates],
+                )
+            )
+        else:
+            for name, hist, cur, policy in candidates:
+                bands.append(_BandItem(doc.id, name, hist, cur, policy))
+        return pairs, bands, bis, multis, hpas
+
+    # ------------------------------------------------------------- scoring
+    def _isolate(self, score_fn, items):
+        """Run a batch scorer with per-job blast-radius containment.
+
+        Scorers batch many jobs into one device program, so one poisoned
+        item would otherwise fail the whole cycle for everyone — and the
+        stuck-job takeover would re-claim and re-crash it forever. On batch
+        failure, retry per JOB (not per item: _score_hpa scores a job's
+        metrics jointly — splitting them would misassign tps/sla roles) and
+        report {job_id: error} for the offenders only.
+        """
+        try:
+            return score_fn(items), {}
+        except Exception:  # noqa: BLE001 - fall back to per-job isolation
+            results, bad = {}, {}
+            by_job: dict[str, list] = {}
+            for it in items:
+                by_job.setdefault(it.job_id, []).append(it)
+            for job_id, group in by_job.items():
+                try:
+                    results.update(score_fn(group))
+                except Exception as e:  # noqa: BLE001
+                    bad[job_id] = f"{type(e).__name__}: {e}"
+            return results, bad
+
+    def _score_pairs(self, items: list[_PairItem]):
+        """Batch all pairwise items (bucketed by window length)."""
+        results = {}
+        by_bucket: dict[int, list[_PairItem]] = {}
+        for it in items:
+            T = bucket_length(
+                max(it.baseline.values.shape[0], it.current.values.shape[0])
+            )
+            by_bucket.setdefault(T, []).append(it)
+        cfg = self.config
+        for T, group in by_bucket.items():
+            bv, bm = pack_windows([it.baseline for it in group], pad_to=T)
+            cv, cm = pack_windows([it.current for it in group], pad_to=T)
+            B = len(group)
+            out = fl.score_pairs(
+                bv, bm, cv, cm,
+                np.full(B, cfg.pairwise_threshold, np.float32),
+                np.full(B, cfg.enabled_tests(), np.int32),
+                np.full(
+                    B,
+                    fl.COMBINE_ALL if cfg.pairwise_combine_all else fl.COMBINE_ANY,
+                    np.int32,
+                ),
+                np.full(B, cfg.ma_window, np.int32),
+                np.asarray([it.policy.threshold for it in group], np.float32),
+                np.asarray([it.policy.bound for it in group], np.int32),
+                np.asarray([it.policy.min_lower_bound for it in group], np.float32),
+                np.tile(
+                    np.asarray(
+                        [
+                            cfg.min_mann_whitney_points,
+                            cfg.min_wilcoxon_points,
+                            cfg.min_kruskal_points,
+                        ],
+                        np.int32,
+                    ),
+                    (B, 1),
+                ),
+            )
+            unhealthy = np.asarray(out["unhealthy"])
+            min_p = np.asarray(out["min_p"])
+            pw = np.asarray(out["pairwise_unhealthy"])
+            band = np.asarray(out["band_unhealthy"])
+            band_count = np.asarray(out["band_count"])
+            for i, it in enumerate(group):
+                results[(it.job_id, it.metric, "pair")] = {
+                    "unhealthy": bool(unhealthy[i]),
+                    "min_p": float(min_p[i]),
+                    "pairwise_unhealthy": bool(pw[i]),
+                    "band_unhealthy": bool(band[i]),
+                    "band_count": int(band_count[i]),
+                }
+        return results
+
+    def _predict(self, xv, xm, region, data_steps: int | None = None):
+        """Forecaster dispatch on config.algorithm (history-only fit).
+
+        `data_steps` is the UNPADDED series length: the long-window gate
+        must see real data size, not the bucket the batch was padded to,
+        or padding alone would flip the kernel choice.
+        """
+        algo = self.config.algorithm
+        hist_mask = xm & ~region
+        B = xv.shape[0]
+        # long windows: same smoother, time-parallel (associative scan).
+        # SES only — the DES associative form compounds f32 rounding on
+        # trending series (~4e-3 relative at T>=4096, enough to flip a
+        # borderline band verdict), so DES always runs sequentially here.
+        long = (data_steps if data_steps is not None
+                else xv.shape[1]) >= self.config.long_window_steps
+        if algo.startswith("exponential_smoothing"):
+            ses = sq.ses_predictions_assoc if long else fc.ses_predictions
+            preds = ses(xv, hist_mask, np.full(B, 0.3, np.float32))
+        elif algo.startswith("double_exponential"):
+            preds = fc.des_predictions(
+                xv, hist_mask, np.full(B, 0.5, np.float32), np.full(B, 0.1, np.float32)
+            )
+        elif algo.startswith("holt_winters"):
+            period = min(self.config.hw_period, max(xv.shape[1] // 2, 2))
+            fitm = hist_mask.copy()
+            fitm[:, : 2 * period] = False
+            _, preds = fc.fit_holt_winters(xv, hist_mask, fitm, period)
+        elif algo.startswith("seasonal_trend") or algo.startswith("prophet"):
+            period = min(self.config.hw_period, max(xv.shape[1] // 2, 2))
+            _, preds = fc.fit_seasonal_trend(
+                xv, hist_mask, hist_mask, period, self.config.st_order
+            )
+        else:  # moving_average_all default
+            preds = fc.moving_average_predictions(xv, hist_mask, self.config.ma_window)
+        return np.asarray(preds), hist_mask
+
+    def _score_bands(self, items: list[_BandItem]):
+        results = {}
+        by_bucket: dict[int, list[_BandItem]] = {}
+        for it in items:
+            T = bucket_length(
+                min(
+                    it.historical.values.shape[0] + it.current.values.shape[0],
+                    MAX_WINDOW_STEPS,
+                )
+            )
+            by_bucket.setdefault(T, []).append(it)
+        for T, group in by_bucket.items():
+            concats = []
+            regions = np.zeros((len(group), T), bool)
+            trimmed_n_h = {}
+            for i, it in enumerate(group):
+                h, c = it.historical, it.current
+                vals, mask, n_h = _concat_trimmed(h, c)
+                trimmed_n_h[id(it)] = n_h
+                concats.append(Window(vals, mask, h.start, h.step))
+                regions[i, n_h : vals.shape[0]] = True
+            xv, xm = pack_windows(concats, pad_to=T)
+            data_steps = max(w.values.shape[0] for w in concats)
+            preds, hist_mask = self._predict(xv, xm, regions, data_steps)
+            sigma = np.asarray(fc.residual_sigma(xv, preds, hist_mask, ~regions))
+            out = fc.band_anomalies(
+                xv, xm, regions, preds, sigma,
+                np.asarray([it.policy.threshold for it in group], np.float32),
+                np.asarray([it.policy.bound for it in group], np.int32),
+                np.asarray([it.policy.min_lower_bound for it in group], np.float32),
+            )
+            counts = np.asarray(out["count"])
+            firsts = np.asarray(out["first_index"])
+            uppers = np.asarray(out["upper"])
+            lowers = np.asarray(out["lower"])
+            flags = np.asarray(out["flags"])
+            checked = np.asarray(out["checked"])
+            for i, it in enumerate(group):
+                n_h = trimmed_n_h[id(it)]
+                anomalous_idx = np.nonzero(flags[i])[0]
+                anomaly_pairs = []
+                for j in anomalous_idx[:50]:
+                    anomaly_pairs += [_concat_ts(it.current, n_h, int(j)),
+                                      float(xv[i, j])]
+                region_sel = regions[i]
+                first = int(firsts[i])
+                results[(it.job_id, it.metric, "band")] = {
+                    "count": int(counts[i]),
+                    "unhealthy": int(counts[i]) >= self._gate(checked[i]),
+                    "first_ts": (
+                        _concat_ts(it.current, n_h, first) if first >= 0 else -1.0
+                    ),
+                    "upper": float(np.mean(uppers[i][region_sel])),
+                    "lower": float(np.mean(lowers[i][region_sel])),
+                    "anomaly_pairs": anomaly_pairs,
+                }
+        return results
+
+    def _gate(self, checked) -> float:
+        """Unhealthy-verdict gate: min anomalous points for a band-style
+        scorer to condemn a window (see EngineConfig.band_min_points)."""
+        return max(
+            self.config.band_min_points,
+            self.config.band_violation_fraction * float(checked),
+        )
+
+    def _score_bivariate(self, items: list[_BiItem]):
+        """Joint 2-metric scoring: one bivariate-normal program per bucket."""
+        results = {}
+        by_bucket: dict[int, list] = {}
+        prepped = {}
+        for it in items:
+            x, m, n_h, n_c = _joint_grid(list(it.hist), list(it.cur))
+            T = bucket_length(x.shape[1])
+            prepped[id(it)] = (x, m, n_h, n_c)
+            by_bucket.setdefault(T, []).append(it)
+        for T, group in by_bucket.items():
+            B = len(group)
+            x1 = np.zeros((B, T), np.float32)
+            x2 = np.zeros((B, T), np.float32)
+            m1 = np.zeros((B, T), bool)
+            m2 = np.zeros((B, T), bool)
+            region = np.zeros((B, T), bool)
+            thr = np.empty(B, np.float32)
+            mlb1 = np.empty(B, np.float32)
+            mlb2 = np.empty(B, np.float32)
+            bm1 = np.empty(B, np.int32)
+            bm2 = np.empty(B, np.int32)
+            for i, it in enumerate(group):
+                x, m, n_h, n_c = prepped[id(it)]
+                n = x.shape[1]
+                x1[i, :n], x2[i, :n] = x[0], x[1]
+                m1[i, :n], m2[i, :n] = m[0], m[1]
+                region[i, n_h:n] = True
+                # the pair shares one ellipse: use the stricter (smaller)
+                # radius of the two metric policies
+                thr[i] = min(it.policies[0].threshold, it.policies[1].threshold)
+                mlb1[i] = it.policies[0].min_lower_bound
+                mlb2[i] = it.policies[1].min_lower_bound
+                bm1[i] = it.policies[0].bound
+                bm2[i] = it.policies[1].bound
+            out = bv.bivariate_normal_anomalies(
+                x1, m1, x2, m2, region, thr, mlb1, mlb2, bm1, bm2
+            )
+            counts = np.asarray(out["count"])
+            firsts = np.asarray(out["first_index"])
+            checked = np.asarray(out["checked"])
+            flags = np.asarray(out["flags"])
+            upper1 = np.asarray(out["upper1"])
+            lower1 = np.asarray(out["lower1"])
+            upper2 = np.asarray(out["upper2"])
+            lower2 = np.asarray(out["lower2"])
+            for i, it in enumerate(group):
+                x, m, n_h, n_c = prepped[id(it)]
+                cur0 = it.cur[0]
+                first = int(firsts[i])
+                anomalous_idx = np.nonzero(flags[i])[0]
+                anomaly_pairs = []
+                for j in anomalous_idx[:50]:
+                    anomaly_pairs += [_concat_ts(cur0, n_h, int(j)),
+                                      float(x[0, int(j)])]
+                sel = region[i]
+                results[(it.job_id, "&".join(it.metrics), "bivariate")] = {
+                    "count": int(counts[i]),
+                    "unhealthy": int(counts[i]) >= self._gate(checked[i]),
+                    "first_ts": (
+                        _concat_ts(cur0, n_h, first) if first >= 0 else -1.0
+                    ),
+                    "anomaly_pairs": anomaly_pairs,
+                    "bounds": {
+                        it.metrics[0]: (
+                            float(np.mean(upper1[i][sel])),
+                            float(np.mean(lower1[i][sel])),
+                        ),
+                        it.metrics[1]: (
+                            float(np.mean(upper2[i][sel])),
+                            float(np.mean(lower2[i][sel])),
+                        ),
+                    },
+                }
+        return results
+
+    def _lstm_model(self, F: int):
+        key = (F, self.config.lstm_hidden, self.config.lstm_latent)
+        if key not in self._lstm_models:
+            self._lstm_models[key] = lstm_ae.LstmAutoencoder(
+                hidden=self.config.lstm_hidden,
+                latent=self.config.lstm_latent,
+                features=F,
+            )
+        return self._lstm_models[key]
+
+    def _score_multi(self, items: list[_MultiItem]):
+        """LSTM-autoencoder scoring for 3+-metric jobs (faq.md:8-10).
+
+        Per job: standardize each metric on its history, train the AE on
+        non-overlapping historical subwindows (cached per app, LRU-bounded by
+        MAX_CACHE_SIZE), then z-score the current window's reconstruction
+        error against the healthy-error distribution."""
+        import jax as _jax
+
+        cfg = self.config
+        results = {}
+        for it in items:
+            x, m, n_h, n_c = _joint_grid(it.hist, it.cur)
+            F, T = x.shape
+            W = min(cfg.lstm_window, max(n_h // 2, 1))
+            if W < 4 or n_h < 2 * W:
+                # not enough history to learn from: leave the job unjudged
+                # (COMPLETED_UNKNOWN at endTime), same as sparse band jobs
+                continue
+            hist_m = m[:, :n_h]
+            hw = hist_m.astype(np.float32)
+            n = np.maximum(hw.sum(axis=1), 1.0)
+            mu = (x[:, :n_h] * hw).sum(axis=1) / n
+            sd = np.sqrt((((x[:, :n_h] - mu[:, None]) * hw) ** 2).sum(axis=1) / n)
+            sd = np.maximum(sd, 1e-6)
+            xs = ((x - mu[:, None]) / sd[:, None]).T.astype(np.float32)  # (T, F)
+            ms = m.T  # (T, F)
+
+            k = n_h // W
+            h0 = n_h - k * W
+            hwin = xs[h0:n_h].reshape(k, W, F)
+            hmask = ms[h0:n_h].reshape(k, W, F)
+            # score windows tiling the WHOLE current region (not just the
+            # last W steps); a final tail window may dip into history — its
+            # history steps are mask-zeroed so they add no reconstruction
+            # error and cannot dilute the z-score
+            starts = list(range(n_h, T - W + 1, W))
+            if not starts or starts[-1] + W < T:
+                starts.append(max(T - W, 0))
+            cwin = np.stack([xs[s : s + W] for s in starts])
+            cmask = np.stack([ms[s : s + W] for s in starts])
+            for k_i, s in enumerate(starts):
+                if s < n_h:
+                    cmask[k_i, : n_h - s] = False
+
+            model = self._lstm_model(F)
+            cache_key = (it.cache_key, tuple(it.metrics), W)
+            entry = self._lstm_cache.pop(cache_key, None)
+            if entry is None:
+                state, tx = lstm_ae.init_state(model, _jax.random.PRNGKey(0), T=W)
+                state, _ = lstm_ae.train(
+                    model, state, tx, hwin, hmask, epochs=cfg.lstm_epochs
+                )
+                err_mu, err_sd = lstm_ae.fit_score_normalizer(
+                    state.params, hwin, hmask, model.apply
+                )
+                entry = (state.params, float(err_mu), float(err_sd))
+            self._lstm_cache[cache_key] = entry  # re-insert = mark recent
+            while len(self._lstm_cache) > cfg.max_cache_size:
+                self._lstm_cache.pop(next(iter(self._lstm_cache)))
+            params, err_mu, err_sd = entry
+            z = float(
+                np.max(
+                    np.asarray(
+                        lstm_ae.anomaly_scores(
+                            params, cwin, cmask, err_mu, err_sd, model.apply
+                        )
+                    )
+                )
+            )
+            results[(it.job_id, "+".join(it.metrics), "lstm")] = {
+                "unhealthy": z > cfg.lstm_threshold,
+                "z": z,
+            }
+        return results
+
+    def _score_hpa(self, items: list[_HpaItem]):
+        """Batch HPA items: primary (priority 0 / tps-like) metric drives the
+        traffic model; an SLA metric (is_increase & priority>0) the reward."""
+        by_job: dict[str, list[_HpaItem]] = {}
+        for it in items:
+            by_job.setdefault(it.job_id, []).append(it)
+        out = {}
+        rows = []
+        for job_id, group in by_job.items():
+            group.sort(key=lambda it: it.priority)
+            tps_it = group[0]
+            # SLA metric contract: is_increase (a "more is worse" signal)
+            # with priority > 0; fall back to any secondary, then primary
+            sla_candidates = [it for it in group[1:] if it.is_increase]
+            if sla_candidates:
+                sla_it = sla_candidates[0]
+            else:
+                sla_it = group[1] if len(group) > 1 else group[0]
+            rows.append((job_id, tps_it, sla_it))
+        if not rows:
+            return out
+        # pack length must fit BOTH the tps and sla series (lengths are
+        # data-driven and independent)
+        T = max(
+            bucket_length(
+                min(
+                    it.historical.values.shape[0] + it.current.values.shape[0],
+                    MAX_WINDOW_STEPS,
+                )
+            )
+            for row in rows
+            for it in (row[1], row[2])
+        )
+
+        def build(it):
+            vals, mask, n_h = _concat_trimmed(it.historical, it.current)
+            region = np.zeros(T, bool)
+            region[n_h : vals.shape[0]] = True
+            return Window(vals, mask, it.historical.start), region
+
+        tps_w, regions = zip(*[build(t) for _, t, _ in rows])
+        sla_w = [build(s)[0] for _, _, s in rows]
+        tv, tm = pack_windows(list(tps_w), pad_to=T)
+        sv, sm = pack_windows(list(sla_w), pad_to=T)
+        reg = np.stack(list(regions))
+        hist_mask = tm & ~reg
+        B = tv.shape[0]
+        preds = np.asarray(
+            fc.ses_predictions(tv, hist_mask, np.full(B, 0.3, np.float32))
+        )
+        sigma = np.asarray(fc.residual_sigma(tv, preds, hist_mask, ~reg))
+        res = hpa_ops.hpa_scores(
+            tv, tm, reg, preds, sigma, sv, sm,
+            np.full(B, 1e9, np.float32),  # static SLA unset -> huge
+            np.full(B, hpa_ops.SLA_DYNAMIC, np.int32),
+            np.full(B, self.config.threshold, np.float32),
+        )
+        for i, (job_id, tps_it, sla_it) in enumerate(rows):
+            out[job_id] = {
+                "raw_score": float(res["score"][i]),
+                "reason_code": int(res["reason"][i]),
+                "tps_metric": tps_it.metric,
+                "sla_metric": sla_it.metric,
+                "current_tps": float(res["current_tps"][i]),
+                "upper": float(res["tps_upper"][i]),
+                "lower": float(res["tps_lower"][i]),
+                "sla_current": float(res["sla_current"][i]),
+                "sla_limit": float(res["sla_limit"][i]),
+            }
+        return out
+
+    # ------------------------------------------------------------- verdict
+    def run_cycle(self, worker: str = "worker-0", now: float | None = None) -> dict:
+        """One engine cycle. Returns {job_id: new_status} for observability."""
+        with tracing.span("engine.cycle", worker=worker):
+            return self._run_cycle(worker, now)
+
+    def _run_cycle(self, worker: str, now: float | None) -> dict:
+        now = time.time() if now is None else now
+        with tracing.span("engine.claim"):
+            claimed = self.store.claim_open_jobs(
+                worker, max_stuck_seconds=self.config.max_stuck_seconds
+            )
+        states: dict[str, _JobState] = {}
+        all_pairs: list[_PairItem] = []
+        all_bands: list[_BandItem] = []
+        all_bis: list[_BiItem] = []
+        all_multis: list[_MultiItem] = []
+        all_hpas: list[_HpaItem] = []
+        with tracing.span("engine.preprocess", jobs=len(claimed)):
+            for doc in claimed:
+                st = _JobState(doc)
+                states[doc.id] = st
+                try:
+                    pairs, bands, bis, multis, hpas = self._preprocess(doc, now)
+                    all_pairs += pairs
+                    all_bands += bands
+                    all_bis += bis
+                    all_multis += multis
+                    all_hpas += hpas
+                except FetchError as e:
+                    st.failed = str(e)
+        for doc_id, st in states.items():
+            if st.failed:
+                if st.doc.strategy in CONTINUOUS_STRATEGIES:
+                    # perpetual jobs survive transient fetch errors: requeue
+                    # instead of dying terminally on one network blip
+                    self.store.transition(
+                        doc_id, J.INITIAL, reason=f"fetch retry: {st.failed}",
+                        worker=worker,
+                    )
+                else:
+                    self.store.transition(
+                        doc_id, J.PREPROCESS_FAILED, reason=st.failed, worker=worker
+                    )
+            else:
+                self.store.transition(doc_id, J.PREPROCESS_COMPLETED, worker=worker)
+                self.store.transition(doc_id, J.POSTPROCESS_INPROGRESS, worker=worker)
+
+        live = {k: v for k, v in states.items() if not v.failed}
+        with tracing.span("engine.score", pairs=len(all_pairs),
+                          bands=len(all_bands), bis=len(all_bis),
+                          multis=len(all_multis), hpas=len(all_hpas)):
+            pair_res, pair_bad = self._isolate(self._score_pairs, all_pairs)
+            band_res, band_bad = self._isolate(self._score_bands, all_bands)
+            bi_res, bi_bad = self._isolate(self._score_bivariate, all_bis)
+            multi_res, multi_bad = self._isolate(self._score_multi, all_multis)
+            hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
+        scoring_failed = {**pair_bad, **band_bad, **bi_bad, **multi_bad, **hpa_bad}
+
+        # fold per-metric results into per-job verdicts
+        for it in all_pairs:
+            r = pair_res.get((it.job_id, it.metric, "pair"))
+            if r is None:
+                continue
+            st = live[it.job_id]
+            st.judged_any = True
+            if r["unhealthy"]:
+                causes = []
+                if r["pairwise_unhealthy"]:
+                    causes.append(f"pairwise rejection p={r['min_p']:.2e}")
+                if r["band_unhealthy"]:
+                    causes.append(
+                        f"{r['band_count']} points outside the baseline band"
+                    )
+                st.unhealthy.append((it.metric, "; ".join(causes), []))
+        for it in all_bands:
+            r = band_res.get((it.job_id, it.metric, "band"))
+            if r is None:
+                continue
+            st = live[it.job_id]
+            st.judged_any = True
+            self.exporter.record_bounds(
+                st.doc.app_name, st.doc.namespace, it.metric,
+                r["upper"], r["lower"], float(r["unhealthy"]),
+            )
+            if r["unhealthy"]:
+                st.unhealthy.append(
+                    (
+                        it.metric,
+                        f"{r['count']} points outside "
+                        f"[{r['lower']:.4g},{r['upper']:.4g}] from ts {r['first_ts']:.0f}",
+                        r["anomaly_pairs"],
+                    )
+                )
+        for it in all_bis:
+            r = bi_res.get((it.job_id, "&".join(it.metrics), "bivariate"))
+            if r is None:
+                continue
+            st = live[it.job_id]
+            st.judged_any = True
+            for metric, (upper, lower) in r["bounds"].items():
+                self.exporter.record_bounds(
+                    st.doc.app_name, st.doc.namespace, metric,
+                    upper, lower, float(r["unhealthy"]),
+                )
+            if r["unhealthy"]:
+                st.unhealthy.append(
+                    (
+                        "&".join(it.metrics),
+                        f"{r['count']} points outside the joint "
+                        f"bivariate-normal ellipse from ts {r['first_ts']:.0f}",
+                        r["anomaly_pairs"],
+                    )
+                )
+        for it in all_multis:
+            r = multi_res.get((it.job_id, "+".join(it.metrics), "lstm"))
+            if r is None:
+                continue
+            st = live[it.job_id]
+            st.judged_any = True
+            if r["unhealthy"]:
+                st.unhealthy.append(
+                    (
+                        "+".join(it.metrics),
+                        f"LSTM-AE reconstruction z={r['z']:.2f} exceeds "
+                        f"{self.config.lstm_threshold:.1f}",
+                        [],
+                    )
+                )
+
+        outcomes = {}
+        for job_id, st in live.items():
+            doc = st.doc
+            if job_id in scoring_failed:
+                reason = f"scoring failed: {scoring_failed[job_id]}"
+                if doc.strategy in CONTINUOUS_STRATEGIES:
+                    # perpetual jobs retry next cycle (data may heal)
+                    self.store.transition(job_id, J.INITIAL, reason=reason, worker=worker)
+                    outcomes[job_id] = J.INITIAL
+                else:
+                    self.store.transition(job_id, J.ABORT, reason=reason, worker=worker)
+                    outcomes[job_id] = J.ABORT
+                continue
+            if doc.strategy == STRATEGY_HPA:
+                outcomes[job_id] = self._finish_hpa(st, hpa_res.get(job_id), worker, now)
+                continue
+            try:
+                end_time = from_rfc3339(doc.end_time)
+            except (ValueError, TypeError):
+                # continuous jobs carry END_TIME placeholders: never expire
+                end_time = float("inf") if doc.strategy in CONTINUOUS_STRATEGIES else now
+            if st.unhealthy:
+                metrics = ", ".join(dict.fromkeys(m for m, _, _ in st.unhealthy))
+                reason = "; ".join(f"{m}: {d}" for m, d, _ in st.unhealthy)
+                anomaly = {m: pairs for m, _, pairs in st.unhealthy if pairs}
+                self.store.transition(
+                    job_id, J.COMPLETED_UNHEALTH,
+                    reason=f"anomaly detected on {metrics} :: {reason}",
+                    anomaly=anomaly, worker=worker,
+                )
+                outcomes[job_id] = J.COMPLETED_UNHEALTH
+            elif now < end_time:
+                # healthy so far; keep watching until endTime (fail-fast
+                # rule); continuous jobs loop here forever
+                self.store.requeue(job_id, worker=worker)
+                outcomes[job_id] = J.INITIAL
+            elif st.judged_any:
+                self.store.transition(job_id, J.COMPLETED_HEALTH, worker=worker)
+                outcomes[job_id] = J.COMPLETED_HEALTH
+            else:
+                self.store.transition(
+                    job_id, J.COMPLETED_UNKNOWN,
+                    reason="insufficient data points to judge", worker=worker,
+                )
+                outcomes[job_id] = J.COMPLETED_UNKNOWN
+        self.store.flush()
+        return outcomes
+
+    def _finish_hpa(self, st: _JobState, res, worker: str, now: float) -> str:
+        doc = st.doc
+        if res is None:
+            self.store.requeue(doc.id, worker=worker)
+            return J.INITIAL
+        gated = self.breath.apply(doc.id, res["raw_score"], now=now)
+        reason_names = {0: "predicted trend", 1: "anomaly trend", 2: "SLA violation"}
+        reason = (
+            f"hpa score {gated:.1f} (raw {res['raw_score']:.1f}) via "
+            f"{reason_names.get(res['reason_code'], '?')} on {res['tps_metric']}"
+        )
+        self.store.add_hpalog(
+            J.HpaLog(
+                job_id=doc.id,
+                hpascore=gated,
+                reason=reason,
+                details=[
+                    {
+                        "metricType": res["tps_metric"],
+                        "current": res["current_tps"],
+                        "upper": res["upper"],
+                        "lower": res["lower"],
+                    },
+                    {
+                        "metricType": res["sla_metric"],
+                        "current": res["sla_current"],
+                        "upper": res["sla_limit"],
+                        "lower": 0.0,
+                    },
+                ],
+                timestamp=now,
+            )
+        )
+        self.exporter.record_hpa_score(doc.app_name, doc.namespace, gated)
+        self.store.requeue(doc.id, worker=worker)
+        return J.INITIAL

@@ -1,0 +1,190 @@
+"""Pluggable job archive: the reference's Elasticsearch role, optional.
+
+The reference parks every job document and HPA log in ES indices
+`documents`/`hpalogs` (foremast-service/pkg/search/elasticsearchstore.go:
+17-21) — its durability AND its audit surface (Kibana over ES,
+design.md:49-51). The TPU runtime's live store is in-process (jobs resolve
+in milliseconds; a queue database adds nothing), so the archive is a
+write-behind sink for *terminal* jobs and hpalogs:
+
+  * `FileArchive` — newline-delimited JSON with size-based rotation; zero
+    dependencies, queryable via /v1/healthcheck/search.
+  * `EsArchive` — same record stream PUT into real ES-compatible indices
+    (same names as the reference), for fleets that already run
+    ES/OpenSearch + Kibana. Best-effort: archive failures must never fail
+    a verdict.
+
+Both implement index_job/index_hpalog/search; JobStore calls them on
+terminal transitions, which also makes terminal-job pruning safe
+(JobStore.gc) — the reference never prunes ES, we must not grow RAM
+forever.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+
+__all__ = ["FileArchive", "EsArchive"]
+
+
+def _statuses(status) -> list | None:
+    """Normalize a status filter to a list (or None = any)."""
+    if not status:
+        return None
+    return [status] if isinstance(status, str) else list(status)
+
+
+def _match(rec: dict, app, namespace, status, strategy) -> bool:
+    """Shared live/archive record predicate; status may be str or list."""
+    statuses = _statuses(status)
+    return (
+        (app is None or rec.get("app_name") == app)
+        and (namespace is None or rec.get("namespace") == namespace)
+        and (statuses is None or rec.get("status") in statuses)
+        and (strategy is None or rec.get("strategy") == strategy)
+    )
+
+
+class FileArchive:
+    """Append-only JSONL archive with one-generation rotation."""
+
+    def __init__(self, path: str, max_bytes: int = 64 * 1024 * 1024):
+        self.path = path
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    # -- writing --
+    def _append(self, rec: dict) -> bool:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            try:
+                if (os.path.exists(self.path)
+                        and os.path.getsize(self.path) + len(line) > self.max_bytes):
+                    os.replace(self.path, self.path + ".1")
+            except OSError:
+                pass
+            try:
+                with open(self.path, "a") as f:
+                    f.write(line)
+            except OSError:
+                return False  # disk full/unwritable: caller keeps RAM copy
+        return True
+
+    def index_job(self, doc: dict) -> bool:
+        return self._append({"_type": "document", **doc})
+
+    def index_hpalog(self, log: dict) -> bool:
+        return self._append({"_type": "hpalog", **log})
+
+    def get(self, job_id: str) -> dict | None:
+        """Latest archived record for one job id."""
+        out = None
+        for rec in self._iter_records():
+            if rec.get("_type") == "document" and rec.get("id") == job_id:
+                out = rec  # later lines overwrite earlier
+        return out
+
+    # -- reading --
+    def _iter_records(self):
+        with self._lock:
+            paths = [self.path + ".1", self.path]
+            lines = []
+            for p in paths:
+                try:
+                    with open(p) as f:
+                        lines += f.readlines()
+                except OSError:
+                    continue
+        for line in lines:
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write after a crash
+
+    def search(self, app=None, namespace=None, status=None, strategy=None,
+               limit: int = 50) -> list[dict]:
+        """Newest-last-write-wins per job id, newest first, capped."""
+        by_id: dict[str, dict] = {}
+        for rec in self._iter_records():
+            if rec.get("_type") != "document":
+                continue
+            if not _match(rec, app, namespace, status, strategy):
+                continue
+            by_id[rec.get("id", "")] = rec  # later lines overwrite earlier
+        out = list(by_id.values())
+        out.sort(key=lambda r: r.get("modified_at", 0.0), reverse=True)
+        return out[:limit]
+
+
+class EsArchive:
+    """Write-behind into ES-compatible REST indices (documents/hpalogs)."""
+
+    def __init__(self, endpoint: str, documents_index: str = "documents",
+                 hpalogs_index: str = "hpalogs", timeout: float = 5.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.documents_index = documents_index
+        self.hpalogs_index = hpalogs_index
+        self.timeout = timeout
+        self.errors = 0  # observability: archive is best-effort
+
+    def _req(self, method: str, path: str, body: dict | None = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.endpoint}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def index_job(self, doc: dict) -> bool:
+        try:
+            self._req("PUT", f"/{self.documents_index}/_doc/{doc['id']}", doc)
+            return True
+        except Exception:  # noqa: BLE001 - never fail a verdict on archive IO
+            self.errors += 1
+            return False
+
+    def index_hpalog(self, log: dict) -> bool:
+        try:
+            self._req("POST", f"/{self.hpalogs_index}/_doc", log)
+            return True
+        except Exception:  # noqa: BLE001
+            self.errors += 1
+            return False
+
+    def get(self, job_id: str) -> dict | None:
+        try:
+            res = self._req("GET", f"/{self.documents_index}/_doc/{job_id}")
+        except Exception:  # noqa: BLE001
+            self.errors += 1
+            return None
+        return res.get("_source")
+
+    def search(self, app=None, namespace=None, status=None, strategy=None,
+               limit: int = 50) -> list[dict]:
+        terms = []
+        for field_name, v in (("app_name", app), ("namespace", namespace),
+                              ("strategy", strategy)):
+            if v is not None:
+                terms.append({"term": {f"{field_name}.keyword": v}})
+        statuses = _statuses(status)
+        if statuses is not None:
+            terms.append({"terms": {"status.keyword": statuses}})
+        query = {"bool": {"must": terms}} if terms else {"match_all": {}}
+        try:
+            res = self._req(
+                "POST",
+                f"/{self.documents_index}/_search",
+                {"query": query, "size": limit,
+                 "sort": [{"modified_at": "desc"}]},
+            )
+        except Exception:  # noqa: BLE001
+            self.errors += 1
+            return []
+        return [h.get("_source", {}) for h in
+                res.get("hits", {}).get("hits", [])]

@@ -50,8 +50,7 @@ class EngineConfig:
     max_cache_size: int = 1024  # MAX_CACHE_SIZE (model/window cache entries)
     ma_window: int = 30  # moving-average lookback (steps)
     # windows at/above this length use the time-parallel associative-scan
-    # SES smoother (ops/seqscan.py) instead of sequential lax.scan; DES
-    # always stays sequential (f32 drift — see seqscan.py docstring)
+    # smoothers (ops/seqscan.py) instead of sequential lax.scan
     long_window_steps: int = 4096  # LONG_WINDOW_STEPS
     hw_period: int = 1440  # Holt-Winters / seasonal-trend period (steps; 1 day at 60s)
     st_order: int = 3  # seasonal-trend (prophet) Fourier order
@@ -99,15 +98,13 @@ class EngineConfig:
             "wilcoxon": fl.TEST_WILCOXON,
             "kruskal": fl.TEST_KRUSKAL,
             "ks": fl.TEST_KS,
-            "friedman": fl.TEST_FRIEDMAN,
         }
         for key, bit in table.items():
             if name.startswith(key):
                 return bit
         # "all"/"any" composite modes enable the full family
         return (
-            fl.TEST_MANN_WHITNEY | fl.TEST_WILCOXON | fl.TEST_KRUSKAL
-            | fl.TEST_KS | fl.TEST_FRIEDMAN
+            fl.TEST_MANN_WHITNEY | fl.TEST_WILCOXON | fl.TEST_KRUSKAL | fl.TEST_KS
         )
 
 

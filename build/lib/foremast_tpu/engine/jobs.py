@@ -1,0 +1,374 @@
+"""Job documents, the brain status machine, and the durable job store.
+
+Wire/behavior contracts re-implemented (not ported) from the reference:
+  * internal statuses and their lifecycle — initial -> preprocess_inprogress
+    -> preprocess_completed -> postprocess_inprogress -> completed_health |
+    completed_unhealth | completed_unknown | preprocess_failed | abort
+    (foremast-service/pkg/converter/converter.go:10-29).
+  * external mapping — new / inprogress / success / anomaly / abort
+    (converter.go:10-29).
+  * document shape — appName, strategy, per-category query-config strings,
+    hpa metric flags, podCountURL, status, reason, processingContent
+    (foremast-service/pkg/models/models.go:102-124).
+  * stuck-job takeover — any job inprogress longer than MAX_STUCK_IN_SECONDS
+    may be re-leased by another worker (design.md:37-43; 90 s at
+    foremast-brain.yaml:80-81). The store is the lease medium, like ES was.
+
+The store here is in-memory + thread-safe with an optional JSON snapshot
+(checkpoint/resume); it is deliberately pluggable — an ES-backed archive can
+implement the same four methods.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+from .archive import _match
+
+
+# --- internal status machine -------------------------------------------------
+INITIAL = "initial"
+PREPROCESS_INPROGRESS = "preprocess_inprogress"
+PREPROCESS_COMPLETED = "preprocess_completed"
+POSTPROCESS_INPROGRESS = "postprocess_inprogress"
+COMPLETED_HEALTH = "completed_health"
+COMPLETED_UNHEALTH = "completed_unhealth"
+COMPLETED_UNKNOWN = "completed_unknown"
+PREPROCESS_FAILED = "preprocess_failed"
+ABORT = "abort"
+
+OPEN_STATUSES = (INITIAL, PREPROCESS_INPROGRESS, PREPROCESS_COMPLETED, POSTPROCESS_INPROGRESS)
+TERMINAL_STATUSES = (COMPLETED_HEALTH, COMPLETED_UNHEALTH, COMPLETED_UNKNOWN, PREPROCESS_FAILED, ABORT)
+INPROGRESS_STATUSES = (PREPROCESS_INPROGRESS, PREPROCESS_COMPLETED, POSTPROCESS_INPROGRESS)
+
+_TRANSITIONS = {
+    INITIAL: {PREPROCESS_INPROGRESS, ABORT},
+    # INITIAL also reachable: transient fetch failures on perpetual
+    # (continuous/hpa) jobs requeue instead of dying
+    PREPROCESS_INPROGRESS: {PREPROCESS_COMPLETED, PREPROCESS_FAILED, INITIAL, ABORT},
+    PREPROCESS_COMPLETED: {POSTPROCESS_INPROGRESS, ABORT},
+    POSTPROCESS_INPROGRESS: {
+        COMPLETED_HEALTH,
+        COMPLETED_UNHEALTH,
+        COMPLETED_UNKNOWN,
+        # healthy-so-far jobs requeue until endTime (fail-fast rule:
+        # design.md:43); continuous/hpa jobs requeue every cycle
+        INITIAL,
+        ABORT,
+    },
+}
+
+EXTERNAL_STATUS = {
+    INITIAL: "new",
+    PREPROCESS_INPROGRESS: "inprogress",
+    PREPROCESS_COMPLETED: "inprogress",
+    POSTPROCESS_INPROGRESS: "inprogress",
+    COMPLETED_HEALTH: "success",
+    COMPLETED_UNHEALTH: "anomaly",
+    COMPLETED_UNKNOWN: "abort",
+    PREPROCESS_FAILED: "abort",
+    ABORT: "abort",
+}
+
+
+def to_external(status: str) -> str:
+    return EXTERNAL_STATUS.get(status, "unknown")
+
+
+class InvalidTransition(Exception):
+    pass
+
+
+@dataclass
+class MetricQueries:
+    """Per-metric query URLs by category."""
+
+    current: str = ""
+    baseline: str = ""
+    historical: str = ""
+    # hpa flags (models.go:179-183 HPAMetric)
+    priority: int = 0
+    is_increase: bool = True
+    is_absolute: bool = False
+
+
+@dataclass
+class Document:
+    """One analysis job."""
+
+    id: str
+    app_name: str
+    strategy: str  # rollingUpdate | canary | continuous | hpa | rollover
+    start_time: str
+    end_time: str
+    namespace: str = ""
+    metrics: dict = field(default_factory=dict)  # name -> MetricQueries
+    pod_count_url: str = ""
+    status: str = INITIAL
+    reason: str = ""
+    anomaly: dict = field(default_factory=dict)  # metric -> flat [ts,v,...]
+    processing_content: str = ""
+    created_at: float = field(default_factory=time.time)
+    modified_at: float = field(default_factory=time.time)
+    lease_holder: str = ""
+    lease_at: float = 0.0
+    archived_at: float = 0.0  # >0 once the archive confirmed the write
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["metrics"] = {k: asdict(v) if isinstance(v, MetricQueries) else v for k, v in self.metrics.items()}
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Document":
+        d = dict(d)
+        d["metrics"] = {k: MetricQueries(**v) for k, v in d.get("metrics", {}).items()}
+        return cls(**d)
+
+
+@dataclass
+class HpaLog:
+    """hpalogs record (models.go:194-209): score + reasoning details."""
+
+    job_id: str
+    hpascore: float
+    reason: str
+    details: list  # [{metricType, current, upper, lower}]
+    timestamp: float = field(default_factory=time.time)
+
+
+class JobStore:
+    """Thread-safe job + hpalog store with lease-based work stealing.
+
+    `archive` (engine/archive.py) is an optional write-behind sink: every
+    terminal transition and hpalog is mirrored there, which is what makes
+    `gc()` safe — terminal jobs older than the retention window are pruned
+    from memory because their record of truth lives in the archive (ES's
+    role in the reference; it never pruned, but it also wasn't RAM).
+    """
+
+    def __init__(self, snapshot_path: str | None = None, archive=None):
+        self._lock = threading.RLock()
+        self._jobs: dict[str, Document] = {}
+        self._hpalogs: list[HpaLog] = []
+        self._snapshot_path = snapshot_path
+        self.archive = archive
+        self._dirty = False
+        self._last_write = 0.0
+        if snapshot_path:
+            self._load()
+
+    # -- documents --
+    def create(self, doc: Document) -> tuple[Document, bool]:
+        """Create or return the existing open duplicate (dedupe-by-id,
+        matching the reference service's create semantics)."""
+        with self._lock:
+            cur = self._jobs.get(doc.id)
+            if cur is not None and cur.status in OPEN_STATUSES:
+                return cur, False
+            self._jobs[doc.id] = doc
+            self._persist()
+            return doc, True
+
+    def get(self, job_id: str) -> Document | None:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def transition(self, job_id: str, new_status: str, *, reason: str = "",
+                   anomaly: dict | None = None, worker: str = "") -> Document:
+        with self._lock:
+            doc = self._jobs[job_id]
+            allowed = _TRANSITIONS.get(doc.status, set())
+            if new_status not in allowed:
+                raise InvalidTransition(f"{doc.status} -> {new_status}")
+            doc.status = new_status
+            doc.modified_at = time.time()
+            if reason:
+                doc.reason = reason
+            if anomaly is not None:
+                doc.anomaly = anomaly
+            if worker:
+                doc.lease_holder = worker
+                doc.lease_at = doc.modified_at
+            self._persist()
+            archive_rec = (
+                doc.to_json()
+                if self.archive is not None and new_status in TERMINAL_STATUSES
+                else None
+            )
+        # archive I/O OUTSIDE the lock: a slow/unreachable archive must not
+        # stall claim/create/status for every other worker and API thread.
+        # Terminal docs never transition again, so the record is stable.
+        if archive_rec is not None and self.archive.index_job(archive_rec):
+            doc.archived_at = time.time()
+        return doc
+
+    def claim_open_jobs(self, worker: str, limit: int = 1024,
+                        max_stuck_seconds: float = 90.0) -> list[Document]:
+        """Lease up to `limit` runnable jobs for `worker`.
+
+        A job is runnable if INITIAL, or stuck in an inprogress status longer
+        than max_stuck_seconds (takeover — the reference's shared-nothing
+        recovery mechanism).
+        """
+        now = time.time()
+        out = []
+        with self._lock:
+            for doc in self._jobs.values():
+                if len(out) >= limit:
+                    break
+                if doc.status == INITIAL:
+                    doc.status = PREPROCESS_INPROGRESS
+                elif doc.status in INPROGRESS_STATUSES and (
+                    now - (doc.lease_at or doc.modified_at) > max_stuck_seconds
+                ):
+                    doc.status = PREPROCESS_INPROGRESS  # reprocess from scratch
+                else:
+                    continue
+                doc.lease_holder = worker
+                doc.lease_at = now
+                doc.modified_at = now
+                out.append(doc)
+            if out:
+                self._persist()
+        return out
+
+    def requeue(self, job_id: str, worker: str = "") -> Document:
+        """Back to INITIAL for the next cycle (keeps reason/anomaly/config)."""
+        return self.transition(job_id, INITIAL, worker=worker)
+
+    def by_status(self, *statuses: str) -> list[Document]:
+        with self._lock:
+            return [d for d in self._jobs.values() if d.status in statuses]
+
+    # -- hpa logs --
+    def add_hpalog(self, log: HpaLog, keep_last: int = 1000):
+        with self._lock:
+            self._hpalogs.append(log)
+            if len(self._hpalogs) > keep_last:
+                self._hpalogs = self._hpalogs[-keep_last:]
+            self._persist()
+        if self.archive is not None:
+            self.archive.index_hpalog(asdict(log))
+
+    def gc(self, max_age_seconds: float = 24 * 3600.0,
+           now: float | None = None) -> int:
+        """Prune terminal jobs older than the retention window.
+
+        A job is only dropped once the archive has CONFIRMED holding its
+        terminal record (archived_at > 0) — jobs resumed from an
+        older snapshot, or whose archive write failed, are (re)archived
+        here first and survive in RAM until that succeeds. Without an
+        archive nothing is ever pruned. Returns the number dropped.
+        """
+        if self.archive is None:
+            return 0
+        now = time.time() if now is None else now
+        with self._lock:
+            candidates = [
+                doc for doc in self._jobs.values()
+                if doc.status in TERMINAL_STATUSES
+                and now - doc.modified_at > max_age_seconds
+            ]
+        dropped = 0
+        for doc in candidates:  # archive I/O outside the lock
+            if doc.archived_at <= 0:
+                if not self.archive.index_job(doc.to_json()):
+                    continue  # archive unavailable: keep the job in RAM
+                doc.archived_at = time.time()
+            with self._lock:
+                if self._jobs.get(doc.id) is doc:  # not re-created meanwhile
+                    del self._jobs[doc.id]
+                    dropped += 1
+        if dropped:
+            with self._lock:
+                self._persist()
+        return dropped
+
+    def search(self, app=None, namespace=None, status=None, strategy=None,
+               limit: int = 50) -> list[dict]:
+        """Live store + archive, newest first, deduped by job id.
+
+        `status` may be a single internal status or a list of them (one
+        pass either way — the archive is read once).
+        """
+        statuses = ([status] if isinstance(status, str) else
+                    list(status) if status else None)
+        with self._lock:
+            live = [
+                d.to_json() for d in self._jobs.values()
+                if _match({"app_name": d.app_name, "namespace": d.namespace,
+                           "status": d.status, "strategy": d.strategy},
+                          app, namespace, statuses, strategy)
+            ]
+        seen = {r["id"] for r in live}
+        if self.archive is not None:
+            for rec in self.archive.search(app=app, namespace=namespace,
+                                           status=statuses, strategy=strategy,
+                                           limit=limit):
+                rec = {k: v for k, v in rec.items() if k != "_type"}
+                if rec.get("id") not in seen:
+                    live.append(rec)
+                    seen.add(rec.get("id"))
+        live.sort(key=lambda r: r.get("modified_at", 0.0), reverse=True)
+        return live[:limit]
+
+    def hpalogs_for(self, job_id: str, limit: int = 20) -> list[HpaLog]:
+        with self._lock:
+            logs = [l for l in self._hpalogs if l.job_id == job_id]
+        return sorted(logs, key=lambda l: -l.timestamp)[:limit]
+
+    # -- checkpoint/resume --
+    def _persist(self):
+        """Debounced write-behind: serializing the whole store on every
+        transition would be O(jobs^2) per cycle under the lock; the 90 s
+        lease takeover already tolerates a snapshot up to a second stale."""
+        if not self._snapshot_path:
+            return
+        now = time.time()
+        self._dirty = True
+        if now - self._last_write < 1.0:
+            return
+        self.flush()
+
+    def flush(self):
+        """Force-write the snapshot (called at cycle boundaries/shutdown).
+
+        Serialize AND write under the lock: concurrent flushes share one
+        .tmp path, so an unlocked write lets two threads interleave bytes
+        and os.replace() a corrupt snapshot into place.
+        """
+        if not self._snapshot_path:
+            return
+        with self._lock:
+            if not self._dirty:
+                return
+            data = {
+                "jobs": [d.to_json() for d in self._jobs.values()],
+                "hpalogs": [asdict(l) for l in self._hpalogs],
+            }
+            self._dirty = False
+            self._last_write = time.time()
+            tmp = self._snapshot_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._snapshot_path)
+
+    def _load(self):
+        if not os.path.exists(self._snapshot_path):
+            return
+        try:
+            with open(self._snapshot_path) as f:
+                data = json.load(f)
+            jobs = {d["id"]: Document.from_json(d) for d in data.get("jobs", [])}
+            logs = [HpaLog(**l) for l in data.get("hpalogs", [])]
+        except (json.JSONDecodeError, OSError, KeyError, TypeError):
+            # a torn/corrupt snapshot must not brick the service: quarantine
+            # it and start empty (jobs are re-submitted by the operator tick)
+            os.replace(self._snapshot_path, self._snapshot_path + ".corrupt")
+            return
+        self._jobs = jobs
+        self._hpalogs = logs

@@ -1,0 +1,50 @@
+"""Background engine workers: the brain's poll loop, in-process.
+
+The reference runs N shared-nothing brain replicas polling ES
+(docs/guides/design.md:37-43). Here workers are threads over the in-process
+JobStore — the lease/takeover semantics in JobStore.claim_open_jobs keep the
+shared-nothing recovery behavior (a worker dying mid-job surrenders it after
+MAX_STUCK_IN_SECONDS), while scoring itself is batched per cycle so more
+workers are only needed to overlap fetch I/O, never for compute.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .analyzer import Analyzer
+
+log = logging.getLogger("foremast_tpu.engine")
+
+
+class EngineWorker:
+    def __init__(self, analyzer: Analyzer, name: str = "worker-0",
+                 poll_interval: float = 10.0):
+        self.analyzer = analyzer
+        self.name = name
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.cycles = 0
+        self.last_error: str = ""
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name=self.name, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.analyzer.run_cycle(worker=self.name)
+                self.cycles += 1
+            except Exception as e:  # noqa: BLE001 - worker must survive
+                self.last_error = f"{type(e).__name__}: {e}"
+                log.exception("engine cycle failed")
+            self._stop.wait(self.poll_interval)
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout)

@@ -1,0 +1,1 @@
+"""Examples: the demo app (error/load generators) and scenario scripts."""

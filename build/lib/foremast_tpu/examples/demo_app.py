@@ -1,0 +1,305 @@
+"""Demo app: instrumented WSGI service with configurable fault injection.
+
+The reference's acceptance tests hinge on a demo Spring Boot app whose
+ErrorGenerator/LoadGenerator self-inflict 4xx/5xx/load at a configurable
+rate (examples/spring-boot-demo/src/main/java/ai/foremast/metrics/demo/
+K8sMetricsDemoApp.java:19-41 and ErrorGenerator.java:19-28) — v1 deploys
+clean, v2 deploys with errors, and the pipeline must notice. This is that
+chaos tool for the TPU framework: a WSGI app + generators driving synthetic
+traffic through the instrumentation middleware, so the whole analysis path
+can be exercised hermetically.
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+from ..instrumentation import MetricsMiddleware, MetricsRegistry
+
+
+def demo_app(environ, start_response):
+    """Routes: / -> 200; /error4xx -> 400; /error5xx -> 502; /slow -> 200."""
+    path = environ.get("PATH_INFO", "/")
+    if path == "/error4xx":
+        start_response("400 Bad Request", [("Content-Length", "3")])
+        return [b"4xx"]
+    if path == "/error5xx":
+        start_response("502 Bad Gateway", [("Content-Length", "3")])
+        return [b"5xx"]
+    if path == "/slow":
+        time.sleep(0.05)
+    start_response("200 OK", [("Content-Length", "2")])
+    return [b"ok"]
+
+
+class Generator:
+    """Drives synthetic requests through a WSGI app at a fixed rate."""
+
+    def __init__(self, app, path: str, per_second: float, caller: str = "loadgen"):
+        self.app = app
+        self.path = path
+        self.per_second = per_second
+        self.caller = caller
+        self._stop = threading.Event()
+        self._thread = None
+
+    def hit(self, n: int = 1):
+        for _ in range(n):
+            environ = {
+                "PATH_INFO": self.path,
+                "REQUEST_METHOD": "GET",
+                "HTTP_X_CALLER": self.caller,
+            }
+            consumed = self.app(environ, lambda s, h, e=None: None)
+            # WSGI apps may return generators; drain them
+            for _chunk in consumed or []:
+                pass
+
+    def start(self):
+        def loop():
+            while not self._stop.is_set():
+                self.hit()
+                self._stop.wait(1.0 / max(self.per_second, 1e-6))
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+
+def build_demo(app_name: str = "demo", error5xx_per_second: float = 0.0,
+               error4xx_per_second: float = 0.0, load_per_second: float = 0.0):
+    """(wrapped_app, registry, generators) — v1 is error rate 0; a 'bad v2'
+    is the same app with error5xx_per_second > 0."""
+    registry = MetricsRegistry(common_tags={"app": app_name})
+    app = MetricsMiddleware(demo_app, registry=registry, app_name=app_name)
+    gens = []
+    if error5xx_per_second > 0:
+        gens.append(Generator(app, "/error5xx", error5xx_per_second, "errorgen"))
+    if error4xx_per_second > 0:
+        gens.append(Generator(app, "/error4xx", error4xx_per_second, "errorgen"))
+    if load_per_second > 0:
+        gens.append(Generator(app, "/", load_per_second))
+    return app, registry, gens
+
+
+# --------------------------------------------------------------------------
+# Hermetic end-to-end demo: the reference's acceptance walkthrough
+# (docs/guides/installation.md:88-150 — deploy clean v1, build history,
+# roll a bad v2, watch the pipeline flag it and auto-roll back) with every
+# real component in one process and zero cluster/Prometheus dependencies.
+# --------------------------------------------------------------------------
+_SCRAPE_5XX = re.compile(
+    r'^http_server_requests_seconds_count\{([^}]*)\}\s+([0-9.eE+-]+)$'
+)
+
+
+def _count_5xx(scrape_text: str) -> float:
+    """Sum http_server_requests_seconds_count samples with a 5xx status
+    label from a real /actuator/prometheus scrape."""
+    total = 0.0
+    for line in scrape_text.splitlines():
+        m = _SCRAPE_5XX.match(line)
+        if m and 'status="5' in m.group(1):
+            total += float(m.group(2))
+    return total
+
+
+def _scrape(app) -> str:
+    chunks = app({"PATH_INFO": "/actuator/prometheus", "REQUEST_METHOD": "GET"},
+                 lambda s, h, e=None: None)
+    return b"".join(chunks).decode()
+
+
+def simulate_series(app, gens: list, minutes: int, t0: float,
+                    hits_per_minute: int = 30):
+    """Drive traffic minute-by-minute (simulated clock, no sleeping) and
+    sample the 5xx counter from the app's own scrape endpoint after each
+    minute — a one-metric Prometheus. Returns (ts, err5xx_per_sec)."""
+    load = Generator(app, "/", 0)
+    ts, vals, prev = [], [], _count_5xx(_scrape(app))
+    for minute in range(minutes):
+        load.hit(hits_per_minute)
+        for g in gens:
+            g.hit(max(1, int(g.per_second * 60)))
+        cur = _count_5xx(_scrape(app))
+        ts.append(t0 + (minute + 1) * 60.0)
+        vals.append((cur - prev) / 60.0)
+        prev = cur
+    return ts, vals
+
+
+def run_demo(unhealthy: bool = True, history_minutes: int = 120,
+             watch_minutes: int = 15, now: float | None = None) -> dict:
+    """Full L1→L6 loop, hermetically:
+
+      1. v1 demo app (clean) builds `history_minutes` of instrumented
+         traffic; a v2 app (5xx generator on when `unhealthy`) produces the
+         canary window — series sampled from real /actuator/prometheus
+         scrapes.
+      2. A FakeKube cluster holds the demo Deployment (+ReplicaSets/Pods)
+         and its DeploymentMetadata; the operator's first tick creates the
+         baseline Healthy monitor; policy sets AutoRollback.
+      3. Rolling v2 makes the operator diff the pod template and submit a
+         canary job through the real service handlers.
+      4. The engine scores baseline-vs-current on the TPU kernels; the next
+         operator tick polls the verdict; Unhealthy triggers the rollback
+         patch back to the v1 template.
+
+    Returns a summary with the verdict, final phase, and rollback proof.
+    """
+    import time as _t
+    from urllib.parse import unquote
+
+    from ..dataplane import FixtureDataSource, VerdictExporter
+    from ..engine import Analyzer, EngineConfig, JobStore
+    from ..operator.analyst import InProcessAnalyst
+    from ..operator.kube import FakeKube
+    from ..operator.loop import OperatorLoop
+    from ..operator.types import (
+        REMEDIATION_AUTO_ROLLBACK,
+        Analyst,
+        DeploymentMetadata,
+        Metrics,
+        Monitoring,
+    )
+    from ..service.api import ForemastService
+
+    now = _t.time() if now is None else now
+    t0 = now - history_minutes * 60.0
+
+    # -- 1. instrumented traffic -> series (the L1/L2 layers) --
+    v1_app, _, _ = build_demo("demo")
+    v2_app, _, v2_gens = build_demo(
+        "demo", error5xx_per_second=5.0 if unhealthy else 0.0
+    )
+    hist_ts, hist_vals = simulate_series(v1_app, [], history_minutes, t0)
+    cur_t0 = now - watch_minutes * 60.0
+    cur_ts, cur_vals = simulate_series(v2_app, v2_gens, watch_minutes, cur_t0)
+    base_ts = hist_ts[-watch_minutes:]
+    base_vals = hist_vals[-watch_minutes:]
+
+    def resolve(url: str):
+        q = unquote(url)
+        if "pod=~" in q:
+            return (cur_ts, cur_vals) if "-v2-" in q else (base_ts, base_vals)
+        return hist_ts, hist_vals  # app-level 7d historical query
+
+    # -- engine + service (L3-L5, one process) --
+    store = JobStore()
+    exporter = VerdictExporter()
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(resolver=resolve),
+                        store, exporter)
+    service = ForemastService(store, exporter=exporter)
+
+    # -- 2. the cluster (L6) --
+    kube = FakeKube()  # ships with a monitored "default" namespace
+
+    def depl(image, revision):
+        return {
+            "metadata": {
+                "name": "demo", "namespace": "default",
+                "labels": {"app": "demo"},
+                "annotations": {"deployment.kubernetes.io/revision": str(revision)},
+            },
+            "spec": {
+                "selector": {"matchLabels": {"app": "demo"}},
+                "template": {"spec": {"containers": [
+                    {"name": "main", "image": image, "env": []}]}},
+            },
+        }
+
+    def rs(name, revision, hash_):
+        return {
+            "metadata": {
+                "name": name, "namespace": "default",
+                "labels": {"pod-template-hash": hash_},
+                "annotations": {"deployment.kubernetes.io/revision": str(revision)},
+                "ownerReferences": [{"kind": "Deployment", "name": "demo"}],
+            },
+            "spec": {"replicas": 1, "template": {"spec": {"containers": [
+                {"name": "main", "image": f"demo:v{revision}"}]}}},
+        }
+
+    kube.deployments[("default", "demo")] = depl("demo:v1", 1)
+    kube.replicasets[("default", "demo-v1")] = rs("demo-v1", 1, "v1hash")
+    kube.pods[("default", "demo-v1-a")] = {"metadata": {
+        "name": "demo-v1-a", "namespace": "default",
+        "labels": {"app": "demo", "pod-template-hash": "v1hash"}}}
+    kube.upsert_metadata(DeploymentMetadata(
+        name="demo", namespace="default",
+        analyst=Analyst(endpoint="in-process"),
+        metrics=Metrics(
+            data_source_type="prometheus",
+            endpoint="http://prom/api/v1/",
+            monitoring=[Monitoring(metric_name="http_server_requests_errors_5xx",
+                                   metric_alias="error5xx")],
+        ),
+    ))
+
+    loop = OperatorLoop(kube, InProcessAnalyst(service))
+    loop.tick(now=now)  # baseline Healthy monitor appears
+    monitor = kube.get_monitor("default", "demo")
+    monitor.spec.remediation.option = REMEDIATION_AUTO_ROLLBACK  # user policy
+    kube.upsert_monitor(monitor)
+
+    # -- 3. roll out v2 --
+    kube.deployments[("default", "demo")] = depl("demo:v2", 2)
+    kube.replicasets[("default", "demo-v2")] = rs("demo-v2", 2, "v2hash")
+    kube.pods[("default", "demo-v2-a")] = {"metadata": {
+        "name": "demo-v2-a", "namespace": "default",
+        "labels": {"app": "demo", "pod-template-hash": "v2hash"}}}
+    loop.tick(now=now)
+    monitor = kube.get_monitor("default", "demo")
+    job_id = monitor.status.job_id
+
+    # -- 4. score on TPU; poll; remediate --
+    outcomes = analyzer.run_cycle(now=now + 11 * 60)  # past the watch window
+    loop.tick(now=now + 11 * 60)
+    monitor = kube.get_monitor("default", "demo")
+    final_image = kube.get_deployment("default", "demo")["spec"]["template"][
+        "spec"]["containers"][0]["image"]
+    doc = store.get(job_id)
+    return {
+        "unhealthy_rollout": unhealthy,
+        "job_id": job_id,
+        "engine_outcome": outcomes.get(job_id, ""),
+        "monitor_phase": monitor.status.phase,
+        "remediation_taken": monitor.status.remediation_taken,
+        "rolled_back_to_v1": final_image == "demo:v1",
+        "reason": doc.reason if doc else "",
+        "verdict_series": sorted(
+            {s[0] for s in exporter.samples()} if exporter.samples() else set()
+        ),
+    }
+
+
+def main() -> None:
+    """Serve the instrumented demo app (the in-cluster chaos container).
+
+    Env: APP_NAME, PORT, DEMO_ERROR5XX_PER_SECOND, DEMO_ERROR4XX_PER_SECOND,
+    DEMO_LOAD_PER_SECOND — the reference demo app's knobs
+    (K8sMetricsDemoApp.java:19-41) as environment variables, so
+    examples/k8s/demo-v1.yaml vs demo-v2.yaml differ only in env.
+    """
+    import os
+    from wsgiref.simple_server import make_server as _wsgi_server
+
+    app, _, gens = build_demo(
+        os.environ.get("APP_NAME", "demo"),
+        error5xx_per_second=float(os.environ.get("DEMO_ERROR5XX_PER_SECOND", "0")),
+        error4xx_per_second=float(os.environ.get("DEMO_ERROR4XX_PER_SECOND", "0")),
+        load_per_second=float(os.environ.get("DEMO_LOAD_PER_SECOND", "1")),
+    )
+    for g in gens:
+        g.start()
+    port = int(os.environ.get("PORT", "8080"))
+    print(f"[demo-app] serving :{port} ({len(gens)} generators)", flush=True)
+    _wsgi_server("", port, app).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

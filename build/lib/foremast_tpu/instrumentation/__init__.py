@@ -1,0 +1,15 @@
+"""App instrumentation: the foremast-metrics equivalent for Python services.
+
+The reference ships Java/Spring micrometer starters that make user apps
+emit the Prometheus series the analysis pipeline consumes (SURVEY.md §2.5).
+This package is the same contract for Python apps: a metrics registry with
+common tags, the CommonMetricsFilter whitelist/blacklist/prefix/tag-rule
+semantics with runtime enable/disable, and a WSGI middleware exporting
+/actuator/prometheus.
+"""
+from .asgi import AsgiMetricsMiddleware
+from .registry import CommonMetricsFilter, MetricsRegistry
+from .wsgi import MetricsMiddleware
+
+__all__ = ["MetricsRegistry", "CommonMetricsFilter", "MetricsMiddleware",
+           "AsgiMetricsMiddleware"]

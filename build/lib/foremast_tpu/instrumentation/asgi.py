@@ -1,0 +1,76 @@
+"""ASGI middleware: the async twin of wsgi.MetricsMiddleware.
+
+The reference ships four starter variants so every framework generation
+in its ecosystem can emit the same `http_server_requests` series
+(SURVEY.md §2.5: Boot 2.x / 1.x / 1.5.x / plain Spring 4.x). The Python
+ecosystem's second dialect is ASGI (FastAPI/Starlette/uvicorn apps); this
+middleware mirrors the WSGI semantics exactly — same timer name, the same
+{method, status, uri, exception, caller} tags, pre-registered error
+statuses, scrape endpoint, and runtime toggle paths — so an async service
+plugs into the same recording rules and analysis pipeline. The shared
+behavior lives in base.MetricsMiddlewareBase.
+"""
+from __future__ import annotations
+
+import time
+
+from .base import HTTP_SERVER_REQUESTS, MetricsMiddlewareBase
+
+__all__ = ["AsgiMetricsMiddleware"]
+
+
+class AsgiMetricsMiddleware(MetricsMiddlewareBase):
+    async def __call__(self, scope, receive, send):
+        if scope.get("type") != "http":
+            await self.app(scope, receive, send)
+            return
+        path = scope.get("path", "/")
+        if path == self.scrape_path:
+            await self._respond(send, 200, self.registry.render().encode(),
+                                b"text/plain; version=0.0.4")
+            return
+        if path.startswith(self.toggle_prefix + "/"):
+            status, msg = self._toggle_action(path)
+            await self._respond(send, status, msg.encode(), b"text/plain")
+            return
+
+        t0 = time.perf_counter()
+        holder = {"status": "200", "exc": "None"}
+
+        async def capturing_send(message):
+            if message.get("type") == "http.response.start":
+                holder["status"] = str(message.get("status", 200))
+            await send(message)
+
+        try:
+            await self.app(scope, receive, capturing_send)
+        except Exception as e:
+            holder["status"] = "500"
+            holder["exc"] = type(e).__name__
+            self._record(scope, holder, t0)
+            raise
+        self._record(scope, holder, t0)
+
+    def _caller(self, scope) -> str:
+        for k, v in scope.get("headers", []):
+            if k.lower() == b"x-caller":
+                return v.decode("latin-1")
+        return "unknown"
+
+    def _record(self, scope, holder, t0):
+        tags = {
+            "exception": holder["exc"],
+            "method": scope.get("method", "GET"),
+            "status": holder["status"],
+            "uri": self._uri_tag(scope.get("path", "/")),
+        }
+        if self.caller_enabled:
+            tags["caller"] = self._caller(scope)
+        self.registry.timer(HTTP_SERVER_REQUESTS, tags, time.perf_counter() - t0)
+
+    @staticmethod
+    async def _respond(send, status: int, body: bytes, content_type: bytes):
+        await send({"type": "http.response.start", "status": status,
+                    "headers": [(b"content-type", content_type),
+                                (b"content-length", str(len(body)).encode())]})
+        await send({"type": "http.response.body", "body": body})

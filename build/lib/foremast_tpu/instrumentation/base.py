@@ -1,0 +1,68 @@
+"""Shared core of the WSGI and ASGI metrics middlewares.
+
+One place for the behavior both dialects must agree on: common-tag and
+registry setup, the pre-registered error statuses (so error series exist
+at zero from boot, starter parity), the uri-tag cardinality bound, and the
+/k8s-metrics toggle-route parsing.
+"""
+from __future__ import annotations
+
+import os
+
+from .registry import MetricsRegistry
+
+HTTP_SERVER_REQUESTS = "http_server_requests"
+DEFAULT_INIT_STATUSES = (403, 404, 501, 502)
+
+
+class MetricsMiddlewareBase:
+    def __init__(self, app, registry: MetricsRegistry | None = None,
+                 app_name: str | None = None,
+                 caller_enabled: bool = True,
+                 init_statuses=DEFAULT_INIT_STATUSES,
+                 scrape_path: str = "/actuator/prometheus",
+                 toggle_prefix: str = "/k8s-metrics",
+                 uri_templates: list | None = None,
+                 max_uris: int = 100):
+        self.app = app
+        name = app_name or os.environ.get("APP_NAME", "")
+        common = {"app": name} if name else {}
+        self.registry = registry or MetricsRegistry(common_tags=common)
+        self.caller_enabled = caller_enabled
+        self.scrape_path = scrape_path
+        self.toggle_prefix = toggle_prefix
+        # uri-tag cardinality bound: raw paths are attacker-controlled, so
+        # either a route whitelist (the starter tags templated routes) or a
+        # distinct-path cap; overflow lands in the '/**' bucket
+        self.uri_templates = uri_templates
+        self.max_uris = max_uris
+        self._seen_uris: set[str] = set()
+        for code in init_statuses or ():
+            tags = {"exception": "None", "method": "GET", "status": str(code),
+                    "uri": "/**"}
+            if caller_enabled:
+                tags["caller"] = "*"
+            self.registry.timer(HTTP_SERVER_REQUESTS, tags, seconds=None)
+
+    def _uri_tag(self, path: str) -> str:
+        if self.uri_templates is not None:
+            return path if path in self.uri_templates else "/**"
+        if path in self._seen_uris:
+            return path
+        if len(self._seen_uris) < self.max_uris:
+            self._seen_uris.add(path)
+            return path
+        return "/**"
+
+    def _toggle_action(self, path: str) -> tuple[int, str]:
+        """Parse /k8s-metrics/<enable|disable>/<metric> and apply it.
+        Returns (http_status, message body)."""
+        rest = path[len(self.toggle_prefix) + 1:]
+        action, _, metric = rest.partition("/")
+        if action == "enable" and metric:
+            self.registry.filter.enable_metric(metric)
+            return 200, f"enabled {metric}"
+        if action == "disable" and metric:
+            self.registry.filter.disable_metric(metric)
+            return 200, f"disabled {metric}"
+        return 404, "not found"

@@ -1,0 +1,160 @@
+"""Metrics registry + common-metrics filter.
+
+Filter decision order re-derived from the reference's CommonMetricsFilter
+(foremast-metrics/foremast-spring-boot-k8s-metrics-starter/src/main/java/ai/
+foremast/metrics/k8s/starter/CommonMetricsFilter.java:38-150):
+
+  1. filter disabled -> accept everything.
+  2. explicit per-metric enable/disable map wins (NEUTRAL/DENY).
+  3. whitelist -> NEUTRAL (kept); blacklist -> DENY.
+  4. any configured prefix match -> ACCEPT.
+  5. any tag rule `tag:value` matching the metric's tags -> ACCEPT.
+  6. otherwise DENY (when the filter is enabled, default is closed).
+
+Metric names normalize '_' -> '.' for list membership (filter() in the
+reference, :133-135); runtime enable/disable move names between the lists
+(:137-150, exposed by K8sMetricsEndpoint.java:10-35).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils.promtext import escape_label_value
+
+
+class CommonMetricsFilter:
+    def __init__(self, enabled: bool = False, whitelist: str = "",
+                 blacklist: str = "", prefixes: str = "", tag_rules: str = ""):
+        self.enabled = enabled
+        self.whitelist = {self._norm(s) for s in self._split(whitelist)}
+        self.blacklist = {self._norm(s) for s in self._split(blacklist)}
+        self.prefixes = self._split(prefixes)
+        self.tag_rules = {}
+        for pair in self._split(tag_rules):
+            name, _, value = pair.partition(":")
+            if not value:
+                raise ValueError(f"invalid tag rule {pair!r}")
+            self.tag_rules[name.strip()] = value.strip()
+        self.overrides: dict[str, bool] = {}  # explicit enable/disable
+
+    @staticmethod
+    def _split(s: str) -> list[str]:
+        return [x.strip() for x in (s or "").split(",") if x.strip()]
+
+    @staticmethod
+    def _norm(name: str) -> str:
+        return name.replace("_", ".")
+
+    def accepts(self, name: str, tags: dict | None = None) -> bool:
+        if not self.enabled:
+            return True
+        norm = self._norm(name)
+        if norm in self.overrides:
+            return self.overrides[norm]
+        if norm in self.whitelist:
+            return True
+        if norm in self.blacklist:
+            return False
+        if any(name.startswith(p) or norm.startswith(p) for p in self.prefixes):
+            return True
+        for key, expected in self.tag_rules.items():
+            if (tags or {}).get(key) == expected:
+                return True
+        return False
+
+    def enable_metric(self, name: str):
+        norm = self._norm(name)
+        self.blacklist.discard(norm)
+        self.whitelist.add(norm)
+        self.overrides[norm] = True
+
+    def disable_metric(self, name: str):
+        norm = self._norm(name)
+        self.whitelist.discard(norm)
+        self.blacklist.add(norm)
+        self.overrides[norm] = False
+
+
+class MetricsRegistry:
+    """Counters + timers with tags, rendered in Prometheus text format.
+
+    Timers emit `<name>_seconds_count|_sum|_max` (micrometer's Prometheus
+    mapping); counters emit `<name>_total`.
+    """
+
+    def __init__(self, common_tags: dict | None = None,
+                 metrics_filter: CommonMetricsFilter | None = None):
+        self.common_tags = dict(common_tags or {})
+        self.filter = metrics_filter or CommonMetricsFilter()
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = {}
+        self._timers: dict[tuple, list] = {}  # key -> [count, sum, max]
+
+    def _key(self, name: str, tags: dict):
+        merged = {**self.common_tags, **tags}
+        return name, tuple(sorted(merged.items()))
+
+    def counter(self, name: str, tags: dict | None = None, amount: float = 1.0):
+        tags = tags or {}
+        if not self.filter.accepts(name, {**self.common_tags, **tags}):
+            return
+        key = self._key(name, tags)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + amount
+
+    def timer(self, name: str, tags: dict | None = None, seconds: float | None = None):
+        """Record a timing; seconds=None just pre-registers the series at 0
+        (the starter pre-registers error statuses so series exist from
+        boot, K8sMetricsAutoConfiguration.java:179-190)."""
+        tags = tags or {}
+        if not self.filter.accepts(name, {**self.common_tags, **tags}):
+            return
+        key = self._key(name, tags)
+        with self._lock:
+            entry = self._timers.setdefault(key, [0, 0.0, 0.0])
+            if seconds is not None:
+                entry[0] += 1
+                entry[1] += seconds
+                entry[2] = max(entry[2], seconds)
+
+    def time(self, name: str, tags: dict | None = None):
+        """Context manager: `with registry.time("http_server_requests", t):`"""
+        registry = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.timer(name, tags, time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    # -- rendering --
+    @staticmethod
+    def _fmt_tags(tags: tuple) -> str:
+        if not tags:
+            return ""
+        # tag values carry user input (request paths, app names): escape or
+        # one stray quote corrupts the whole scrape
+        inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in tags)
+        return "{" + inner + "}"
+
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            counters = dict(self._counters)
+            timers = {k: list(v) for k, v in self._timers.items()}
+        for (name, tags), value in sorted(counters.items()):
+            pname = name.replace(".", "_")
+            lines.append(f"{pname}_total{self._fmt_tags(tags)} {value}")
+        for (name, tags), (count, total, mx) in sorted(timers.items()):
+            pname = name.replace(".", "_")
+            t = self._fmt_tags(tags)
+            lines.append(f"{pname}_seconds_count{t} {count}")
+            lines.append(f"{pname}_seconds_sum{t} {total}")
+            lines.append(f"{pname}_seconds_max{t} {mx}")
+        return "\n".join(lines) + "\n"

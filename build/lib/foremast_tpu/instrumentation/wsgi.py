@@ -1,0 +1,78 @@
+"""WSGI middleware: http_server_requests timing + scrape + toggle endpoints.
+
+The Python-side equivalent of the reference starters' servlet filter +
+actuator endpoints (SURVEY.md §2.5):
+
+  * every request lands in the `http_server_requests` timer tagged
+    {method, status, uri, exception, caller} — caller from the X-CALLER
+    header (K8sMetricsProperties.APP_ASSET_ALIAS_HEADER).
+  * common tag `app` resolved from APP_NAME env (commonTagNameValuePairs
+    default "app:ENV.APP_NAME|info.app.name").
+  * error statuses 403,404,501,502 pre-registered at zero so the error
+    series exist before the first error (initializeForStatuses default).
+  * GET /actuator/prometheus — scrape endpoint.
+  * POST|GET /k8s-metrics/enable/<metric> and /disable/<metric> — the
+    runtime toggle actuator (K8sMetricsEndpoint.java:10-35).
+
+Registration, uri-tag bounding, and toggle parsing live in
+base.MetricsMiddlewareBase, shared with the ASGI twin.
+"""
+from __future__ import annotations
+
+import time
+
+from .base import DEFAULT_INIT_STATUSES, HTTP_SERVER_REQUESTS, MetricsMiddlewareBase
+
+__all__ = ["MetricsMiddleware", "HTTP_SERVER_REQUESTS", "CALLER_HEADER",
+           "DEFAULT_INIT_STATUSES"]
+
+CALLER_HEADER = "HTTP_X_CALLER"
+
+
+class MetricsMiddleware(MetricsMiddlewareBase):
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        if path == self.scrape_path:
+            body = self.registry.render().encode()
+            start_response(
+                "200 OK",
+                [("Content-Type", "text/plain; version=0.0.4"),
+                 ("Content-Length", str(len(body)))],
+            )
+            return [body]
+        if path.startswith(self.toggle_prefix + "/"):
+            status, msg = self._toggle_action(path)
+            body = msg.encode()
+            start_response(
+                "200 OK" if status == 200 else "404 Not Found",
+                [("Content-Length", str(len(body)))],
+            )
+            return [body]
+
+        t0 = time.perf_counter()
+        status_holder = {"status": "200", "exc": "None"}
+
+        def capturing_start_response(status, headers, exc_info=None):
+            status_holder["status"] = status.split(" ", 1)[0]
+            return start_response(status, headers, exc_info)
+
+        try:
+            result = self.app(environ, capturing_start_response)
+        except Exception as e:
+            status_holder["status"] = "500"
+            status_holder["exc"] = type(e).__name__
+            self._record(environ, status_holder, t0)
+            raise
+        self._record(environ, status_holder, t0)
+        return result
+
+    def _record(self, environ, holder, t0):
+        tags = {
+            "exception": holder["exc"],
+            "method": environ.get("REQUEST_METHOD", "GET"),
+            "status": holder["status"],
+            "uri": self._uri_tag(environ.get("PATH_INFO", "/")),
+        }
+        if self.caller_enabled:
+            tags["caller"] = environ.get(CALLER_HEADER, "unknown")
+        self.registry.timer(HTTP_SERVER_REQUESTS, tags, time.perf_counter() - t0)

@@ -1,0 +1,10 @@
+"""Flax models: multivariate anomaly scorers."""
+from .lstm_ae import (  # noqa: F401
+    LstmAutoencoder,
+    anomaly_scores,
+    fit_score_normalizer,
+    init_state,
+    reconstruction_errors,
+    train,
+    train_step,
+)

@@ -1,0 +1,172 @@
+"""LSTM-autoencoder multivariate anomaly scorer (flax).
+
+The reference brain escalates to "LSTM deep learning" for 3+ correlated
+metrics (foremast-brain/faq.md:8-10 — Keras+MXNet LSTM autoencoder;
+unsupervised per faq.md:3-5; menu position at docs/guides/design.md:53-88).
+This is the TPU-native replacement: a flax seq2seq autoencoder trained on
+healthy historical windows; anomaly score = reconstruction error normalized
+against the healthy-error distribution.
+
+TPU notes:
+  * time recurrence runs under `flax.linen.RNN` (nn.scan -> lax.scan), batch
+    and feature dims stay dense so the per-step matmuls hit the MXU.
+  * all parameters/activations are float32 by default with a bfloat16 switch
+    for large fleets (param dtype stays float32; activations cast).
+  * masked windows: padded steps contribute zero loss and zero score; the
+    encoder consumes gap-filled inputs (value 0 + mask channel) so shapes
+    stay static.
+
+Inputs are (B, T, F) windows: F metrics per service (e.g. latency_p99,
+error_rate, cpu, tps) resampled by ops.windowing, standardized per feature.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+__all__ = ["LstmAutoencoder", "TrainState", "init_state", "train_step", "train",
+           "anomaly_scores", "fit_score_normalizer", "param_shardings"]
+
+_F = jnp.float32
+
+
+class LstmAutoencoder(nn.Module):
+    """Seq2seq reconstruction model.
+
+    Encoder LSTM folds the window into a latent; decoder LSTM unrolls the
+    latent back over T steps; a Dense head reconstructs the F features per
+    step. The mask is appended as extra input channels so the model can
+    distinguish gaps from true zeros.
+    """
+
+    hidden: int = 128  # MXU-friendly multiple of 128
+    latent: int = 64
+    features: int = 4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask):
+        # x: (B, T, F); mask: (B, T, F) bool
+        B, T, F = x.shape
+        inp = jnp.concatenate([x, mask.astype(self.dtype)], axis=-1)
+        enc = nn.RNN(nn.LSTMCell(self.hidden, param_dtype=jnp.float32, dtype=self.dtype))
+        h = enc(inp)  # (B, T, H)
+        z = nn.Dense(self.latent, dtype=self.dtype)(h[:, -1, :])  # (B, Z)
+        # decoder: latent repeated over time, unrolled by a second LSTM
+        dec_in = jnp.repeat(z[:, None, :], T, axis=1)
+        dec = nn.RNN(nn.LSTMCell(self.hidden, param_dtype=jnp.float32, dtype=self.dtype))
+        dh = dec(dec_in)
+        recon = nn.Dense(F, dtype=self.dtype)(dh)
+        return recon.astype(_F)
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: int
+
+
+def _loss_fn(params, model, x, mask, apply_fn):
+    recon = apply_fn({"params": params}, x, mask)
+    m = mask.astype(_F)
+    se = (recon - x) ** 2 * m
+    denom = jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.sum(se) / denom
+
+
+def param_shardings(params, mesh, model_axis: str | None = None,
+                    min_shard_width: int = 8):
+    """Tensor-parallel NamedSharding pytree for the scorer's parameters.
+
+    Megatron-style column split: every kernel whose output (last) dim is a
+    multiple of the `model` axis size AND at least `min_shard_width` wide
+    is sharded on that dim — the LSTM gate matmuls and the latent Dense
+    head — while biases, indivisible leaves, and narrow heads replicate.
+    The width floor keeps the reconstruction head (output dim = feature
+    count, typically 3-4) replicated: splitting a 4-wide output saves no
+    compute and would cost an all-gather per decode step.
+
+    Handing these to jax.device_put / jit's in_shardings is enough: XLA
+    GSPMD partitions the per-step matmuls and inserts the gate all-reduces
+    over ICI, so a scorer whose hidden state outgrows one chip spans
+    several without model changes (the `model` mesh axis reserved in
+    parallel/mesh.py — the default axis name comes from there).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MODEL_AXIS
+
+    model_axis = MODEL_AXIS if model_axis is None else model_axis
+    axis_size = mesh.shape[model_axis]
+
+    def rule(x):
+        if (getattr(x, "ndim", 0) >= 2 and x.shape[-1] % axis_size == 0
+                and x.shape[-1] >= min_shard_width):
+            spec = [None] * (x.ndim - 1) + [model_axis]
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(rule, params)
+
+
+def init_state(model: LstmAutoencoder, rng, T: int, lr: float = 1e-3):
+    x0 = jnp.zeros((1, T, model.features), _F)
+    m0 = jnp.ones((1, T, model.features), bool)
+    params = model.init(rng, x0, m0)["params"]
+    tx = optax.adam(lr)
+    return TrainState(params=params, opt_state=tx.init(params), step=0), tx
+
+
+@partial(jax.jit, static_argnames=("apply_fn", "tx"))
+def train_step(params, opt_state, x, mask, apply_fn, tx):
+    loss, grads = jax.value_and_grad(_loss_fn)(params, None, x, mask, apply_fn)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def train(model, state, tx, x, mask, epochs: int = 50):
+    """Full-batch training loop (fleet windows are small; one device batch)."""
+    params, opt_state = state.params, state.opt_state
+    loss = None
+    for _ in range(epochs):
+        params, opt_state, loss = train_step(
+            params, opt_state, x, mask, model.apply, tx
+        )
+    return TrainState(params=params, opt_state=opt_state, step=state.step + epochs), loss
+
+
+@partial(jax.jit, static_argnames=("apply_fn",))
+def reconstruction_errors(params, x, mask, apply_fn):
+    """Per-window masked MSE (B,)."""
+    recon = apply_fn({"params": params}, x, mask)
+    m = mask.astype(_F)
+    se = (recon - x) ** 2 * m
+    denom = jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0)
+    return jnp.sum(se, axis=(1, 2)) / denom
+
+
+def fit_score_normalizer(params, x_healthy, mask, apply_fn):
+    """Mean/std of reconstruction error on healthy windows -> (mu, sigma)."""
+    errs = reconstruction_errors(params, x_healthy, mask, apply_fn)
+    mu = jnp.mean(errs)
+    sigma = jnp.maximum(jnp.std(errs), 1e-6)
+    return mu, sigma
+
+
+@partial(jax.jit, static_argnames=("apply_fn",))
+def anomaly_scores(params, x, mask, mu, sigma, apply_fn):
+    """Z-score of reconstruction error vs the healthy distribution (B,).
+
+    score > threshold (typically 3.0) => window judged anomalous; the engine
+    maps that to completed_unhealth exactly like a pairwise rejection.
+    """
+    errs = reconstruction_errors(params, x, mask, apply_fn)
+    return (errs - mu) / sigma

@@ -1,0 +1,133 @@
+"""ctypes loader for the native data-plane extension (C++, no pybind11).
+
+Build-on-first-use: if the shared library is absent and a C++ toolchain is
+available, it is compiled once into the package directory (g++ -O3, ~1 s)
+and cached. Every entry point degrades to ``None`` when the library is
+unavailable so callers keep their pure-Python fallbacks — the extension is
+an accelerator, never a dependency. Disable with FOREMAST_NATIVE=0.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "parse_series", "resample", "lib_path"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "foremast_native.cpp")
+_SO = os.path.join(_DIR, "foremast_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_state = "unloaded"  # unloaded | ready | failed
+
+FLAVOR_PROMETHEUS = 0
+FLAVOR_WAVEFRONT = 1
+
+
+def lib_path() -> str:
+    return _SO
+
+
+def _build() -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load():
+    global _lib, _state
+    with _lock:
+        if _state != "unloaded":
+            return _lib
+        _state = "failed"
+        if os.environ.get("FOREMAST_NATIVE", "1") == "0":
+            return None
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.fm_parse_series.restype = ctypes.c_int
+        lib.fm_parse_series.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.fm_resample.restype = None
+        lib.fm_resample.argtypes = [
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ]
+        lib.fm_free.restype = None
+        lib.fm_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        _state = "ready"
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_series(buf: bytes, flavor: int):
+    """Parse a metric-store response body -> (ts, vals) float64 arrays,
+    duplicate timestamps averaged. None = unavailable/malformed (caller
+    falls back to the Python parser)."""
+    lib = _load()
+    if lib is None:
+        return None
+    ts_p = ctypes.POINTER(ctypes.c_double)()
+    val_p = ctypes.POINTER(ctypes.c_double)()
+    n = ctypes.c_long()
+    rc = lib.fm_parse_series(
+        buf, len(buf), flavor, ctypes.byref(ts_p), ctypes.byref(val_p),
+        ctypes.byref(n),
+    )
+    if rc != 0:
+        return None
+    try:
+        count = n.value
+        ts = np.ctypeslib.as_array(ts_p, shape=(max(count, 1),))[:count].copy()
+        vals = np.ctypeslib.as_array(val_p, shape=(max(count, 1),))[:count].copy()
+    finally:
+        lib.fm_free(ts_p)
+        lib.fm_free(val_p)
+    return ts, vals
+
+
+def resample(ts, vals, start: int, end: int, step: int):
+    """Grid-resample (ts, vals) onto [start, end) — native twin of
+    ops.windowing.resample_to_grid's inner loop. None = unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    ts = np.ascontiguousarray(ts, np.float64)
+    vals = np.ascontiguousarray(vals, np.float64)
+    T = max(1, (end - start) // step)
+    out_vals = np.zeros(T, np.float32)
+    out_mask = np.zeros(T, np.uint8)
+    lib.fm_resample(ts, vals, len(ts), start, end, step, out_vals, out_mask)
+    return out_vals, out_mask.astype(bool)

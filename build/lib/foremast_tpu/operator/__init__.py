@@ -1,0 +1,35 @@
+"""Operator layer: the TPU-native foremast-barrelman equivalent.
+
+Watches Deployments/HPAs/DeploymentMonitors, drives analysis jobs through
+the engine (in-process or HTTP), applies remediation, and maintains the HPA
+score wiring — re-derived from foremast-barrelman (SURVEY.md §2.1) as a
+tick-driven reconciler over a small injectable K8s API seam.
+"""
+from .analyst import HttpAnalyst, InProcessAnalyst, StatusResponse
+from .barrelman import Barrelman
+from .controllers import DeploymentController, HpaController, MonitorController
+from .kube import FakeKube, KubeClient
+from .types import (
+    DeploymentMetadata,
+    DeploymentMonitor,
+    PHASE_HEALTHY,
+    PHASE_RUNNING,
+    PHASE_UNHEALTHY,
+)
+
+__all__ = [
+    "Barrelman",
+    "DeploymentController",
+    "MonitorController",
+    "HpaController",
+    "FakeKube",
+    "KubeClient",
+    "HttpAnalyst",
+    "InProcessAnalyst",
+    "StatusResponse",
+    "DeploymentMetadata",
+    "DeploymentMonitor",
+    "PHASE_HEALTHY",
+    "PHASE_RUNNING",
+    "PHASE_UNHEALTHY",
+]

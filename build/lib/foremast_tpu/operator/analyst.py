@@ -1,0 +1,137 @@
+"""Analyst client: operator -> analysis service.
+
+The reference client (foremast-barrelman/pkg/client/analyst/analystclient.go)
+POSTs /v1/healthcheck/create and GETs /id/:jobId, mapping service statuses
+to monitor phases (:227-245):
+
+  created/initial/new/inprogress/unknown -> Running
+  completed_health/success               -> Healthy
+  completed_unhealth/anomaly             -> Unhealthy
+  abort                                  -> Abort
+  completed_unknown                      -> Warning
+
+Two implementations share the mapping:
+  * HttpAnalyst — real HTTP with an injectable do_func (the reference's
+    DoFunc test seam, analystclient.go:24).
+  * InProcessAnalyst — calls the ForemastService handlers directly; the
+    TPU-native collapse when operator + engine share a process.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+
+from .types import PHASE_ABORT, PHASE_HEALTHY, PHASE_RUNNING, PHASE_UNHEALTHY, PHASE_WARNING
+
+STATUS_TO_PHASE = {
+    "created": PHASE_RUNNING,
+    "initial": PHASE_RUNNING,
+    "new": PHASE_RUNNING,
+    "inprogress": PHASE_RUNNING,
+    "unknown": PHASE_RUNNING,
+    "completed_health": PHASE_HEALTHY,
+    "success": PHASE_HEALTHY,
+    "completed_unhealth": PHASE_UNHEALTHY,
+    "anomaly": PHASE_UNHEALTHY,
+    "abort": PHASE_ABORT,
+    "completed_unknown": PHASE_WARNING,
+}
+
+
+@dataclass
+class StatusResponse:
+    phase: str
+    reason: str = ""
+    anomaly: dict = field(default_factory=dict)  # metric -> flat [ts,v,...]
+    hpa_logs: list = field(default_factory=list)
+
+
+class AnalystError(Exception):
+    pass
+
+
+def _map_status(status: str) -> str:
+    return STATUS_TO_PHASE.get(status, PHASE_RUNNING)
+
+
+class HttpAnalyst:
+    def __init__(self, endpoint: str, do_func=None, timeout: float = 10.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.do_func = do_func  # (method, url, body_bytes) -> (status, bytes)
+        self.timeout = timeout
+
+    def _do(self, method: str, url: str, body: bytes | None = None):
+        if self.do_func is not None:
+            return self.do_func(method, url, body)
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, r.read()
+        except Exception as e:  # noqa: BLE001 - HTTP boundary
+            raise AnalystError(f"{method} {url}: {e}") from e
+
+    def start_analyzing(self, request: dict) -> str:
+        status, payload = self._do(
+            "POST",
+            f"{self.endpoint}/v1/healthcheck/create",
+            json.dumps(request).encode(),
+        )
+        if status != 200:
+            raise AnalystError(f"create returned {status}: {payload[:200]!r}")
+        return json.loads(payload)["jobId"]
+
+    def get_status(self, job_id: str) -> StatusResponse:
+        status, payload = self._do(
+            "GET", f"{self.endpoint}/v1/healthcheck/id/{job_id}"
+        )
+        if status != 200:
+            raise AnalystError(f"status returned {status}: {payload[:200]!r}")
+        doc = json.loads(payload)
+        return StatusResponse(
+            phase=_map_status(doc.get("status", "")),
+            reason=doc.get("reason", ""),
+            anomaly=doc.get("anomaly", {}) or {},
+            hpa_logs=doc.get("hpalogs", []) or [],
+        )
+
+
+class InProcessAnalyst:
+    """Zero-hop analyst over an in-process ForemastService.
+
+    Service-layer ApiError surfaces as AnalystError, mirroring the HTTP
+    path where a 400 response becomes AnalystError — callers must see the
+    same failure type on both transports.
+    """
+
+    def __init__(self, service):
+        self.service = service
+
+    def start_analyzing(self, request: dict) -> str:
+        from ..service.api import ApiError
+
+        try:
+            status, payload = self.service.create(request)
+        except ApiError as e:
+            raise AnalystError(f"create rejected: {e.message}") from e
+        if status != 200:
+            raise AnalystError(f"create returned {status}: {payload}")
+        return payload["jobId"]
+
+    def get_status(self, job_id: str) -> StatusResponse:
+        from ..service.api import ApiError
+
+        try:
+            status, doc = self.service.status(job_id)
+        except ApiError as e:
+            raise AnalystError(f"status rejected: {e.message}") from e
+        if status != 200:
+            raise AnalystError(f"status returned {status}: {doc}")
+        return StatusResponse(
+            phase=_map_status(doc.get("status", "")),
+            reason=doc.get("reason", ""),
+            anomaly=doc.get("anomaly", {}) or {},
+            hpa_logs=doc.get("hpalogs", []) or [],
+        )

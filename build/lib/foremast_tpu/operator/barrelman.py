@@ -1,0 +1,382 @@
+"""Barrelman: the shared operator engine.
+
+Re-derives foremast-barrelman/pkg/controller/Barrelman.go as a tick-driven
+reconciler (the Go version runs a 10 s ticker goroutine, Barrelman.go:64-69;
+here the caller owns the loop — `tick()` is pure logic, trivially testable):
+
+  * monitor_new_deployment (Barrelman.go:233-372): resolve old/new pod sets
+    from ReplicaSet revisions, build the current/baseline/historical metric
+    queries, start an analysis job (one retry, :289-296), upsert the
+    DeploymentMonitor with phase Running + waitUntil.
+  * check_running_status (Barrelman.go:448-571): poll every Running
+    monitor's job, fold phase/anomaly/hpaLogs into status, expire past
+    waitUntil, re-arm continuous/HPA monitors.
+  * metadata resolution with TTL cache + app -> appType -> operator
+    namespace fallbacks (Barrelman.go:382-417).
+
+Modes (cmd/manager/main.go:69-76): MODE in {hpa_only,
+hpa_and_healthy_monitoring, healthy_monitoring_only}; HPA_STRATEGY
+`hpa_exists` stamps the score template when an HPA object exists.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from ..dataplane.promql import MetricQuerySpec, build_metric_windows, pod_count_url
+from ..utils.timeutils import to_rfc3339
+from .analyst import AnalystError, HttpAnalyst, InProcessAnalyst  # noqa: F401
+from .types import (
+    DEFAULT_HPA_TEMPLATE,
+    PHASE_HEALTHY,
+    PHASE_RUNNING,
+    Anomaly,
+    DeploymentMetadata,
+    DeploymentMonitor,
+    HpaLogEntry,
+    MonitorSpec,
+    MonitorStatus,
+    STRATEGY_CANARY,
+    STRATEGY_CONTINUOUS,
+    STRATEGY_HPA,
+    STRATEGY_ROLLING_UPDATE,
+)
+
+WATCH_TIME_MINUTES = 10  # DeploymentController.go:48
+WAIT_UNTIL_MAX_MINUTES = 30  # DeploymentController.go:50
+METADATA_CACHE_TTL = 60.0
+
+MODE_HPA_ONLY = "hpa_only"
+MODE_HPA_AND_HEALTHY = "hpa_and_healthy_monitoring"
+MODE_HEALTHY_ONLY = "healthy_monitoring_only"
+
+
+@dataclass
+class _CachedMetadata:
+    md: DeploymentMetadata
+    at: float
+
+
+class Barrelman:
+    def __init__(self, kube, analyst, mode: str = MODE_HPA_AND_HEALTHY,
+                 hpa_strategy: str = "hpa_exists", operator_namespace: str = "foremast",
+                 watch_namespaces=None):
+        self.kube = kube
+        self.analyst = analyst
+        self.mode = mode
+        self.hpa_strategy = hpa_strategy
+        self.operator_namespace = operator_namespace
+        # non-empty set -> reconcile ONLY these namespaces (WATCH_NAMESPACES)
+        self.watch_namespaces = set(watch_namespaces or ())
+        self._md_cache: dict[tuple, _CachedMetadata] = {}
+
+    def watches_namespace(self, ns: str) -> bool:
+        return not self.watch_namespaces or ns in self.watch_namespaces
+
+    # ------------------------------------------------------------ metadata
+    def get_deployment_metadata(self, ns: str, app: str,
+                                app_type: str = "") -> DeploymentMetadata | None:
+        """App metadata with TTL cache and the reference's fallback chain:
+        app name -> appType -> operator namespace (Barrelman.go:382-417)."""
+        key = (ns, app, app_type)
+        hit = self._md_cache.get(key)
+        now = time.time()
+        if hit and now - hit.at < METADATA_CACHE_TTL:
+            return hit.md
+        md = (
+            self.kube.get_metadata(ns, app)
+            or (self.kube.get_metadata(ns, app_type) if app_type else None)
+            or self.kube.get_metadata(self.operator_namespace, app_type or "deployment-metadata-default")
+            or self.kube.get_metadata(self.operator_namespace, "deployment-metadata-default")
+        )
+        if md is not None:
+            self._md_cache[key] = _CachedMetadata(md, now)
+        return md
+
+    def monitors_health(self) -> bool:
+        return self.mode in (MODE_HPA_AND_HEALTHY, MODE_HEALTHY_ONLY)
+
+    def monitors_hpa(self) -> bool:
+        return self.mode in (MODE_HPA_AND_HEALTHY, MODE_HPA_ONLY)
+
+    # ------------------------------------------------------------ pod names
+    def get_pod_names(self, ns: str, deployment: dict) -> tuple[list[str], list[str]]:
+        """(old_pods, new_pods) from ReplicaSet revisions.
+
+        The Go version diffs ReplicaSets with sleeps and retries around
+        rollout churn (Barrelman.go:100-230); reconciliation re-runs every
+        tick here, so one clean pass suffices: group the deployment's RSes
+        by revision, newest revision's pods are "new", the rest "old".
+        """
+        name = deployment["metadata"]["name"]
+        rss = [
+            rs
+            for rs in self.kube.list_replicasets(ns)
+            if any(
+                o.get("kind") == "Deployment" and o.get("name") == name
+                for o in rs["metadata"].get("ownerReferences", [])
+            )
+        ]
+        if not rss:
+            return [], []
+
+        def revision(rs):
+            return int(rs["metadata"].get("annotations", {}).get(
+                "deployment.kubernetes.io/revision", 0
+            ))
+
+        newest = max(revision(rs) for rs in rss)
+        new_hashes = {
+            rs["metadata"]["labels"].get("pod-template-hash", "")
+            for rs in rss
+            if revision(rs) == newest
+        }
+        old_hashes = {
+            rs["metadata"]["labels"].get("pod-template-hash", "")
+            for rs in rss
+            if revision(rs) != newest and int(rs["spec"].get("replicas", 0)) >= 0
+        }
+        sel = (deployment["spec"].get("selector", {}) or {}).get("matchLabels", {})
+        pods = self.kube.list_pods(ns, sel or None)
+        old_pods, new_pods = [], []
+        for p in pods:
+            h = p["metadata"].get("labels", {}).get("pod-template-hash", "")
+            if h in new_hashes:
+                new_pods.append(p["metadata"]["name"])
+            elif h in old_hashes:
+                old_pods.append(p["metadata"]["name"])
+        return old_pods, new_pods
+
+    # ------------------------------------------------------------ requests
+    def _specs_from_metadata(self, md: DeploymentMetadata) -> list[MetricQuerySpec]:
+        return [
+            MetricQuerySpec(
+                name=m.metric_alias or m.metric_name,
+                data_source_type=md.metrics.data_source_type or "prometheus",
+                priority=i,
+            )
+            for i, m in enumerate(md.metrics.monitoring)
+        ]
+
+    def _specs_from_template(self, md: DeploymentMetadata, template: str) -> list[MetricQuerySpec]:
+        t = md.template_named(template) or md.template_named(DEFAULT_HPA_TEMPLATE)
+        aliases = t.metrics if t else ["cpu", "tps", "latency"]
+        return [
+            MetricQuerySpec(name=a, data_source_type=md.metrics.data_source_type,
+                            priority=i)
+            for i, a in enumerate(aliases)
+        ]
+
+    def build_request(self, ns: str, app: str, md: DeploymentMetadata,
+                      strategy: str, current_pods=None, baseline_pods=None,
+                      now: float | None = None) -> dict:
+        now = time.time() if now is None else now
+        start, end = now, now + WATCH_TIME_MINUTES * 60
+        if strategy == STRATEGY_HPA:
+            specs = self._specs_from_template(md, DEFAULT_HPA_TEMPLATE)
+        else:
+            specs = self._specs_from_metadata(md)
+        windows = build_metric_windows(
+            md.metrics.endpoint, specs, strategy, start, end, ns, app,
+            current_pods=current_pods, baseline_pods=baseline_pods,
+        )
+        info = {"current": {}, "baseline": {}, "historical": {}}
+        for w in windows:
+            flags = {"priority": w.priority, "isIncrease": w.is_increase,
+                     "isAbsolute": w.is_absolute}
+            if w.current:
+                info["current"][w.name] = {"url": w.current, **flags}
+            if w.baseline:
+                info["baseline"][w.name] = {"url": w.baseline, **flags}
+            if w.historical:
+                info["historical"][w.name] = {"url": w.historical, **flags}
+        return {
+            "appName": app,
+            "namespace": ns,
+            "strategy": strategy,
+            "startTime": to_rfc3339(start),
+            "endTime": to_rfc3339(end),
+            "metricsInfo": info,
+            "podCountURL": pod_count_url(md.metrics.endpoint, ns, app, start, end),
+        }
+
+    # ----------------------------------------------------------- monitoring
+    def monitor_new_deployment(self, ns: str, app: str, deployment: dict,
+                               strategy: str = STRATEGY_ROLLING_UPDATE,
+                               continuous: bool = False,
+                               rollback_revision: int = 0,
+                               remediation_option: str = "",
+                               now: float | None = None) -> DeploymentMonitor | None:
+        """Create/refresh the monitor for a (re)deployed app and start a job."""
+        now = time.time() if now is None else now
+        app_type = deployment["metadata"].get("annotations", {}).get(
+            "deployment.foremast.ai/type", ""
+        )
+        md = self.get_deployment_metadata(ns, app, app_type)
+        if md is None:
+            self.kube.record_event(
+                "Deployment", ns, app, "NoMetadata",
+                "no DeploymentMetadata found; skipping analysis",
+            )
+            return None
+        old_pods, new_pods = ([], [])
+        if strategy in (STRATEGY_ROLLING_UPDATE, STRATEGY_CANARY):
+            old_pods, new_pods = self.get_pod_names(ns, deployment)
+        req = self.build_request(
+            ns, app, md, strategy,
+            current_pods=new_pods or None, baseline_pods=old_pods or None,
+            now=now,
+        )
+        job_id = ""
+        try:
+            job_id = self.analyst.start_analyzing(req)
+        except AnalystError:
+            try:  # one retry (Barrelman.go:289-296)
+                job_id = self.analyst.start_analyzing(req)
+            except AnalystError as e:
+                self.kube.record_event(
+                    "Deployment", ns, app, "AnalystUnavailable", str(e)
+                )
+        wait_minutes = min(WATCH_TIME_MINUTES * 2, WAIT_UNTIL_MAX_MINUTES)
+        existing = self.kube.get_monitor(ns, app)
+        monitor = existing or DeploymentMonitor(name=app, namespace=ns)
+        monitor.annotations.setdefault("deployment.foremast.ai/name", app)
+        monitor.spec = MonitorSpec(
+            selector=(deployment["spec"].get("selector", {}) or {}).get("matchLabels", {}),
+            analyst=monitor.spec.analyst,
+            start_time=to_rfc3339(now),
+            wait_until=to_rfc3339(now + wait_minutes * 60),
+            metrics=md.metrics,
+            continuous=continuous or monitor.spec.continuous,
+            remediation=monitor.spec.remediation,
+            rollback_revision=rollback_revision or monitor.spec.rollback_revision,
+            hpa_score_template=monitor.spec.hpa_score_template,
+        )
+        if remediation_option:
+            monitor.spec.remediation.option = remediation_option
+        monitor.status = MonitorStatus(
+            job_id=job_id,
+            phase=PHASE_RUNNING if job_id else PHASE_HEALTHY,
+            timestamp=to_rfc3339(now),
+            expired=not job_id,
+            hpa_score_enabled=monitor.status.hpa_score_enabled,
+            hpa_logs=monitor.status.hpa_logs,
+        )
+        return self.kube.upsert_monitor(monitor)
+
+    def monitor_continuously(self, monitor: DeploymentMonitor,
+                             now: float | None = None):
+        return self._monitor_perpetual(monitor, STRATEGY_CONTINUOUS, now)
+
+    def monitor_hpa(self, monitor: DeploymentMonitor, now: float | None = None):
+        return self._monitor_perpetual(monitor, STRATEGY_HPA, now)
+
+    def _monitor_perpetual(self, monitor: DeploymentMonitor, strategy: str,
+                           now: float | None = None):
+        now = time.time() if now is None else now
+        ns, app = monitor.namespace, monitor.name
+        md = self.get_deployment_metadata(ns, app)
+        if md is None:
+            return None
+        req = self.build_request(ns, app, md, strategy, now=now)
+        try:
+            job_id = self.analyst.start_analyzing(req)
+        except AnalystError as e:
+            self.kube.record_event("DeploymentMonitor", ns, app, "AnalystUnavailable", str(e))
+            return None
+        monitor.status.job_id = job_id
+        monitor.status.phase = PHASE_RUNNING
+        monitor.status.expired = False
+        monitor.status.timestamp = to_rfc3339(now)
+        return self.kube.upsert_monitor(monitor)
+
+    # ----------------------------------------------------------- status tick
+    def check_running_status(self, now: float | None = None) -> dict:
+        """One reconcile pass over every namespace's monitors.
+
+        Returns {"<ns>/<name>": phase} of monitors it touched.
+        """
+        now = time.time() if now is None else now
+        touched = {}
+        for ns in self.kube.list_namespaces():
+            if not self.watches_namespace(ns):
+                continue
+            for monitor in self.kube.list_monitors(ns):
+                key = f"{ns}/{monitor.name}"
+                if monitor.status.phase == PHASE_RUNNING:
+                    changed = self._poll_running(monitor, now)
+                    if changed:
+                        monitor.status.remediation_taken = False
+                        self.kube.upsert_monitor(monitor)
+                        touched[key] = monitor.status.phase
+                elif monitor.spec.continuous or monitor.spec.hpa_score_template:
+                    # re-arm perpetual monitors; unhealthy ones get a 60 s
+                    # breather before re-trigger (Barrelman.go:552-565)
+                    if monitor.status.phase == "Unhealthy":
+                        try:
+                            from ..utils.timeutils import from_rfc3339
+
+                            last = from_rfc3339(monitor.status.timestamp)
+                        except (ValueError, TypeError):
+                            last = 0.0
+                        if now - last <= 60:
+                            continue
+                    if self.monitors_health() and monitor.spec.continuous:
+                        self.monitor_continuously(monitor, now)
+                        touched[key] = monitor.status.phase
+                    elif self.monitors_hpa() and monitor.spec.hpa_score_template:
+                        self.monitor_hpa(monitor, now)
+                        touched[key] = monitor.status.phase
+        return touched
+
+    def _poll_running(self, monitor: DeploymentMonitor, now: float) -> bool:
+        changed = False
+        if not monitor.status.expired:
+            if not monitor.status.job_id:
+                # no job was ever created: expire to Healthy
+                monitor.status.expired = True
+                monitor.status.phase = PHASE_HEALTHY
+                monitor.status.timestamp = to_rfc3339(now)
+                return True
+            try:
+                resp = self.analyst.get_status(monitor.status.job_id)
+            except AnalystError:
+                # analyst down or job gone: still fall through to the
+                # expiry check below, else the monitor polls forever
+                resp = None
+            if resp is not None:
+                old_phase = monitor.status.phase
+                monitor.status.phase = resp.phase
+                if resp.anomaly:
+                    monitor.status.anomaly = Anomaly.from_flat(resp.anomaly)
+                    changed = True
+                if resp.hpa_logs:
+                    new_logs = [
+                        HpaLogEntry(
+                            timestamp=str(l.get("timestamp", "")),
+                            hpascore=float(l.get("hpascore", 0) or 0),
+                            reason=l.get("reason", "") or "",
+                            details=l.get("details", []) or [],
+                        )
+                        for l in resp.hpa_logs
+                    ]
+                    old_ts = sorted(l.timestamp for l in monitor.status.hpa_logs)
+                    if sorted(l.timestamp for l in new_logs) != old_ts:
+                        monitor.status.hpa_logs = new_logs
+                        changed = True
+                if monitor.status.phase != old_phase:
+                    changed = True
+                monitor.status.timestamp = to_rfc3339(now)
+        if monitor.status.phase == PHASE_RUNNING and monitor.spec.wait_until:
+            try:
+                from ..utils.timeutils import from_rfc3339
+
+                until = from_rfc3339(monitor.spec.wait_until)
+            except (ValueError, TypeError):
+                until = None
+            if until is not None and until < now:
+                monitor.status.phase = PHASE_HEALTHY
+                monitor.status.expired = True
+                monitor.status.timestamp = to_rfc3339(now)
+                changed = True
+        return changed

@@ -1,0 +1,366 @@
+"""The three operator controllers: Deployment, Monitor (remediation), HPA.
+
+Event-handler re-derivations of foremast-barrelman/pkg/controller/
+{DeploymentController,MonitorController,HpaController}.go. The Go versions
+hang off informer caches; here each controller exposes plain on_* methods
+the reconcile loop (or FakeKube watch) calls — same decisions, no informer
+machinery.
+
+Key behavior contracts:
+  * namespace gating — blacklist {kube-public, kube-system, opa, monitoring}
+    + namespace annotation foremast.ai/monitoring != "false"
+    (DeploymentController.go:89-94, :412-429).
+  * canary naming — deployments suffixed "-foremast-canary" are judged
+    against the base deployment (DeploymentController.go:58).
+  * redeploy detection — container image or env changed
+    (DeploymentController.go:125-135, :156-194); rollback-generated updates
+    skipped (revision == RollbackRevision or deprecated rollback annotation,
+    :177-186).
+  * remediation — phase flip to Unhealthy with !RemediationTaken dispatches
+    rollback/pause/auto (MonitorController.go:122-143). Rollback is
+    re-implemented as a ReplicaSet template patch (the modern equivalent of
+    the removed extensions/v1beta1 DeploymentRollback the reference used,
+    MonitorController.go:222-237); paused deployments are refused (:219-221).
+  * HPA — stamps hpaScoreTemplate (default cpu_bound) when HPA_STRATEGY is
+    hpa_exists, renders a scaling-explanation letter on desiredReplicas
+    changes driven by the hpa_score metric: 4 most recent logs for scale-up,
+    6 for scale-down (HpaController.go:94-141).
+"""
+from __future__ import annotations
+
+import time
+
+from ..utils.timeutils import to_rfc3339
+from .barrelman import Barrelman
+from .types import (
+    DEFAULT_HPA_TEMPLATE,
+    PHASE_HEALTHY,
+    PHASE_UNHEALTHY,
+    REMEDIATION_AUTO,
+    REMEDIATION_AUTO_PAUSE,
+    REMEDIATION_AUTO_ROLLBACK,
+    DeploymentMonitor,
+    MonitorSpec,
+    MonitorStatus,
+    STRATEGY_CANARY,
+    STRATEGY_ROLLING_UPDATE,
+)
+
+NAMESPACE_BLACKLIST = {"kube-public", "kube-system", "opa", "monitoring"}
+MONITORING_ANNOTATION = "foremast.ai/monitoring"
+CANARY_SUFFIX = "-foremast-canary"
+ROLLBACK_ANNOTATION = "deprecated.deployment.rollback.to"
+ROLLBACK_MESSAGE_ANNOTATION = "deployment.foremast.ai/rollbackMessage"
+HPA_SCORE_METRIC = "namespace_app_pod_hpa_score"
+
+ALERT_LETTER = """
+At {timestamp} {application} at {namespace} was scaled {action} from {old} to {new} pods. This is because
+{details}
+If you have any question, please refer to the HPA docs.
+"""
+
+
+def _containers(deployment: dict) -> list[dict]:
+    return (
+        deployment.get("spec", {})
+        .get("template", {})
+        .get("spec", {})
+        .get("containers", [])
+    )
+
+
+def _env_equal(a: list, b: list) -> bool:
+    if len(a) != len(b):
+        return False
+    return all(
+        x.get("name") == y.get("name") and x.get("value") == y.get("value")
+        for x, y in zip(a, b)
+    )
+
+
+def _revision(deployment: dict) -> int:
+    return int(
+        deployment.get("metadata", {})
+        .get("annotations", {})
+        .get("deployment.kubernetes.io/revision", 0)
+        or 0
+    )
+
+
+class DeploymentController:
+    def __init__(self, kube, barrelman: Barrelman):
+        self.kube = kube
+        self.barrelman = barrelman
+
+    # -- gating (DeploymentController.go:89-94, 412-429) --
+    def is_monitored_namespace(self, ns: str) -> bool:
+        if ns in NAMESPACE_BLACKLIST:
+            return False
+        if not self.barrelman.watches_namespace(ns):
+            return False
+        return self.kube.namespace_annotations(ns).get(MONITORING_ANNOTATION) != "false"
+
+    def _app_name(self, deployment: dict) -> str:
+        labels = deployment.get("metadata", {}).get("labels", {}) or {}
+        return labels.get("app", deployment.get("metadata", {}).get("name", ""))
+
+    # -- handlers --
+    def on_add(self, deployment: dict):
+        """New app-labeled deployment -> baseline Healthy monitor; canary
+        deployments start a canary analysis against the base immediately."""
+        ns = deployment["metadata"].get("namespace", "default")
+        if not self.is_monitored_namespace(ns):
+            return
+        name = deployment["metadata"]["name"]
+        app = self._app_name(deployment)
+        if not app:
+            return
+        if name.endswith(CANARY_SUFFIX):
+            base = name[: -len(CANARY_SUFFIX)]
+            self.barrelman.monitor_new_deployment(
+                ns, base, deployment, strategy=STRATEGY_CANARY
+            )
+            return
+        if self.kube.get_monitor(ns, name) is None:
+            self.kube.upsert_monitor(
+                DeploymentMonitor(
+                    name=name,
+                    namespace=ns,
+                    annotations={"deployment.foremast.ai/name": name},
+                    spec=MonitorSpec(
+                        selector=(deployment["spec"].get("selector", {}) or {}).get(
+                            "matchLabels", {}
+                        )
+                    ),
+                    status=MonitorStatus(
+                        phase=PHASE_HEALTHY, timestamp=to_rfc3339(time.time())
+                    ),
+                )
+            )
+
+    def on_update(self, old: dict, new: dict):
+        """Image/env diff -> start rolling-update analysis (with the
+        rollback-loop guard)."""
+        ns = new["metadata"].get("namespace", "default")
+        if not self.is_monitored_namespace(ns):
+            return
+        name = new["metadata"]["name"]
+        app = self._app_name(new)
+        old_c, new_c = _containers(old), _containers(new)
+        if len(old_c) != len(new_c):
+            return
+        changed = any(
+            oc.get("image") != nc.get("image")
+            or not _env_equal(oc.get("env", []), nc.get("env", []))
+            for oc, nc in zip(old_c, new_c)
+        )
+        if not changed:
+            return
+        monitor = self.kube.get_monitor(ns, name)
+        rollback_revision = _revision(old)
+        if monitor is not None:
+            new_rev = _revision(new)
+            if new_rev > 0 and new_rev == monitor.spec.rollback_revision:
+                return  # this update IS the rollback we asked for
+        if old["metadata"].get("annotations", {}).get(ROLLBACK_ANNOTATION):
+            return
+        strategy = (
+            STRATEGY_CANARY if name.endswith(CANARY_SUFFIX) else STRATEGY_ROLLING_UPDATE
+        )
+        self.barrelman.monitor_new_deployment(
+            ns,
+            name[: -len(CANARY_SUFFIX)] if strategy == STRATEGY_CANARY else app,
+            new,
+            strategy=strategy,
+            rollback_revision=rollback_revision,
+        )
+
+    def on_delete(self, deployment: dict):
+        ns = deployment["metadata"].get("namespace", "default")
+        self.kube.delete_metadata(ns, self._app_name(deployment))
+
+
+class MonitorController:
+    def __init__(self, kube, barrelman: Barrelman):
+        self.kube = kube
+        self.barrelman = barrelman
+
+    def on_update(self, old: DeploymentMonitor | None, new: DeploymentMonitor):
+        # remediation on phase flip to Unhealthy (MonitorController.go:85-143)
+        flipped = (
+            new.status.phase == PHASE_UNHEALTHY
+            and (old is None or old.status.phase != PHASE_UNHEALTHY)
+        )
+        if flipped and not new.status.remediation_taken:
+            err = self.remediate(new)
+            new.status.remediation_taken = True
+            self.kube.upsert_monitor(new)
+            if err:
+                self.kube.record_event(
+                    "DeploymentMonitor", new.namespace, new.name,
+                    "RemediationFailed", err,
+                )
+        # re-arm perpetual monitors on spec change (:104-113, 146-155)
+        if old is not None:
+            if new.spec.continuous and not old.spec.continuous:
+                self.barrelman.monitor_continuously(new)
+            if new.spec.hpa_score_template and (
+                new.spec.hpa_score_template != old.spec.hpa_score_template
+            ):
+                self.barrelman.monitor_hpa(new)
+
+    def remediate(self, monitor: DeploymentMonitor) -> str:
+        option = monitor.spec.remediation.option
+        if option == REMEDIATION_AUTO_ROLLBACK:
+            return self.rollback(monitor)
+        if option == REMEDIATION_AUTO_PAUSE:
+            return self.pause(monitor)
+        if option == REMEDIATION_AUTO:
+            # reserved for policy-driven selection (a stub in the reference
+            # too, MonitorController.go:291-294)
+            return ""
+        return ""
+
+    def _deployment_name(self, monitor: DeploymentMonitor) -> str:
+        return monitor.annotations.get("deployment.foremast.ai/name", monitor.name)
+
+    def rollback(self, monitor: DeploymentMonitor) -> str:
+        """Roll the deployment back to spec.rollback_revision by patching
+        its pod template from the matching ReplicaSet — the modern
+        replacement for the removed DeploymentRollback subresource."""
+        if monitor.spec.rollback_revision == 0:
+            return ""
+        name = self._deployment_name(monitor)
+        ns = monitor.namespace
+        depl = self.kube.get_deployment(ns, name)
+        if depl is None:
+            return f"deployment {ns}/{name} not found"
+        if _revision(depl) == monitor.spec.rollback_revision:
+            return ""  # already there
+        if depl.get("spec", {}).get("paused"):
+            return (
+                f"cannot rollback paused deployment {name}; resume it first "
+                f"with 'kubectl rollout resume deployment/{name}'"
+            )
+        target_rs = None
+        for rs in self.kube.list_replicasets(ns):
+            owners = rs["metadata"].get("ownerReferences", [])
+            if not any(o.get("name") == name and o.get("kind") == "Deployment" for o in owners):
+                continue
+            rev = int(
+                rs["metadata"].get("annotations", {}).get(
+                    "deployment.kubernetes.io/revision", 0
+                ) or 0
+            )
+            if rev == monitor.spec.rollback_revision:
+                target_rs = rs
+                break
+        if target_rs is None:
+            return f"revision {monitor.spec.rollback_revision} not found for {name}"
+        message = (
+            "Foremast detected unhealthy, so rolled back automatically to "
+            f"revision:{monitor.spec.rollback_revision}"
+        )
+        self.kube.patch_deployment(
+            ns,
+            name,
+            {
+                "metadata": {"annotations": {ROLLBACK_MESSAGE_ANNOTATION: message}},
+                "spec": {"template": target_rs["spec"]["template"]},
+            },
+        )
+        self.kube.record_event("Deployment", ns, name, "ForemastRollback", message)
+        return ""
+
+    def pause(self, monitor: DeploymentMonitor) -> str:
+        name = self._deployment_name(monitor)
+        ns = monitor.namespace
+        if self.kube.get_deployment(ns, name) is None:
+            return f"deployment {ns}/{name} not found"
+        message = "Foremast detected unhealthy, so paused this deployment"
+        self.kube.patch_deployment(
+            ns,
+            name,
+            {
+                "metadata": {"annotations": {ROLLBACK_MESSAGE_ANNOTATION: message}},
+                "spec": {"paused": True},
+            },
+        )
+        self.kube.record_event("Deployment", ns, name, "ForemastPaused", message)
+        return ""
+
+
+class HpaController:
+    def __init__(self, kube, barrelman: Barrelman):
+        self.kube = kube
+        self.barrelman = barrelman
+        self.alerts: list[str] = []  # rendered letters (log sink)
+
+    def _monitor_for(self, hpa: dict) -> DeploymentMonitor | None:
+        ns = hpa["metadata"].get("namespace", "default")
+        target = hpa.get("spec", {}).get("scaleTargetRef", {}).get("name", "")
+        return self.kube.get_monitor(ns, target) if target else None
+
+    def on_upsert(self, old: dict | None, new: dict):
+        """Stamp the score template + HpaScoreEnabled; alert on scaling."""
+        if self.barrelman.hpa_strategy == "hpa_exists":
+            monitor = self._monitor_for(new)
+            if monitor is not None and not monitor.spec.hpa_score_template:
+                monitor.spec.hpa_score_template = DEFAULT_HPA_TEMPLATE
+                monitor.status.hpa_score_enabled = True
+                self.kube.upsert_monitor(monitor)
+                self.barrelman.monitor_hpa(monitor)
+        if old is None:
+            return
+        old_desired = old.get("status", {}).get("desiredReplicas", 0)
+        new_desired = new.get("status", {}).get("desiredReplicas", 0)
+        if old_desired == new_desired:
+            return
+        metrics = new.get("spec", {}).get("metrics", [])
+        if not any(
+            m.get("type") == "Object"
+            and m.get("object", {}).get("metric", {}).get("name") == HPA_SCORE_METRIC
+            for m in metrics
+        ):
+            return
+        monitor = self._monitor_for(new)
+        if monitor is None:
+            return
+        scale_down = new_desired < old.get("status", {}).get("currentReplicas", old_desired)
+        log_count = 6 if scale_down else 4  # HpaController.go:113-117
+        logs = sorted(
+            monitor.status.hpa_logs, key=lambda l: l.timestamp, reverse=True
+        )[:log_count]
+        details = "\n".join(
+            f"{d.get('metricType', d.get('metricAlias', '?'))} at {l.timestamp} "
+            f"value {d.get('current')} is out of normal range "
+            f"({d.get('lower')}, {d.get('upper')})"
+            for l in logs
+            for d in l.details
+        )
+        letter = ALERT_LETTER.format(
+            timestamp=to_rfc3339(time.time()),
+            application=monitor.annotations.get(
+                "deployment.foremast.ai/name", monitor.name
+            ),
+            namespace=monitor.namespace,
+            action="down" if scale_down else "up",
+            old=old.get("status", {}).get("currentReplicas", old_desired),
+            new=new_desired,
+            details=details,
+        )
+        self.alerts.append(letter)
+        self.kube.record_event(
+            "HorizontalPodAutoscaler",
+            new["metadata"].get("namespace", "default"),
+            new["metadata"]["name"],
+            "ForemastScaling",
+            letter.strip(),
+        )
+
+    def on_delete(self, hpa: dict):
+        monitor = self._monitor_for(hpa)
+        if monitor is not None:
+            monitor.spec.hpa_score_template = ""
+            monitor.status.hpa_score_enabled = False
+            self.kube.upsert_monitor(monitor)

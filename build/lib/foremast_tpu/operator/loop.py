@@ -1,0 +1,117 @@
+"""Operator reconcile loop: poll-and-diff in place of Go informers.
+
+The reference wires informer caches + a rate-limited workqueue with 2
+workers and a 10 s status ticker (cmd/manager/main.go:65-111,
+Barrelman.go:64-69). The TPU-native operator replaces that machinery with
+one idempotent `tick()`: list the world, diff against the previous
+snapshot, dispatch the controller handlers, then run the status sweep.
+Restart-safe by construction — the first tick rebuilds the snapshot and
+reconciles from the CRDs (the reference relies on the same property,
+SURVEY.md §5 checkpoint/resume).
+"""
+from __future__ import annotations
+
+import copy
+import time
+
+from .barrelman import Barrelman
+from .controllers import DeploymentController, HpaController, MonitorController
+from .types import PHASE_UNHEALTHY
+
+
+class OperatorLoop:
+    def __init__(self, kube, analyst, mode: str = "hpa_and_healthy_monitoring",
+                 hpa_strategy: str = "hpa_exists", watch_namespaces=None):
+        self.kube = kube
+        self.barrelman = Barrelman(kube, analyst, mode=mode,
+                                   hpa_strategy=hpa_strategy,
+                                   watch_namespaces=watch_namespaces)
+        self.deployments = DeploymentController(kube, self.barrelman)
+        self.monitors = MonitorController(kube, self.barrelman)
+        self.hpas = HpaController(kube, self.barrelman)
+        self._depl_snapshot: dict[tuple, dict] = {}
+        self._hpa_snapshot: dict[tuple, dict] = {}
+        self._monitor_phases: dict[tuple, str] = {}
+        self._primed = False
+
+    def tick(self, now: float | None = None) -> dict:
+        """One full reconcile pass. Returns the status sweep's touches."""
+        now = time.time() if now is None else now
+        self._diff_deployments()
+        self._diff_hpas()
+        touched = self.barrelman.check_running_status(now)
+        self._sweep_monitors()
+        self._primed = True
+        return touched
+
+    # -- deployments --
+    def _diff_deployments(self):
+        seen = {}
+        for ns in self.kube.list_namespaces():
+            if not self.deployments.is_monitored_namespace(ns):
+                continue
+            for d in self.kube.list_deployments(ns):
+                key = (ns, d["metadata"]["name"])
+                seen[key] = copy.deepcopy(d)
+                old = self._depl_snapshot.get(key)
+                try:
+                    if old is None:
+                        # on_add is idempotent, so the first tick after a
+                        # restart just re-ensures baseline monitors exist
+                        self.deployments.on_add(d)
+                    elif old != seen[key]:
+                        self.deployments.on_update(old, d)
+                except Exception as e:  # noqa: BLE001 - one bad app must not
+                    # wedge reconciliation for the rest (snapshot still
+                    # advances, so the crash does not repeat every tick)
+                    self.kube.record_event(
+                        "Deployment", ns, key[1], "ReconcileError", str(e)
+                    )
+        for key in set(self._depl_snapshot) - set(seen):
+            ns, name = key
+            # a key can vanish because its namespace was un-annotated for
+            # monitoring; only a truly deleted deployment gets on_delete
+            # (which removes the app's user-managed DeploymentMetadata)
+            if self.kube.get_deployment(ns, name) is None:
+                self.deployments.on_delete(self._depl_snapshot[key])
+        self._depl_snapshot = seen
+
+    # -- hpas --
+    def _diff_hpas(self):
+        seen = {}
+        for ns in self.kube.list_namespaces():
+            if not self.barrelman.watches_namespace(ns):
+                continue
+            for h in self.kube.list_hpas(ns):
+                key = (ns, h["metadata"]["name"])
+                seen[key] = copy.deepcopy(h)
+                old = self._hpa_snapshot.get(key)
+                if old != seen[key]:
+                    self.hpas.on_upsert(old, h)
+        for key in set(self._hpa_snapshot) - set(seen):
+            self.hpas.on_delete(self._hpa_snapshot[key])
+        self._hpa_snapshot = seen
+
+    # -- monitors (remediation on phase flips) --
+    def _sweep_monitors(self):
+        for m in self.kube.list_monitors():
+            if not self.barrelman.watches_namespace(m.namespace):
+                continue
+            key = (m.namespace, m.name)
+            old_phase = self._monitor_phases.get(key)
+            if m.status.phase == PHASE_UNHEALTHY and old_phase != PHASE_UNHEALTHY:
+                prev = None
+                if old_phase is not None:
+                    prev = copy.deepcopy(m)
+                    prev.status.phase = old_phase
+                self.monitors.on_update(prev, m)
+            self._monitor_phases[key] = m.status.phase
+
+    def run_forever(self, interval: float = 10.0):
+        while True:
+            t0 = time.time()
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 - operator must survive
+                print(f"[foremast-tpu operator] tick error: {e}", flush=True)
+            time.sleep(max(0.0, interval - (time.time() - t0)))

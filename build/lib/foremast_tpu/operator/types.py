@@ -1,0 +1,170 @@
+"""Operator resource types: DeploymentMetadata + DeploymentMonitor.
+
+Re-derives the two CRDs of the reference operator
+(foremast-barrelman/pkg/apis/deployment/v1alpha1/types.go) as plain
+dataclasses with dict (JSON) codecs — the shapes the real K8s CRDs
+(deploy/crds/*.yaml here) serialize to:
+
+  * DeploymentMetadata (types.go:14-41): per-app config — analyst endpoint,
+    metric source + monitored metric list, HPA score templates.
+  * DeploymentMonitor (types.go:200-246 spec, :249-269 status): per-app job
+    state — selector, watch window, continuous flag, remediation policy,
+    rollback revision, hpaScoreTemplate; status carries jobId, phase,
+    anomaly, hpa logs.
+  * phases (types.go:300-314), remediation options (types.go:317-328),
+    Anomaly (types.go:339-354).
+"""
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+# --- monitor phases (types.go:300-314) ---
+PHASE_HEALTHY = "Healthy"
+PHASE_RUNNING = "Running"
+PHASE_FAILED = "Failed"
+PHASE_UNHEALTHY = "Unhealthy"
+PHASE_WARNING = "Warning"
+PHASE_EXPIRED = "Expired"
+PHASE_ABORT = "Abort"
+
+# --- remediation options (types.go:317-328) ---
+REMEDIATION_NONE = "None"
+REMEDIATION_AUTO_ROLLBACK = "AutoRollback"
+REMEDIATION_AUTO_PAUSE = "AutoPause"
+REMEDIATION_AUTO = "Auto"
+
+# --- strategies (metricsquery.go:14-20) ---
+STRATEGY_ROLLING_UPDATE = "rollingUpdate"
+STRATEGY_CANARY = "canary"
+STRATEGY_CONTINUOUS = "continuous"
+STRATEGY_HPA = "hpa"
+
+
+@dataclass
+class Analyst:
+    endpoint: str = ""
+    version: str = "0.0.1"
+
+
+@dataclass
+class Monitoring:
+    metric_name: str = ""
+    metric_type: str = "counter"
+    metric_alias: str = ""
+
+
+@dataclass
+class Metrics:
+    data_source_type: str = "prometheus"
+    endpoint: str = ""
+    monitoring: list = field(default_factory=list)  # [Monitoring]
+
+
+@dataclass
+class HpaScoreTemplate:
+    """Named alias list, e.g. cpu_bound -> [cpu, tps, latency]
+    (types.go:63-67; default template name at Barrelman.go:37)."""
+
+    name: str = ""
+    metrics: list = field(default_factory=list)  # alias names, priority = index
+
+
+DEFAULT_HPA_TEMPLATE = "cpu_bound"
+
+
+@dataclass
+class DeploymentMetadata:
+    name: str = ""
+    namespace: str = ""
+    analyst: Analyst = field(default_factory=Analyst)
+    metrics: Metrics = field(default_factory=Metrics)
+    hpa_score_templates: list = field(default_factory=list)  # [HpaScoreTemplate]
+
+    def template_named(self, name: str) -> HpaScoreTemplate | None:
+        for t in self.hpa_score_templates:
+            if t.name == name:
+                return t
+        return None
+
+
+@dataclass
+class RemediationAction:
+    option: str = REMEDIATION_NONE
+    parameters: dict = field(default_factory=dict)
+
+
+@dataclass
+class AnomalousMetricValue:
+    time: int = 0
+    value: float = 0.0
+
+
+@dataclass
+class AnomalousMetric:
+    name: str = ""
+    tags: str = ""
+    values: list = field(default_factory=list)  # [AnomalousMetricValue]
+
+
+@dataclass
+class Anomaly:
+    anomalous_metrics: list = field(default_factory=list)  # [AnomalousMetric]
+
+    @classmethod
+    def from_flat(cls, flat: dict) -> "Anomaly":
+        """{metric: [ts, v, ts, v, ...]} -> structured pairs (the wire shape
+        the engine emits; DeploymentController.go:431-458 did this in Go)."""
+        ms = []
+        for name, pairs in (flat or {}).items():
+            vals = [
+                AnomalousMetricValue(time=int(pairs[i]), value=float(pairs[i + 1]))
+                for i in range(0, len(pairs) - 1, 2)
+            ]
+            ms.append(AnomalousMetric(name=name, values=vals))
+        return cls(anomalous_metrics=ms)
+
+
+@dataclass
+class HpaLogEntry:
+    timestamp: str = ""
+    hpascore: float = 0.0
+    reason: str = ""
+    details: list = field(default_factory=list)  # [{metricType,current,upper,lower}]
+
+
+@dataclass
+class MonitorSpec:
+    selector: dict = field(default_factory=dict)  # label query
+    analyst: Analyst = field(default_factory=Analyst)
+    start_time: str = ""
+    wait_until: str = ""
+    metrics: Metrics = field(default_factory=Metrics)
+    continuous: bool = False
+    remediation: RemediationAction = field(default_factory=RemediationAction)
+    rollback_revision: int = 0
+    hpa_score_template: str = ""
+
+
+@dataclass
+class MonitorStatus:
+    observed_generation: int = 0
+    job_id: str = ""
+    phase: str = PHASE_HEALTHY
+    remediation_taken: bool = False
+    anomaly: Anomaly = field(default_factory=Anomaly)
+    timestamp: str = ""
+    expired: bool = False
+    hpa_score_enabled: bool = False
+    hpa_logs: list = field(default_factory=list)  # [HpaLogEntry]
+
+
+@dataclass
+class DeploymentMonitor:
+    name: str = ""
+    namespace: str = ""
+    annotations: dict = field(default_factory=dict)
+    spec: MonitorSpec = field(default_factory=MonitorSpec)
+    status: MonitorStatus = field(default_factory=MonitorStatus)
+
+    def to_json(self) -> dict:
+        return asdict(self)

@@ -1,0 +1,349 @@
+"""Batched forecasting models + anomaly-band logic (lax.scan smoothers).
+
+The reference brain's historical-model judgment mode fits a forecaster on the
+7-day historical window, derives an upper/lower band, and flags current-window
+points outside it (spec: SURVEY.md §2.4; algorithm menu at
+docs/guides/design.md:53-88 — moving average, exponential smoothing, double
+exponential smoothing, Holt-Winters; default ML_ALGORITHM=moving_average_all
+at deploy/foremast/3_brain/foremast-brain.yaml:24-25; per-metric
+threshold/bound/min_lower_bound overrides at foremast-brain.yaml:26-73).
+
+TPU design:
+  * every model is an online one-step-ahead predictor rolled over the FULL
+    (historical ++ current) series by `lax.scan` — no Python loops, no
+    data-dependent shapes. Gaps advance the model state by its own forecast
+    (standard missing-data handling for exponential smoothers).
+  * band sigma is the RMS one-step residual over the *historical* region only
+    (region selected by index masks, not slicing, so hist_len is a traced
+    per-series value and one compiled program serves every job shape bucket).
+  * Holt-Winters parameters are fit by a grid search minimizing historical
+    SSE: candidates stream through `lax.map` (bounded memory), each candidate
+    vmapped across the whole batch — replacing the per-series scipy.optimize
+    loop a CPU brain would run.
+
+All kernels take (B, T) values + masks and are jit-compiled once per (T,
+period/window) bucket.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "ALGO_MOVING_AVERAGE",
+    "ALGO_SES",
+    "ALGO_DES",
+    "ALGO_HOLT_WINTERS",
+    "BOUND_BOTH",
+    "BOUND_UPPER",
+    "BOUND_LOWER",
+    "masked_mean_std",
+    "moving_average_predictions",
+    "ses_predictions",
+    "des_predictions",
+    "holt_winters_predictions",
+    "fit_holt_winters",
+    "fit_seasonal_trend",
+    "residual_sigma",
+    "band_anomalies",
+]
+
+_F = jnp.float32
+
+ALGO_MOVING_AVERAGE = 0
+ALGO_SES = 1
+ALGO_DES = 2
+ALGO_HOLT_WINTERS = 3
+
+# ML_BOUND codes. The reference deploy config uses small-int codes
+# (deploy/foremast/3_brain/foremast-brain.yaml: bound=1 for error5xx/4xx/
+# cpu/memory, bound=3 for latency); we read them as a bitmask:
+# bit0 = check upper band, bit1 = check lower band. 0 is treated as both.
+BOUND_UPPER = 1
+BOUND_LOWER = 2
+BOUND_BOTH = 3
+
+
+def _first_valid(x, mask):
+    """Value at the first True of mask (0.0 if none)."""
+    idx = jnp.argmax(mask)
+    return jnp.where(jnp.any(mask), x[idx], 0.0)
+
+
+def masked_mean_std(x, mask, axis=-1):
+    m = mask.astype(_F)
+    n = jnp.sum(m, axis=axis)
+    denom = jnp.where(n == 0, 1.0, n)
+    mean = jnp.sum(x * m, axis=axis) / denom
+    var = jnp.sum(m * (x - jnp.expand_dims(mean, axis)) ** 2, axis=axis) / denom
+    return mean, jnp.sqrt(var)
+
+
+# ---------------------------------------------------------------------------
+# One-step-ahead predictors. All: (T,) x, (T,) mask -> (T,) preds where
+# preds[t] is the model's forecast of x[t] before observing it.
+# ---------------------------------------------------------------------------
+def _moving_average_1d(x, mask, window: int):
+    """Causal rolling mean over the last `window` time slots (valid only).
+
+    Time-based, not count-based: a gap shrinks the sample, it does not pull
+    older points into the window — a 5-step MA always looks back 5 minutes at
+    a 60 s step, matching how the brain's moving-average band tracks recency.
+    When the whole window is a gap, the prediction falls back to the most
+    recent valid value strictly before t (causal forward-fill); only slots
+    before the first observation ever see the first valid value.
+    """
+    T = x.shape[0]
+    xf = x.astype(_F)
+    xm = jnp.where(mask, xf, 0.0)
+    m = mask.astype(_F)
+    csum = jnp.concatenate([jnp.zeros(1, _F), jnp.cumsum(xm)])
+    ccnt = jnp.concatenate([jnp.zeros(1, _F), jnp.cumsum(m)])
+    t = jnp.arange(T)
+    lo = jnp.maximum(t - window, 0)
+    s = csum[t] - csum[lo]
+    c = ccnt[t] - ccnt[lo]
+    # causal forward-fill: value at the last valid index strictly before t
+    idx = jnp.where(mask, t, -1)
+    last_le = lax.cummax(idx)  # last valid index <= t
+    prev_idx = jnp.concatenate([jnp.full((1,), -1), last_le[:-1]])
+    first = _first_valid(x, mask)
+    fallback = jnp.where(prev_idx >= 0, xf[jnp.maximum(prev_idx, 0)], first)
+    return jnp.where(c > 0, s / jnp.where(c == 0, 1.0, c), fallback)
+
+
+def _ses_1d(x, mask, alpha):
+    s0 = _first_valid(x, mask)
+
+    def step(s, inp):
+        xt, mt = inp
+        pred = s
+        s_next = jnp.where(mt, alpha * xt + (1.0 - alpha) * s, s)
+        return s_next, pred
+
+    _, preds = lax.scan(step, s0, (x.astype(_F), mask))
+    return preds
+
+
+def _des_1d(x, mask, alpha, beta):
+    """Holt's linear (double exponential smoothing)."""
+    l0 = _first_valid(x, mask)
+    b0 = jnp.asarray(0.0, _F)
+
+    def step(carry, inp):
+        l, b = carry
+        xt, mt = inp
+        pred = l + b
+        l_next = jnp.where(mt, alpha * xt + (1.0 - alpha) * (l + b), l + b)
+        b_next = jnp.where(mt, beta * (l_next - l) + (1.0 - beta) * b, b)
+        return (l_next, b_next), pred
+
+    _, preds = lax.scan(step, (l0, b0), (x.astype(_F), mask))
+    return preds
+
+
+def _hw_1d(x, mask, period: int, alpha, beta, gamma):
+    """Additive Holt-Winters with static seasonal period."""
+    T = x.shape[0]
+    m0 = mask[:period].astype(_F)
+    n0 = jnp.maximum(jnp.sum(m0), 1.0)
+    l0 = jnp.sum(jnp.where(mask[:period], x[:period].astype(_F), 0.0)) / n0
+    s0 = jnp.where(mask[:period], x[:period].astype(_F) - l0, 0.0)
+    b0 = jnp.asarray(0.0, _F)
+
+    def step(carry, inp):
+        l, b, season = carry
+        xt, mt = inp
+        s_t = season[0]
+        pred = l + b + s_t
+        l_next = jnp.where(mt, alpha * (xt - s_t) + (1.0 - alpha) * (l + b), l + b)
+        b_next = jnp.where(mt, beta * (l_next - l) + (1.0 - beta) * b, b)
+        s_new = jnp.where(mt, gamma * (xt - l_next) + (1.0 - gamma) * s_t, s_t)
+        season = jnp.roll(season, -1).at[-1].set(s_new)
+        return (l_next, b_next, season), pred
+
+    _, preds = lax.scan(step, (l0, b0, s0), (x.astype(_F), mask))
+    return preds
+
+
+# Batched, jitted entry points.
+moving_average_predictions = jax.jit(
+    jax.vmap(_moving_average_1d, in_axes=(0, 0, None)), static_argnames=("window",)
+)
+ses_predictions = jax.jit(jax.vmap(_ses_1d, in_axes=(0, 0, 0)))
+des_predictions = jax.jit(jax.vmap(_des_1d, in_axes=(0, 0, 0, 0)))
+holt_winters_predictions = jax.jit(
+    jax.vmap(_hw_1d, in_axes=(0, 0, None, 0, 0, 0)), static_argnames=("period",)
+)
+
+
+# ---------------------------------------------------------------------------
+# Holt-Winters grid fit: per series, pick (alpha, beta, gamma) minimizing
+# masked SSE over the historical region.
+# ---------------------------------------------------------------------------
+def _default_grid():
+    a = jnp.asarray([0.1, 0.3, 0.5, 0.7, 0.9], _F)
+    b = jnp.asarray([0.0, 0.1, 0.3], _F)
+    g = jnp.asarray([0.05, 0.1, 0.3, 0.5], _F)
+    A, B, G = jnp.meshgrid(a, b, g, indexing="ij")
+    return jnp.stack([A.ravel(), B.ravel(), G.ravel()], axis=-1)  # (60, 3)
+
+
+@partial(jax.jit, static_argnames=("period",))
+def fit_holt_winters(x, mask, fit_mask, period: int, grid=None):
+    """Grid-fit HW per series.
+
+    Args:
+      x, mask: (B, T).
+      fit_mask: (B, T) bool — region whose residuals define the SSE
+                (historical region minus warmup).
+      period: seasonal period in steps (static).
+      grid: (G, 3) candidate (alpha, beta, gamma); default 60-point grid.
+
+    Returns (params (B, 3), preds (B, T)) — predictions under each series'
+    best parameters.
+    """
+    if grid is None:
+        grid = _default_grid()
+
+    def per_candidate(params):
+        a, b, g = params[0], params[1], params[2]
+        preds = jax.vmap(_hw_1d, in_axes=(0, 0, None, None, None, None))(
+            x, mask, period, a, b, g
+        )
+        r = jnp.where(fit_mask & mask, x - preds, 0.0)
+        n = jnp.maximum(jnp.sum((fit_mask & mask).astype(_F), axis=-1), 1.0)
+        return jnp.sum(r * r, axis=-1) / n  # (B,)
+
+    # lax.map keeps device memory at O(G*B) scores instead of materializing
+    # (G, B, T) candidate predictions; each candidate is still fully vmapped
+    # over the batch. The winner's predictions are recomputed once below.
+    sses = lax.map(per_candidate, grid)  # (G, B)
+    best = jnp.argmin(sses, axis=0)  # (B,)
+    params = grid[best]
+    preds = jax.vmap(_hw_1d, in_axes=(0, 0, None, 0, 0, 0))(
+        x, mask, period, params[:, 0], params[:, 1], params[:, 2]
+    )
+    return params, preds
+
+
+# ---------------------------------------------------------------------------
+# Prophet-style decomposable model: linear trend + Fourier seasonality.
+# ---------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("period", "order"))
+def fit_seasonal_trend(x, mask, fit_mask, period: int, order: int = 3,
+                       ridge: float = 1e-4):
+    """Fit trend+seasonality per series by masked ridge least squares.
+
+    The reference brain's menu lists Prophet for single-metric forecasting
+    (docs/guides/design.md:53-88). Prophet's core is a decomposable model
+    y(t) = g(t) + s(t): piecewise-linear trend plus a Fourier-series
+    seasonality, fit by regularized regression. This is that core, TPU-shaped:
+    one closed-form weighted least-squares solve per series — the normal
+    equations are batched (B, D, D) systems that XLA maps straight onto the
+    MXU, replacing Prophet's per-series Stan/L-BFGS optimizer loop.
+
+    Args:
+      x, mask:   (B, T) values + validity.
+      fit_mask:  (B, T) bool — points whose residuals define the fit
+                 (historical region).
+      period:    seasonal period in steps (static).
+      order:     Fourier order K (static); D = 2 + 2K design columns.
+      ridge:     Tikhonov weight keeping the solve well-posed when a series
+                 has few valid points or the window spans < one period.
+
+    Returns (beta (B, D), preds (B, T)).
+    """
+    B, T = x.shape
+    tn = jnp.arange(T, dtype=_F) / jnp.maximum(T - 1, 1)
+    cols = [jnp.ones(T, _F), tn]
+    w = 2.0 * jnp.pi * jnp.arange(T, dtype=_F) / period
+    for k in range(1, order + 1):
+        cols += [jnp.sin(k * w), jnp.cos(k * w)]
+    X = jnp.stack(cols, axis=-1)  # (T, D)
+    D = X.shape[-1]
+    sel = (mask & fit_mask).astype(_F)  # (B, T)
+    A = jnp.einsum("td,te,bt->bde", X, X, sel) + ridge * jnp.eye(D, dtype=_F)
+    rhs = jnp.einsum("td,bt->bd", X, sel * x.astype(_F))
+    beta = jnp.linalg.solve(A, rhs[..., None])[..., 0]  # (B, D)
+    preds = jnp.einsum("td,bd->bt", X, beta)
+    return beta, preds
+
+
+# ---------------------------------------------------------------------------
+# Band + anomaly logic
+# ---------------------------------------------------------------------------
+@jax.jit
+def residual_sigma(x, preds, mask, region_mask):
+    """RMS one-step residual over region_mask & mask, per series (B,).
+
+    With fewer than 2 residual samples there is no error scale to estimate;
+    sigma is +inf there, so downstream bands become infinitely wide and a
+    no-history series can never be judged anomalous (fail-open). The engine
+    additionally gates jobs on MIN_HISTORICAL_DATA_POINT_TO_MEASURE before
+    scoring, mirroring the reference brain's env config. A genuinely
+    constant history (n >= 2, zero residuals) keeps sigma = 0 on purpose:
+    any deviation from a perfectly flat metric IS anomalous.
+    """
+    sel = (mask & region_mask).astype(_F)
+    n = jnp.sum(sel, axis=-1)
+    r = jnp.where(mask & region_mask, x - preds, 0.0)
+    sigma = jnp.sqrt(jnp.sum(r * r, axis=-1) / jnp.maximum(n, 1.0))
+    return jnp.where(n >= 2.0, sigma, jnp.inf)
+
+
+@jax.jit
+def band_anomalies(
+    x,
+    mask,
+    region_mask,
+    preds,
+    sigma,
+    threshold,
+    bound_mode,
+    min_lower_bound,
+):
+    """Flag points outside the model band in the scored region.
+
+    Args:
+      x, mask:      (B, T) values + validity.
+      region_mask:  (B, T) bool — the current window being judged.
+      preds:        (B, T) model one-step predictions.
+      sigma:        (B,) residual scale.
+      threshold:    (B,) band half-width in sigmas (per-metric ML_THRESHOLD).
+      bound_mode:   (B,) int32 — BOUND_BOTH / BOUND_UPPER / BOUND_LOWER
+                    (per-metric ML_BOUND).
+      min_lower_bound: (B,) floor applied to the lower band (per-metric
+                    min_lower_bound{N} override; lets error-rate metrics not
+                    alarm on "too healthy").
+
+    Returns dict with upper/lower bands (B, T), anomaly flags (B, T),
+    counts (B,), first anomaly index (B,) (-1 if none), and checked point
+    counts (B,).
+    """
+    thr = threshold[:, None] * sigma[:, None]
+    upper = preds + thr
+    lower = jnp.maximum(preds - thr, min_lower_bound[:, None])
+
+    over = x > upper
+    under = x < lower
+    mode = bound_mode[:, None]
+    mode = jnp.where(mode == 0, BOUND_BOTH, mode)
+    viol = (over & ((mode & 1) > 0)) | (under & ((mode & 2) > 0))
+    flags = viol & mask & region_mask
+    counts = jnp.sum(flags, axis=-1)
+    first = jnp.where(
+        counts > 0, jnp.argmax(flags, axis=-1), jnp.full((x.shape[0],), -1)
+    )
+    checked = jnp.sum((mask & region_mask).astype(jnp.int32), axis=-1)
+    return {
+        "upper": upper,
+        "lower": lower,
+        "flags": flags,
+        "count": counts,
+        "first_index": first,
+        "checked": checked,
+    }

@@ -1,0 +1,212 @@
+"""HPA autoscaling score: forecast-driven demand vs capacity, on-device.
+
+Reference semantics (docs/dynamic_autoscaling.md; examples/hpa/README.MD):
+  * unified score in [0,100]; the HPA object targets 50, so score > 50 means
+    "scale up", < 50 "scale down" (dynamic_autoscaling.md:8-11) — the score
+    IS the ratio the HPA controller multiplies replicas by.
+  * TPS (traffic) is modeled for seasonality+trend; bounds are recomputed per
+    30-min window. Inside the band the predicted trend drives demand; outside
+    it, the recent observed (anomaly) trend does (dynamic_autoscaling.md:28-44).
+  * a reward over the SLA metric (default latency) biases the decision:
+    static SLA limit, dynamic 3-sigma limit, or min of both
+    (dynamic_autoscaling.md:45-56).
+  * scale-up reacts faster than scale-down ("breath" cooldowns,
+    dynamic_autoscaling.md:117-126) — cooldowns are inherently stateful
+    across scoring cycles, so they live host-side in `BreathState`, not in
+    the jitted kernel.
+
+The kernel is batched over services: one device launch scores every HPA job
+in the fleet. Forecaster choice is the caller's: the engine passes in the
+predictions/sigma produced by ops.forecast (Holt-Winters for seasonal
+traffic), keeping this kernel model-agnostic.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SLA_STATIC",
+    "SLA_DYNAMIC",
+    "SLA_MIN",
+    "REASON_PREDICTED_TREND",
+    "REASON_ANOMALY_TREND",
+    "REASON_SLA_VIOLATION",
+    "hpa_scores",
+    "BreathState",
+]
+
+_F = jnp.float32
+
+SLA_STATIC = 0  # fixed limit
+SLA_DYNAMIC = 1  # mean + 3 sigma of healthy history
+SLA_MIN = 2  # min(static, dynamic)
+
+REASON_PREDICTED_TREND = 0
+REASON_ANOMALY_TREND = 1
+REASON_SLA_VIOLATION = 2
+
+
+def _masked_mean(x, m, axis=-1):
+    mm = m.astype(_F)
+    n = jnp.maximum(jnp.sum(mm, axis=axis), 1.0)
+    return jnp.sum(x * mm, axis=axis) / n
+
+
+def _recent_slope(x, mask, region):
+    """Least-squares slope over the valid points of the scored region (B,)."""
+    sel = (mask & region).astype(_F)
+    t = jnp.arange(x.shape[-1], dtype=_F)[None, :]
+    n = jnp.maximum(jnp.sum(sel, axis=-1), 1.0)
+    tm = jnp.sum(t * sel, axis=-1) / n
+    xm = jnp.sum(x * sel, axis=-1) / n
+    cov = jnp.sum(sel * (t - tm[:, None]) * (x - xm[:, None]), axis=-1)
+    var = jnp.maximum(jnp.sum(sel * (t - tm[:, None]) ** 2, axis=-1), 1e-6)
+    return cov / var
+
+
+@jax.jit
+def hpa_scores(
+    tps,
+    tps_mask,
+    region,
+    tps_pred,
+    tps_sigma,
+    sla,
+    sla_mask,
+    sla_static_limit,
+    sla_mode,
+    threshold,
+):
+    """Compute fleet HPA scores.
+
+    Args:
+      tps:        (B, T) traffic series (historical ++ current window).
+      tps_mask:   (B, T) validity.
+      region:     (B, T) bool — the current scoring window (last ~30 min).
+      tps_pred:   (B, T) forecaster predictions for tps, fit on HISTORY ONLY
+                  (run the forecaster with mask & ~region so the band is
+                  frozen at window start — an online model that adapts inside
+                  the window absorbs the very surge it should detect).
+      tps_sigma:  (B,) residual scale of the forecaster on history.
+      sla:        (B, T) SLA metric series (latency).
+      sla_mask:   (B, T) validity.
+      sla_static_limit: (B,) static SLA limit per service.
+      sla_mode:   (B,) int32 — SLA_STATIC / SLA_DYNAMIC / SLA_MIN.
+      threshold:  (B,) band half-width in sigmas for the traffic band.
+
+    Returns dict:
+      score:  (B,) float in [0, 100] — 50 = keep replicas.
+      reason: (B,) int32 — REASON_* driving the decision.
+      demand, current_tps: (B,) — demand estimate vs observed traffic.
+      sla_current, sla_limit: (B,).
+      tps_upper, tps_lower: (B,) — band means over the region (for hpalogs
+      details {current, upper, lower} per models.go:194-209 semantics).
+    """
+    thr = threshold[:, None] * tps_sigma[:, None]
+    upper = tps_pred + thr
+    lower = tps_pred - thr
+
+    sel = tps_mask & region
+    current_tps = _masked_mean(tps, sel)
+    pred_mean = _masked_mean(tps_pred, region)
+    upper_mean = _masked_mean(upper, region)
+    lower_mean = _masked_mean(lower, region)
+
+    out_of_band = sel & ((tps > upper) | (tps < lower))
+    n_out = jnp.sum(out_of_band, axis=-1)
+    n_checked = jnp.maximum(jnp.sum(sel, axis=-1), 1)
+    # "observe N points" rule: the anomaly trend takes over once a third of
+    # the window sits outside the band.
+    anomalous = n_out * 3 >= n_checked
+
+    # demand: in-band -> the predicted trend; out-of-band -> the observed
+    # (anomaly) trend extrapolated half a window ahead.
+    horizon = jnp.sum(region.astype(_F), axis=-1) * 0.5
+    slope = _recent_slope(tps, tps_mask, region)
+    anomaly_demand = current_tps + slope * horizon
+    demand = jnp.maximum(jnp.where(anomalous, anomaly_demand, pred_mean), 0.0)
+
+    # capacity proxy: the historical traffic level the current replica count
+    # was provisioned for. score = 50 * demand/provisioned is then exactly
+    # "50 * pods-needed / pods-present" under throughput-proportional pods.
+    provisioned = _masked_mean(tps, tps_mask & ~region)
+
+    # SLA reward: limit per configured mode; violation forces scale-up bias.
+    hist_sel = sla_mask & ~region
+    sla_mu = _masked_mean(sla, hist_sel)
+    sla_sd = jnp.sqrt(
+        jnp.maximum(
+            _masked_mean((sla - sla_mu[:, None]) ** 2, hist_sel), 1e-12
+        )
+    )
+    dyn_limit = sla_mu + 3.0 * sla_sd
+    limit = jnp.where(
+        sla_mode == SLA_STATIC,
+        sla_static_limit,
+        jnp.where(
+            sla_mode == SLA_DYNAMIC,
+            dyn_limit,
+            jnp.minimum(sla_static_limit, dyn_limit),
+        ),
+    )
+    sla_current = _masked_mean(sla, sla_mask & region)
+    sla_violated = sla_current > limit
+
+    # SLA violation floors the score at 75 so a struggling service always
+    # scales up regardless of what the traffic model says.
+    base = 50.0 * demand / jnp.maximum(provisioned, 1e-6)
+    score = jnp.where(sla_violated, jnp.maximum(base, 75.0), base)
+    score = jnp.clip(score, 0.0, 100.0)
+
+    reason = jnp.where(
+        sla_violated,
+        REASON_SLA_VIOLATION,
+        jnp.where(anomalous, REASON_ANOMALY_TREND, REASON_PREDICTED_TREND),
+    ).astype(jnp.int32)
+
+    return {
+        "score": score,
+        "reason": reason,
+        "demand": demand,
+        "current_tps": current_tps,
+        "sla_current": sla_current,
+        "sla_limit": limit,
+        "tps_pred": pred_mean,
+        "tps_upper": upper_mean,
+        "tps_lower": lower_mean,
+    }
+
+
+@dataclass
+class BreathState:
+    """Host-side scale cooldowns: fast up, slow down, no flip-flop.
+
+    Mirrors the breath-duration rules (dynamic_autoscaling.md:117-126): a
+    scale-up signal passes after `breath_up_s` of sustained score > 50; a
+    scale-down needs `breath_down_s` (longer). Between decisions the emitted
+    score is pinned to 50 so the HPA holds replicas steady.
+    """
+
+    breath_up_s: float = 120.0
+    breath_down_s: float = 600.0
+    _since: dict = field(default_factory=dict)  # service -> (direction, t0)
+
+    def apply(self, service: str, raw_score: float, now: float | None = None) -> float:
+        now = time.time() if now is None else now
+        direction = 1 if raw_score > 50.0 else (-1 if raw_score < 50.0 else 0)
+        if direction == 0:
+            self._since.pop(service, None)
+            return 50.0
+        prev = self._since.get(service)
+        if prev is None or prev[0] != direction:
+            self._since[service] = (direction, now)
+            return 50.0
+        held = now - prev[1]
+        need = self.breath_up_s if direction > 0 else self.breath_down_s
+        if held >= need:
+            return float(raw_score)
+        return 50.0

@@ -1,0 +1,68 @@
+"""Masked, tie-averaged ranking — the core primitive of the rank-test family.
+
+TPU constraints drive the design (see /opt/skills/guides/pallas_guide.md and
+SURVEY.md §7 "Hard parts"): no data-dependent shapes, so missing samples are
+handled by masks, never by filtering. Masked slots sort to the end (+inf key)
+and receive rank 0; valid slots receive scipy.rankdata-compatible average
+ranks. Tie correction terms (sum of t^3 - t over tie groups) are computed with
+segment sums over sorted tie-group ids, which XLA lowers to scatter-adds.
+
+All functions operate on one 1-D series and are vmapped by callers; everything
+is O(T log T) via a single sort.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["masked_rankdata", "rank_and_ties"]
+
+
+@jax.jit
+def rank_and_ties(values: jnp.ndarray, mask: jnp.ndarray):
+    """Rank `values` where `mask` is True, averaging ties.
+
+    Args:
+      values: (T,) float array. Entries where mask is False are ignored.
+      mask:   (T,) bool array.
+
+    Returns:
+      ranks:    (T,) float32 — 1-based average ranks among valid entries,
+                0.0 for masked entries. Matches scipy.stats.rankdata on the
+                valid subset.
+      tie_term: scalar — sum over tie groups (valid entries only) of t^3 - t,
+                the correction term used by Mann-Whitney / Kruskal / Wilcoxon.
+      n_valid:  scalar float — number of valid entries.
+    """
+    T = values.shape[-1]
+    dtype = jnp.float32
+    vals = jnp.where(mask, values.astype(dtype), jnp.inf)
+    # Stable sort: masked (+inf) entries land at the end.
+    order = jnp.argsort(vals, stable=True)
+    sorted_vals = vals[order]
+    sorted_valid = mask[order]
+
+    pos = jnp.arange(1, T + 1, dtype=dtype)
+    new_group = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sorted_vals[1:] != sorted_vals[:-1]]
+    )
+    gid = jnp.cumsum(new_group) - 1  # 0-based tie-group ids, ascending
+
+    first = jax.ops.segment_min(pos, gid, num_segments=T)
+    last = jax.ops.segment_max(pos, gid, num_segments=T)
+    avg = (first + last) * 0.5
+    ranks_sorted = avg[gid]
+
+    ranks = jnp.zeros(T, dtype=dtype).at[order].set(ranks_sorted)
+    ranks = jnp.where(mask, ranks, 0.0)
+
+    counts = jax.ops.segment_sum(sorted_valid.astype(dtype), gid, num_segments=T)
+    tie_term = jnp.sum(counts**3 - counts)
+    n_valid = jnp.sum(mask.astype(dtype))
+    return ranks, tie_term, n_valid
+
+
+def masked_rankdata(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """scipy.stats.rankdata over the masked subset; 0 at masked positions."""
+    ranks, _, _ = rank_and_ties(values, mask)
+    return ranks

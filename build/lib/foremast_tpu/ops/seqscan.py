@@ -1,0 +1,124 @@
+"""Time-parallel (sequence-parallel) exponential smoothers.
+
+`lax.scan` forecasters (ops/forecast.py) walk the window serially: O(T)
+dependent steps, which for multi-week 60-s-step histories (T ~ 10^4-10^5)
+leaves the TPU idle between tiny steps and cannot shard the time axis.
+Masked SES and DES are *affine recurrences* —
+
+    state_t = A_t @ state_{t-1} + c_t
+    pred_t  = h · state_{t-1}
+
+— so the whole trajectory is a composition of affine maps, computable with
+`jax.lax.associative_scan` in O(log T) depth. That is this framework's
+sequence parallelism: the (A_t, c_t) element stream is embarrassingly
+data-parallel, the combine is associative, and when the time axis is
+sharded over the mesh GSPMD partitions the scan with inter-chip
+collectives — the role ring-attention plays for long-sequence transformers
+(SURVEY.md §2.8: long metric windows shard on time via scan, no attention
+needed).
+
+Equivalence with the sequential kernels is pinned by tests
+(tests/test_seqscan.py). SES stays bit-tight at any length; the DES form
+compounds f32 rounding through its 2x2 shear products (~4e-3 relative by
+T~4096 on trending series), so the engine's automatic long-window switch
+(LONG_WINDOW_STEPS, engine/config.py) applies to SES only — DES assoc is
+for explicitly time-sharded pipelines that accept the documented
+tolerance.
+
+Holt-Winters stays sequential: its seasonal-index gather makes the
+recurrence periodically-banded rather than chain-affine; its cost is
+dominated by the parameter grid search, which is already batch-parallel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .forecast import _first_valid
+
+__all__ = ["ses_predictions_assoc", "des_predictions_assoc",
+           "sequence_sharding"]
+
+_F = jnp.float32
+
+
+def _combine_scalar(left, right):
+    """Compose scalar affine maps: right ∘ left (scan order oldest-first)."""
+    A1, c1 = left
+    A2, c2 = right
+    return A2 * A1, A2 * c1 + c2
+
+
+def _combine_matrix(left, right):
+    """Compose 2x2 affine maps; elements carry a leading chunk dim inside
+    associative_scan, so use batched matmul/matvec."""
+    A1, c1 = left
+    A2, c2 = right
+    return A2 @ A1, jnp.einsum("...ij,...j->...i", A2, c1) + c2
+
+
+def _exclusive_states(A, c, v0):
+    """States BEFORE each step from inclusive affine prefix products.
+
+    A: (T, ...) per-step transition; c: (T, ...) per-step offset;
+    v0: initial state. Returns (T, ...) of state_{t-1}.
+    """
+    if A.ndim == 3:  # matrix-valued (DES)
+        MA, Mc = lax.associative_scan(_combine_matrix, (A, c))
+        after = jnp.einsum("tij,j->ti", MA, v0) + Mc
+        eye_state = v0[None, :]
+    else:  # scalar-valued (SES)
+        MA, Mc = lax.associative_scan(_combine_scalar, (A, c))
+        after = MA * v0 + Mc
+        eye_state = v0[None]
+    return jnp.concatenate([eye_state, after[:-1]], axis=0)
+
+
+def _ses_assoc_1d(x, mask, alpha):
+    """Associative-scan twin of forecast._ses_1d (identical outputs)."""
+    x = x.astype(_F)
+    m = mask.astype(_F)
+    s0 = _first_valid(x, mask)
+    A = 1.0 - alpha * m  # m_t ? (1-alpha) : 1
+    c = alpha * m * x  # m_t ? alpha x_t : 0
+    prev = _exclusive_states(A, c, s0)
+    return prev  # pred_t = s_{t-1}
+
+
+def _des_assoc_1d(x, mask, alpha, beta):
+    """Associative-scan twin of forecast._des_1d (identical outputs).
+
+    State v = (l, b). Observed step:
+      l' = (1-a) l + (1-a) b + a x
+      b' = -ba l + (b(1-a) + 1-b)·b + ba x     [b = beta, a = alpha]
+    Gap step: l' = l + b, b' = b. Both affine in v.
+    """
+    x = x.astype(_F)
+    T = x.shape[0]
+    m = mask.astype(_F)
+    l0 = _first_valid(x, mask)
+    v0 = jnp.stack([l0, jnp.asarray(0.0, _F)])
+
+    A_obs = jnp.asarray(
+        [[1.0 - alpha, 1.0 - alpha],
+         [-beta * alpha, beta * (1.0 - alpha) + (1.0 - beta)]], _F
+    )
+    A_gap = jnp.asarray([[1.0, 1.0], [0.0, 1.0]], _F)
+    A = m[:, None, None] * A_obs[None] + (1.0 - m)[:, None, None] * A_gap[None]
+    c = jnp.stack([alpha * m * x, beta * alpha * m * x], axis=1)  # (T, 2)
+    prev = _exclusive_states(A, c, v0)  # (T, 2)
+    return prev[:, 0] + prev[:, 1]  # pred_t = l_{t-1} + b_{t-1}
+
+
+ses_predictions_assoc = jax.jit(jax.vmap(_ses_assoc_1d, in_axes=(0, 0, 0)))
+des_predictions_assoc = jax.jit(jax.vmap(_des_assoc_1d, in_axes=(0, 0, 0, 0)))
+
+
+def sequence_sharding(mesh, time_axis_name: str):
+    """NamedSharding splitting the TIME axis of (B, T) windows over the
+    mesh — the long-window layout: one window's history spans every chip,
+    associative_scan's combine tree runs through ICI collectives."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(None, time_axis_name))

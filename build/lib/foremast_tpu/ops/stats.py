@@ -1,0 +1,48 @@
+"""Distribution tail functions needed by the pairwise test kernels.
+
+Everything here is elementwise, jit-safe, and batched for free. These are the
+TPU-side replacements for the scipy distribution calls the reference brain's
+pairwise comparators rely on (spec: SURVEY.md §2.4; foremast-brain/README.md
+lists Mann-Whitney / Wilcoxon / Kruskal / Friedman as the pairwise family).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import erfc, gammaincc
+
+_SQRT2 = 1.4142135623730951
+
+
+def norm_sf(z: jnp.ndarray) -> jnp.ndarray:
+    """Standard normal survival function P(Z > z)."""
+    return 0.5 * erfc(z / _SQRT2)
+
+
+def chi2_sf(x: jnp.ndarray, df: jnp.ndarray) -> jnp.ndarray:
+    """Chi-squared survival function P(X > x) with df degrees of freedom.
+
+    chi2.sf(x, k) == gammaincc(k/2, x/2) (regularized upper incomplete gamma).
+    """
+    x = jnp.maximum(x, 0.0)
+    return gammaincc(df / 2.0, x / 2.0)
+
+
+def kolmogorov_sf(x: jnp.ndarray, terms: int = 64) -> jnp.ndarray:
+    """Survival function of the Kolmogorov distribution.
+
+    sf(x) = 2 * sum_{k>=1} (-1)^{k-1} exp(-2 k^2 x^2), the asymptotic null
+    distribution of the two-sample KS statistic (scaled). The truncated series
+    only converges for x large enough that the `terms`-th term has decayed;
+    below that cutoff sf(x) is 1 to far beyond float32 precision
+    (sf(0.2) > 1 - 1e-6), so we return 1 exactly there instead of an
+    arbitrarily wrong partial sum.
+    """
+    x = jnp.asarray(x)
+    k = jnp.arange(1, terms + 1, dtype=x.dtype)
+    signs = jnp.where(k % 2 == 1, 1.0, -1.0).astype(x.dtype)
+    xc = jnp.maximum(x, 0.2)  # below cutoff the series result is discarded
+    # shape (..., terms)
+    expo = jnp.exp(-2.0 * (k**2) * (xc[..., None] ** 2))
+    s = 2.0 * jnp.sum(signs * expo, axis=-1)
+    s = jnp.where(x < 0.2, 1.0, s)
+    return jnp.clip(s, 0.0, 1.0)

@@ -29,7 +29,7 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import forecast as fc
-from ..ops.pairwise import friedman_chi_square, two_sample_tests
+from ..ops.pairwise import two_sample_tests
 from .mesh import FLEET_AXIS, fleet_sharding, replicated
 
 __all__ = ["score_pairs", "make_fleet_scorer", "fleet_summary", "COMBINE_ANY", "COMBINE_ALL"]
@@ -41,7 +41,6 @@ TEST_MANN_WHITNEY = 1
 TEST_WILCOXON = 2
 TEST_KRUSKAL = 4
 TEST_KS = 8
-TEST_FRIEDMAN = 16  # paired (baseline_t, current_t) blocks, k=2 treatments
 
 COMBINE_ANY = 0  # unhealthy if ANY enabled test rejects
 COMBINE_ALL = 1  # unhealthy only if ALL enabled tests reject
@@ -50,7 +49,6 @@ COMBINE_ALL = 1  # unhealthy only if ALL enabled tests reject
 MIN_MANN_WHITNEY = 20
 MIN_WILCOXON = 20
 MIN_KRUSKAL = 5
-MIN_FRIEDMAN = 5  # complete (both-sides-valid) blocks
 
 
 def _pair_verdict(
@@ -69,38 +67,22 @@ def _pair_verdict(
 ):
     """Single (baseline, current) judgment. vmapped by score_pairs.
 
-    min_points: (3,) or (4,) gates for mann-whitney/wilcoxon/kruskal
-    [/friedman] — the MIN_*_DATA_POINTS config surface
-    (foremast-brain.yaml:74-79); a 3-wide vector keeps Friedman at its
-    MIN_FRIEDMAN default for callers that predate the fifth test.
+    min_points: (3,) gates for mann-whitney/wilcoxon/kruskal — the
+    MIN_*_DATA_POINTS config surface (foremast-brain.yaml:74-79).
     """
     if min_points is None:
-        min_points = jnp.asarray(
-            [MIN_MANN_WHITNEY, MIN_WILCOXON, MIN_KRUSKAL, MIN_FRIEDMAN]
-        )
-    friedman_gate = (
-        min_points[3] if min_points.shape[-1] >= 4 else MIN_FRIEDMAN
-    )
+        min_points = jnp.asarray([MIN_MANN_WHITNEY, MIN_WILCOXON, MIN_KRUSKAL])
     n_b = jnp.sum(b_mask.astype(_F))
     n_c = jnp.sum(c_mask.astype(_F))
     n_min = jnp.minimum(n_b, n_c)
 
     tests = two_sample_tests(baseline, b_mask, current, c_mask)
-    # Friedman over time blocks: each timestep with both sides valid is a
-    # block ranked across the 2 treatments (the paired-comparison member of
-    # the family, design.md:89-92)
-    paired_blocks = b_mask & c_mask
-    n_blocks = jnp.sum(paired_blocks.astype(_F))
-    _, p_friedman = friedman_chi_square(
-        jnp.stack([baseline, current], axis=-1), paired_blocks
-    )
     pvals = jnp.stack(
         [
             tests["mann_whitney"][1],
             tests["wilcoxon"][1],
             tests["kruskal"][1],
             tests["ks"][1],
-            p_friedman,
         ]
     )
 
@@ -111,11 +93,9 @@ def _pair_verdict(
             n_min >= min_points[1],
             n_min >= min_points[2],
             n_min >= 2,
-            n_blocks >= friedman_gate,
         ]
     )
-    bits = jnp.asarray([TEST_MANN_WHITNEY, TEST_WILCOXON, TEST_KRUSKAL,
-                        TEST_KS, TEST_FRIEDMAN])
+    bits = jnp.asarray([TEST_MANN_WHITNEY, TEST_WILCOXON, TEST_KRUSKAL, TEST_KS])
     enabled = ((test_mask & bits) > 0) & enough
     rejects = (pvals < pvalue_threshold) & enabled
     n_enabled = jnp.sum(enabled)
@@ -214,10 +194,7 @@ def make_fleet_scorer(mesh, k: int = 8):
         min_points = cfg.get(
             "min_points",
             jnp.tile(
-                jnp.asarray(
-                    [MIN_MANN_WHITNEY, MIN_WILCOXON, MIN_KRUSKAL, MIN_FRIEDMAN]
-                ),
-                (B, 1),
+                jnp.asarray([MIN_MANN_WHITNEY, MIN_WILCOXON, MIN_KRUSKAL]), (B, 1)
             ),
         )
         args = (

@@ -1,0 +1,65 @@
+"""Device mesh construction + sharding helpers.
+
+The reference scales by running N shared-nothing brain workers against an
+Elasticsearch queue (docs/guides/design.md:37-43). The TPU-native design
+replaces that with SPMD: one jitted program, batch ("fleet") axis sharded
+across every chip, XLA inserting ICI collectives for fleet-level reductions.
+Multi-pod scale-out extends the same mesh over DCN via jax.distributed
+(initialize() on each host) — the program does not change.
+
+Axes:
+  fleet — the (service x metric x window) batch axis; pure data parallelism,
+          zero communication except final reductions.
+  model — reserved for tensor-sharding the LSTM scorer's hidden dim when a
+          single scorer outgrows one chip (kept size 1 in the common case).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["fleet_mesh", "fleet_sharding", "replicated", "pad_to_multiple", "P"]
+
+FLEET_AXIS = "fleet"
+MODEL_AXIS = "model"
+
+
+def fleet_mesh(devices: Sequence[jax.Device] | None = None, model_parallel: int = 1) -> Mesh:
+    """(fleet, model) mesh over the given (default: all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    arr = np.array(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, (FLEET_AXIS, MODEL_AXIS))
+
+
+def fleet_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding: leading axis split across the fleet axis."""
+    return NamedSharding(mesh, P(FLEET_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arrs, multiple: int, batch_axis: int = 0):
+    """Right-pad every array's batch axis to a multiple (shardability).
+
+    Returns (padded_arrays, original_B). Pads with zeros — callers carry
+    masks, so padded rows score as fully-masked no-ops.
+    """
+    B = arrs[0].shape[batch_axis]
+    rem = B % multiple
+    if rem == 0:
+        return list(arrs), B
+    pad = multiple - rem
+    out = []
+    for a in arrs:
+        widths = [(0, 0)] * a.ndim
+        widths[batch_axis] = (0, pad)
+        out.append(np.pad(np.asarray(a), widths))
+    return out, B

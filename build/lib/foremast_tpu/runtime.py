@@ -1,0 +1,170 @@
+"""Composition root: one process = service + engine worker loop.
+
+The reference ran foremast-service (Go, HTTP :8099), foremast-brain (Python
+worker pool polling Elasticsearch), and the verdict /metrics exporter
+(:8000) as three deployments with ES between them (SURVEY.md §1 L3-L5). The
+TPU-native design collapses them into one process: the HTTP API writes into
+the in-process JobStore, worker cycles drain it through the batched TPU
+scorer, and the exporter serves foremastbrain:* from the same registry.
+
+Env surface (union of the reference services'):
+  ML_* family            engine knobs (engine/config.py, foremast-brain/README.md:22-38)
+  MAX_CACHE_SIZE         window-fetch LRU entries (foremast-brain/README.md:30)
+  QUERY_SERVICE_ENDPOINT metric-store base for the dashboard proxy
+                         (foremast-service/cmd/manager/main.go:301-309)
+  SNAPSHOT_PATH          job-store checkpoint file (ES's durability role)
+  ARCHIVE_PATH           JSONL write-behind archive of terminal jobs/hpalogs
+  ES_ENDPOINT            ES-compatible archive instead (reference indices
+                         documents/hpalogs); takes precedence over ARCHIVE_PATH
+  JOB_RETENTION_SECONDS  prune archived terminal jobs from RAM after this
+  PORT                   HTTP port (reference :8099)
+  CYCLE_SECONDS          engine cycle cadence (brain poll loop)
+  WAVEFRONT_PROXY        host[:port] of a Wavefront proxy to mirror the
+                         verdict series to (custom.iks.foremast.*)
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .dataplane.exporter import VerdictExporter
+from .dataplane.fetch import CachingDataSource, PrometheusDataSource
+from .engine.analyzer import Analyzer
+from .engine.config import EngineConfig, from_env
+from .engine.jobs import JobStore
+from .service.api import ForemastService, make_server
+
+__all__ = ["Runtime"]
+
+
+class Runtime:
+    def __init__(
+        self,
+        config: EngineConfig | None = None,
+        data_source=None,
+        snapshot_path: str | None = None,
+        query_endpoint: str = "",
+        cache: bool = True,
+        wavefront_sink=None,
+        archive=None,
+        job_retention_seconds: float = 24 * 3600.0,
+    ):
+        self.config = config or from_env()
+        source = data_source or PrometheusDataSource()
+        if cache:
+            source = CachingDataSource(source, max_entries=self.config.max_cache_size)
+        self.source = source
+        self.store = JobStore(snapshot_path=snapshot_path, archive=archive)
+        self.job_retention_seconds = job_retention_seconds
+        self.exporter = VerdictExporter()
+        self.analyzer = Analyzer(
+            self.config, self.source, self.store, exporter=self.exporter
+        )
+        self.service = ForemastService(
+            self.store, exporter=self.exporter, query_endpoint=query_endpoint
+        )
+        self.wavefront_sink = wavefront_sink
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._server = None
+
+    # -- lifecycle --
+    def start(self, host: str = "0.0.0.0", port: int = 8099,
+              cycle_seconds: float = 10.0, worker: str = "worker-0"):
+        """Start the HTTP server and the engine worker loop (background)."""
+        self._server = make_server(self.service, host, port)
+        t_http = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t_http.start()
+        t_eng = threading.Thread(
+            target=self._worker_loop, args=(cycle_seconds, worker), daemon=True
+        )
+        t_eng.start()
+        self._threads = [t_http, t_eng]
+        return self
+
+    def _worker_loop(self, cycle_seconds: float, worker: str):
+        while not self._stop.is_set():
+            t0 = time.time()
+            try:
+                self.analyzer.run_cycle(worker=worker)
+                if self.wavefront_sink is not None:
+                    self.wavefront_sink.flush()
+                self.store.gc(max_age_seconds=self.job_retention_seconds)
+            except Exception as e:  # noqa: BLE001 - worker must survive a bad cycle
+                print(f"[foremast-tpu] cycle error: {e}", flush=True)
+            self._stop.wait(max(0.0, cycle_seconds - (time.time() - t0)))
+
+    def stop(self):
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+        self.store.flush()
+
+    def run_forever(self, **kw):
+        self.start(**kw)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            self.stop()
+
+
+def _env_seconds(name: str, default: float) -> float:
+    """Tolerant env float: empty/malformed values fall back to the default
+    (a templated-empty var must not crashloop the pod)."""
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        print(f"[foremast-tpu] ignoring invalid {name}={raw!r}; "
+              f"using {default}", flush=True)
+        return default
+
+
+def main():
+    from .parallel.distributed import host_info, initialize
+
+    # multi-host (DCN) deploys join the jax.distributed world here; plain
+    # single-host deploys fall straight through
+    if initialize():
+        hi = host_info()
+        print(
+            f"[foremast-tpu] multi-host: process {hi.process_id}/"
+            f"{hi.num_processes}, {hi.local_devices} local / "
+            f"{hi.global_devices} global devices",
+            flush=True,
+        )
+    archive = None
+    es = os.environ.get("ES_ENDPOINT", "")
+    archive_path = os.environ.get("ARCHIVE_PATH", "")
+    if es:
+        from .engine.archive import EsArchive
+
+        archive = EsArchive(es)
+    elif archive_path:
+        from .engine.archive import FileArchive
+
+        archive = FileArchive(archive_path)
+    rt = Runtime(
+        snapshot_path=os.environ.get("SNAPSHOT_PATH") or None,
+        query_endpoint=os.environ.get("QUERY_SERVICE_ENDPOINT", ""),
+        archive=archive,
+        job_retention_seconds=_env_seconds("JOB_RETENTION_SECONDS", 24 * 3600.0),
+    )
+    proxy = os.environ.get("WAVEFRONT_PROXY", "")
+    if proxy:
+        from .dataplane.wavefront_sink import WavefrontSink
+
+        host, _, port = proxy.partition(":")
+        rt.wavefront_sink = WavefrontSink(
+            rt.exporter, host=host, port=int(port or 2878)
+        )
+    port = int(os.environ.get("PORT", "8099"))
+    cycle = float(os.environ.get("CYCLE_SECONDS", "10"))
+    print(f"[foremast-tpu] serving :{port}, cycle={cycle}s", flush=True)
+    rt.run_forever(port=port, cycle_seconds=cycle)
+
+
+if __name__ == "__main__":
+    main()

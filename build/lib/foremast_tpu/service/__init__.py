@@ -1,0 +1,2 @@
+"""HTTP job API (foremast-service contract)."""
+from .api import ApiError, ForemastService, build_document, make_server, serve_background  # noqa: F401

@@ -1,0 +1,406 @@
+"""HTTP job API — the contract of foremast-service, stdlib-only.
+
+Endpoints (reference: foremast-service/cmd/manager/main.go:326-346):
+  POST /v1/healthcheck/create          submit an analysis job
+  GET  /v1/healthcheck/id/<jobId>      job status + hpa logs
+  GET  /alert/<app>/<namespace>/<strategy>   recent HPA logs for the app
+  GET  /api/v1/<queryproxy>?...        CORS proxy to the metric store
+  GET  /metrics                        foremastbrain:* verdict series
+  GET  /healthz                        liveness
+
+Behavior contracts preserved:
+  * job ids — HMAC-SHA256 over the canonical request; HPA jobs get the
+    deterministic "app:namespace:hpa" id (elasticsearchstore.go:31-33,
+    stringutils.go:11-17).
+  * dedupe-or-create on id (elasticsearchstore.go:24-92).
+  * hpa/continuous jobs swap start/end for START_TIME/END_TIME placeholders
+    so windows re-materialize each cycle (main.go:59-63).
+  * status mapping internal -> external (converter.go:10-29) via
+    engine.jobs.to_external.
+  * appName validation: non-empty, sane charset (main.go:152-162).
+
+The reference split service (Go) from brain (Python) across an ES hop; here
+the API writes straight into the in-process JobStore the engine workers
+drain — one process, zero queue hops. The store stays pluggable for an
+external archive.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..dataplane.exporter import VerdictExporter
+from ..dataplane.promql import (
+    CONTINUOUS_STRATEGIES,
+    END_PLACEHOLDER,
+    START_PLACEHOLDER,
+    placeholderize,
+    prometheus_range_url,
+    wavefront_url,
+)
+from ..engine import jobs as J
+from ..engine.jobs import Document, JobStore, MetricQueries
+from ..utils.ids import hmac_job_id, hpa_job_id
+
+_APP_RE = re.compile(r"^[A-Za-z0-9_.-]{1,253}$")
+_METRIC_RE = re.compile(r"^[A-Za-z0-9_:.-]{1,200}$")
+
+VALID_STRATEGIES = {"rollingUpdate", "canary", "continuous", "hpa", "rollover"}
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _category_url(entry: dict, strategy: str) -> str:
+    """One MetricQuery wire object -> concrete query URL.
+
+    Accepts {"url": "..."} directly, or the reference's
+    {dataSourceType, parameters: {endpoint?, query, start, end, step}} shape
+    (constructURL dispatch, main.go:34-48).
+    """
+    if not entry:
+        return ""
+    if not isinstance(entry, dict):
+        raise ApiError(400, f"metric entry must be an object, got {type(entry).__name__}")
+    if entry.get("url"):
+        url = entry["url"]
+    else:
+        params = entry.get("parameters", {})
+        if not isinstance(params, dict):
+            raise ApiError(400, "metric 'parameters' must be an object")
+        query = params.get("query", "")
+        if not query:
+            return ""
+        endpoint = params.get("endpoint", "http://prometheus:9090/api/v1/")
+        start = params.get("start", 0)
+        end = params.get("end", 0)
+        try:
+            step = int(params.get("step", 60))
+        except (TypeError, ValueError):
+            raise ApiError(400, f"invalid step {params.get('step')!r}") from None
+        if entry.get("dataSourceType") == "wavefront":
+            url = wavefront_url(endpoint, query, start, end, step)
+        else:
+            url = prometheus_range_url(endpoint, query, start, end, step)
+    return url
+
+
+def build_document(req: dict) -> Document:
+    """Validate + convert a create request into a job Document."""
+    app = req.get("appName", "")
+    if not app or not _APP_RE.match(app):
+        raise ApiError(400, f"invalid appName {app!r}")
+    strategy = req.get("strategy", "rollingUpdate")
+    if strategy not in VALID_STRATEGIES:
+        raise ApiError(400, f"invalid strategy {strategy!r}")
+    namespace = req.get("namespace", "default")
+    info = req.get("metricsInfo", {})
+    current = info.get("current", {})
+    baseline = info.get("baseline", {})
+    historical = info.get("historical", {})
+    if not current and strategy != "hpa":
+        raise ApiError(400, "metricsInfo.current is required")
+
+    continuous = strategy in CONTINUOUS_STRATEGIES
+    metrics: dict[str, MetricQueries] = {}
+    # sorted: set iteration is hash-randomized across processes, and the
+    # HPA tps/sla selection tie-breaks on insertion order — scores must not
+    # change across a restart
+    for name in sorted(set(current) | set(baseline) | set(historical)):
+        if not _METRIC_RE.match(name):
+            raise ApiError(400, f"invalid metric name {name!r}")
+        cur_e = current.get(name, {})
+        cur = _category_url(cur_e, strategy)
+        base = _category_url(baseline.get(name, {}), strategy)
+        hist = _category_url(historical.get(name, {}), strategy)
+        if continuous:
+            cur = placeholderize(cur, historical=False)
+            base = ""
+            hist = placeholderize(hist, historical=True)
+        # hpa flags may ride whichever category carries the metric
+        flags = cur_e or baseline.get(name, {}) or historical.get(name, {})
+        try:
+            priority = int(flags.get("priority", 0))
+        except (TypeError, ValueError):
+            raise ApiError(
+                400, f"invalid priority {flags.get('priority')!r} for {name}"
+            ) from None
+        metrics[name] = MetricQueries(
+            current=cur,
+            baseline=base,
+            historical=hist,
+            priority=priority,
+            is_increase=bool(flags.get("isIncrease", True)),
+            is_absolute=bool(flags.get("isAbsolute", False)),
+        )
+
+    start_time = req.get("startTime", "")
+    end_time = req.get("endTime", "")
+    if continuous:
+        start_time, end_time = START_PLACEHOLDER, END_PLACEHOLDER
+
+    if strategy == "hpa":
+        job_id = hpa_job_id(app, namespace)
+    else:
+        job_id = hmac_job_id(
+            {
+                "appName": app,
+                "namespace": namespace,
+                "strategy": strategy,
+                "startTime": start_time,
+                "endTime": end_time,
+                "metrics": {
+                    k: [v.current, v.baseline, v.historical] for k, v in sorted(metrics.items())
+                },
+            }
+        )
+    return Document(
+        id=job_id,
+        app_name=app,
+        namespace=namespace,
+        strategy=strategy,
+        start_time=start_time,
+        end_time=end_time,
+        metrics=metrics,
+        pod_count_url=req.get("podCountURL", ""),
+    )
+
+
+class ForemastService:
+    """Route handlers over the shared store/exporter."""
+
+    def __init__(self, store: JobStore, exporter: VerdictExporter | None = None,
+                 query_endpoint: str = ""):
+        self.store = store
+        self.exporter = exporter or VerdictExporter()
+        self.query_endpoint = query_endpoint  # metric-store base for the proxy
+
+    # -- handlers, each returns (status, payload-dict | text) --
+    def create(self, body: dict):
+        doc = build_document(body)
+        doc, created = self.store.create(doc)
+        return 200, {"jobId": doc.id, "status": J.to_external(doc.status)}
+
+    def status(self, job_id: str):
+        doc = self.store.get(job_id)
+        if doc is None:
+            # a terminal job may have been gc'd from RAM after archival:
+            # the id must stay resolvable as long as /search returns it
+            archive = getattr(self.store, "archive", None)
+            rec = archive.get(job_id) if archive is not None else None
+            if rec is None:
+                return 404, {"error": f"job {job_id} not found"}
+            return 200, {
+                "jobId": rec.get("id", job_id),
+                "appName": rec.get("app_name", ""),
+                "namespace": rec.get("namespace", ""),
+                "strategy": rec.get("strategy", ""),
+                "status": J.to_external(rec.get("status", "")),
+                "statusCode": "200",
+                "reason": rec.get("reason", ""),
+                "anomaly": rec.get("anomaly", {}),
+                "hpalogs": [],
+            }
+        logs = self.store.hpalogs_for(job_id)
+        return 200, {
+            "jobId": doc.id,
+            "appName": doc.app_name,
+            "namespace": doc.namespace,
+            "strategy": doc.strategy,
+            "status": J.to_external(doc.status),
+            "statusCode": "200",
+            "reason": doc.reason,
+            "anomaly": doc.anomaly,
+            "hpalogs": [
+                {
+                    "job_id": l.job_id,
+                    "hpascore": l.hpascore,
+                    "reason": l.reason,
+                    "details": l.details,
+                    "timestamp": l.timestamp,
+                }
+                for l in logs
+            ],
+        }
+
+    def alert(self, app: str, namespace: str, strategy: str):
+        job_id = hpa_job_id(app, namespace)
+        logs = self.store.hpalogs_for(job_id)
+        return 200, {
+            "appName": app,
+            "namespace": namespace,
+            "strategy": strategy,
+            "hpalogs": [
+                {"hpascore": l.hpascore, "reason": l.reason, "details": l.details,
+                 "timestamp": l.timestamp}
+                for l in logs
+            ],
+        }
+
+    def query_proxy(self, path_and_query: str):
+        if not self.query_endpoint:
+            return 502, {"error": "no query endpoint configured"}
+        url = self.query_endpoint.rstrip("/") + "/" + path_and_query.lstrip("/")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return 200, r.read().decode()
+        except Exception as e:  # noqa: BLE001 - proxy boundary
+            return 502, {"error": f"query proxy failed: {e}"}
+
+    def search(self, params: dict):
+        """GET /v1/healthcheck/search — the job-audit surface ES/Kibana
+        provided in the reference (design.md:49-51 there): live store plus
+        the write-behind archive, filterable by app/namespace/status/
+        strategy. `status` accepts internal or external names."""
+        def one(key):
+            v = params.get(key, [""])[0]
+            return v or None
+
+        status = one("status")
+        statuses = None
+        if status:
+            # accept internal names and external aliases; an external name
+            # ("abort") fans out to every internal it covers
+            statuses = [k for k, v in J.EXTERNAL_STATUS.items()
+                        if k == status or v == status]
+            if not statuses:
+                raise ApiError(400, f"unknown status {status!r}")
+        try:
+            limit = int(params.get("limit", ["50"])[0])
+        except ValueError:
+            raise ApiError(400, "invalid limit") from None
+        if not 1 <= limit <= 500:
+            raise ApiError(400, f"limit must be in [1, 500], got {limit}")
+        out = [
+            {
+                "jobId": rec.get("id", ""),
+                "appName": rec.get("app_name", ""),
+                "namespace": rec.get("namespace", ""),
+                "strategy": rec.get("strategy", ""),
+                "status": J.to_external(rec.get("status", "")),
+                "internalStatus": rec.get("status", ""),
+                "reason": rec.get("reason", ""),
+                "modifiedAt": rec.get("modified_at", 0.0),
+            }
+            for rec in self.store.search(
+                app=one("appName"), namespace=one("namespace"),
+                status=statuses, strategy=one("strategy"), limit=limit,
+            )
+        ]
+        return 200, {"jobs": out}
+
+    def metrics(self):
+        from ..utils.tracing import tracer
+
+        # verdict series + host-side span aggregates in one scrape
+        return 200, self.exporter.render() + tracer.render_metrics()
+
+    def debug_traces(self, limit: int = 50):
+        from ..utils.tracing import tracer
+
+        return 200, {"traces": tracer.snapshot(limit), "stats": tracer.stats()}
+
+    def dashboard(self):
+        try:
+            from ..dashboard import index_html
+
+            return 200, index_html()
+        except OSError as e:
+            return 500, {"error": f"dashboard assets unavailable: {e}"}
+
+
+def make_server(service: ForemastService, host: str = "0.0.0.0", port: int = 8099):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _send(self, status: int, payload, content_type=None):
+            body = (
+                payload.encode()
+                if isinstance(payload, str)
+                else json.dumps(payload).encode()
+            )
+            ct = content_type or (
+                "text/plain; charset=utf-8"
+                if isinstance(payload, str)
+                else "application/json"
+            )
+            self.send_response(status)
+            self.send_header("Content-Type", ct)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            parts = [p for p in parsed.path.split("/") if p]
+            try:
+                if parsed.path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif parsed.path in ("/", "/dashboard") or parsed.path.startswith(
+                    "/dashboard/"
+                ):
+                    status, payload = service.dashboard()
+                    ct = "text/html; charset=utf-8" if status == 200 else None
+                    self._send(status, payload, content_type=ct)
+                elif parsed.path == "/metrics":
+                    self._send(*service.metrics())
+                elif parsed.path == "/debug/traces":
+                    q = parse_qs(parsed.query)
+                    try:
+                        limit = int(q.get("limit", ["50"])[0])
+                    except ValueError:
+                        limit = 50
+                    self._send(*service.debug_traces(limit))
+                elif parts == ["v1", "healthcheck", "search"]:
+                    self._send(*service.search(parse_qs(parsed.query)))
+                elif parts[:3] == ["v1", "healthcheck", "id"] and len(parts) == 4:
+                    self._send(*service.status(parts[3]))
+                elif parts[:1] == ["alert"] and len(parts) == 4:
+                    self._send(*service.alert(parts[1], parts[2], parts[3]))
+                elif parts[:2] == ["api", "v1"]:
+                    rest = "/".join(parts[2:])
+                    if parsed.query:
+                        rest += "?" + parsed.query
+                    self._send(*service.query_proxy(rest))
+                else:
+                    self._send(404, {"error": "not found"})
+            except ApiError as e:
+                self._send(e.status, {"error": e.message})
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": str(e)})
+
+        def do_POST(self):
+            parsed = urlparse(self.path)
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if parsed.path == "/v1/healthcheck/create":
+                    self._send(*service.create(body))
+                else:
+                    self._send(404, {"error": "not found"})
+            except ApiError as e:
+                self._send(e.status, {"error": e.message})
+            except json.JSONDecodeError:
+                self._send(400, {"error": "invalid JSON body"})
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": str(e)})
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    return server
+
+
+def serve_background(service: ForemastService, host="127.0.0.1", port=8099):
+    server = make_server(service, host, port)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

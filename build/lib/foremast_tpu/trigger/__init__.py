@@ -1,0 +1,20 @@
+"""Standalone (non-K8s) trigger: poll metrics sources, drive analyses.
+
+The TPU-native foremast-trigger (SURVEY.md §2.3): reads a requests file of
+service/metric/query tuples, keeps a rollover analysis job per service
+against the job API, records anomalies to daily TSV reports with deep-link
+dashboard URLs, and produces daily summary reports.
+"""
+from .trigger import (
+    JobInfo,
+    TriggerService,
+    parse_requests_file,
+    parse_requests_lines,
+)
+
+__all__ = [
+    "TriggerService",
+    "JobInfo",
+    "parse_requests_file",
+    "parse_requests_lines",
+]

@@ -1,0 +1,256 @@
+"""Trigger loop: requests file -> perpetual rollover analyses -> reports.
+
+Re-derives foremast-trigger (SURVEY.md §2.3, §3.5) as one single-threaded
+scheduler instead of a goroutine per service:
+
+  * requests file — `app;metric;query[;metric;query...]` lines
+    (foremast-trigger/cmd/manager/main.go:65-78).
+  * rollover request — current = [now-5m, now-5m+30m], historical = baseline
+    = trailing 7 days, wavefront source with millisecond timestamps
+    (trigger.go:219-288).
+  * poll loop — Healthy -> resubmit; Unhealthy -> TSV anomaly record
+    (timestamp, service, jobId, reason, dashboardURL) in a daily file +
+    resubmit; Abort/Warning -> resubmit; else keep waiting
+    (trigger.go:330-380).
+  * dashboard URL — metric + anomaly timestamp extracted from the verdict
+    reason; shifted 15 min back for chart context (trigger.go:290-327). The
+    reference regexed the brain's HTML-escaped JSON reason; this engine's
+    reasons are plain text ("anomaly detected on <metric> :: ... from ts
+    <unix>"), so the extraction matches that shape.
+  * daily summary — per service/metric anomaly counts over the last day,
+    queried from the `custom.iks.foremast.<metric>_anomaly` mirror series
+    (trigger.go:107-216).
+"""
+from __future__ import annotations
+
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+from ..dataplane.wavefront_sink import mirror_name
+from ..utils.timeutils import to_rfc3339
+
+_REASON_METRIC = re.compile(r"anomaly detected on ([\w.:-]+)")
+_REASON_TS = re.compile(r"from ts (\d+)")
+
+
+def parse_requests_lines(lines) -> list[tuple[str, dict]]:
+    """`app;metric;query[;metric;query...]` -> [(app, {metric: query})]."""
+    out = []
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        values = line.split(";")
+        # pairwise walk: values[1::2] metric names, values[2::2] queries
+        metric_map = {
+            values[i]: values[i + 1] for i in range(1, len(values) - 1, 2)
+        }
+        out.append((values[0], metric_map))
+    return out
+
+
+def parse_requests_file(path: str) -> list[tuple[str, dict]]:
+    with open(path) as f:
+        return parse_requests_lines(f)
+
+
+@dataclass
+class JobInfo:
+    metric_map: dict
+    job_id: str = ""
+    submitted_at: float = 0.0
+
+
+@dataclass
+class TriggerService:
+    """Keeps one rolling analysis job per service."""
+
+    analyst: object  # start_analyzing/get_status (operator.analyst protocol)
+    wavefront_endpoint: str = ""
+    volume_path: str = "."
+    window_minutes: int = 30
+    anomaly_counter: object | None = None  # callable(metric, start, end) -> int
+    jobs: dict = field(default_factory=dict)  # app -> JobInfo
+    # structured in-memory mirror of the TSV rows:
+    # {"ts", "app", "job_id", "metric", "reason", "row"}
+    anomalies: list = field(default_factory=list)
+
+    # ------------------------------------------------------------- requests
+    def build_request(self, app: str, metric_map: dict, now: float) -> dict:
+        start = int(now) - 60 * 5
+        end = start + 60 * self.window_minutes
+        week = 7 * 24 * 60 * 60
+        info = {"current": {}, "baseline": {}, "historical": {}}
+        for name, query in metric_map.items():
+            cur = {
+                "dataSourceType": "wavefront",
+                "parameters": {
+                    "query": query,
+                    "endpoint": self.wavefront_endpoint,
+                    "start": start * 1000,
+                    "end": end * 1000,
+                    "step": 60,
+                },
+            }
+            hist = {
+                "dataSourceType": "wavefront",
+                "parameters": {
+                    "query": query,
+                    "endpoint": self.wavefront_endpoint,
+                    "start": (start - week) * 1000,
+                    "end": start * 1000,
+                    "step": 60,
+                },
+            }
+            info["current"][name] = cur
+            info["historical"][name] = hist
+            info["baseline"][name] = dict(hist)
+        return {
+            "appName": app,
+            "strategy": "rollover",
+            "startTime": to_rfc3339(now),
+            "endTime": to_rfc3339(now + 60 * 5),
+            "metricsInfo": info,
+        }
+
+    def submit(self, app: str, metric_map: dict, now: float | None = None) -> bool:
+        from ..operator.analyst import AnalystError
+
+        now = time.time() if now is None else now
+        try:
+            job_id = self.analyst.start_analyzing(self.build_request(app, metric_map, now))
+        except AnalystError:
+            return False
+        self.jobs[app] = JobInfo(metric_map=metric_map, job_id=job_id, submitted_at=now)
+        return True
+
+    def start(self, requests: list[tuple[str, dict]], now: float | None = None):
+        for app, metric_map in requests:
+            self.submit(app, metric_map, now)
+
+    # ------------------------------------------------------------- polling
+    def poll_once(self, now: float | None = None) -> dict:
+        """One status sweep. Returns {app: phase} for resolved jobs."""
+        from ..operator.analyst import AnalystError
+
+        now = time.time() if now is None else now
+        resolved = {}
+        for app, info in list(self.jobs.items()):
+            try:
+                resp = self.analyst.get_status(info.job_id)
+            except AnalystError:
+                continue
+            if resp.phase == "Healthy":
+                resolved[app] = resp.phase
+                self.submit(app, info.metric_map, now)
+            elif resp.phase == "Unhealthy":
+                resolved[app] = resp.phase
+                self.record_anomaly(app, info, resp.reason, now)
+                self.submit(app, info.metric_map, now)
+            elif resp.phase in ("Abort", "Warning"):
+                resolved[app] = resp.phase
+                self.submit(app, info.metric_map, now)
+            # Running: wait for the next poll
+        return resolved
+
+    # ------------------------------------------------------------- reports
+    def _daily_path(self, prefix: str, now: float) -> str:
+        day = time.strftime("%Y-%B-%-d", time.localtime(now))
+        return os.path.join(self.volume_path, f"{prefix}_{day}.tsv")
+
+    def record_anomaly(self, app: str, info: JobInfo, reason: str, now: float):
+        url = self.dashboard_url(app, info.metric_map, reason)
+        m = _REASON_METRIC.search(reason or "")
+        row = f"{to_rfc3339(now)}\t{app}\t{info.job_id}\t{reason}\t{url}\n"
+        self.anomalies.append(
+            {
+                "ts": now,
+                "app": app,
+                "job_id": info.job_id,
+                "metric": m.group(1) if m else "",
+                "reason": reason,
+                "row": row,
+            }
+        )
+        path = self._daily_path("anomaly", now)
+        os.makedirs(self.volume_path, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(row)
+
+    def dashboard_url(self, app: str, metric_map: dict, reason: str) -> str:
+        """Deep link to a chart of metric + bounds + anomaly markers.
+
+        Series names go through mirror_name() so links track exactly what
+        the Wavefront sink emits (exporter sanitization + rename)."""
+        base = self.wavefront_endpoint or ""
+        m = _REASON_METRIC.search(reason or "")
+        t = _REASON_TS.search(reason or "")
+        if not m:
+            return f"{base}/dashboard/Foremast"
+        metric = m.group(1)
+        ts = int(t.group(1)) - 60 * 15 if t else int(time.time()) - 60 * 15
+        base_series = mirror_name(metric, "anomaly")[: -len("_anomaly")]
+        query = metric_map.get(metric, metric_map.get(metric.lower(), ""))
+        return (
+            f"{base}/chart#app={app}&metric={base_series}"
+            f"&upper={base_series}_upper&lower={base_series}_lower"
+            f"&anomaly={base_series}_anomaly&q={query}&t={ts}&w=2h"
+        )
+
+    def summary_report(self, requests: list[tuple[str, dict]],
+                       now: float | None = None) -> str:
+        """Daily per-service anomaly-count table; also written to disk."""
+        now = time.time() if now is None else now
+        day_ago = now - 86400
+        lines = ["service\tmetric\tanomaly_count"]
+        for app, metric_map in requests:
+            for metric in metric_map:
+                if self.anomaly_counter is not None:
+                    count = int(
+                        self.anomaly_counter(mirror_name(metric, "anomaly"), day_ago, now)
+                    )
+                else:
+                    count = sum(
+                        1 for a in self.anomalies
+                        if a["app"] == app and a["metric"] == metric
+                        and a["ts"] >= day_ago
+                    )
+                lines.append(f"{app}\t{metric}\t{count}")
+        report = "\n".join(lines) + "\n"
+        os.makedirs(self.volume_path, exist_ok=True)
+        with open(self._daily_path("report", now), "w") as f:
+            f.write(report)
+        return report
+
+    # ------------------------------------------------------------- lifecycle
+    def run_forever(self, requests: list[tuple[str, dict]],
+                    poll_seconds: float = 10.0, report_seconds: float = 86400.0):
+        self.start(requests)
+        self.summary_report(requests)
+        last_report = time.time()
+        while True:
+            t0 = time.time()
+            self.poll_once()
+            if time.time() - last_report >= report_seconds:
+                self.summary_report(requests)
+                last_report = time.time()
+            time.sleep(max(0.0, poll_seconds - (time.time() - t0)))
+
+
+def main():
+    from ..operator.analyst import HttpAnalyst
+
+    requests_file = os.environ.get("REQUESTS_FILE", "requests.csv")
+    endpoint = os.environ.get("FOREMAST_ENDPOINT", "http://127.0.0.1:8099")
+    svc = TriggerService(
+        analyst=HttpAnalyst(endpoint),
+        wavefront_endpoint=os.environ.get("WAVEFRONT_ENDPOINT", ""),
+        volume_path=os.environ.get("VOLUME_PATH", "."),
+    )
+    svc.run_forever(parse_requests_file(requests_file))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""Small shared helpers: deterministic ids, time parsing."""
+from .ids import hmac_job_id, hpa_job_id  # noqa: F401
+from .timeutils import from_rfc3339, to_rfc3339  # noqa: F401

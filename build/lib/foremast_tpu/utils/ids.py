@@ -1,0 +1,26 @@
+"""Deterministic job ids.
+
+Mirrors the reference service's id scheme (behavior, not code):
+  * normal jobs — an HMAC-SHA256 digest over the canonicalized request, so
+    identical requests dedupe to the same job
+    (foremast-service/pkg/common/stringutils.go:11-17).
+  * HPA jobs — the stable composite "app:namespace:hpa" so each app has
+    exactly one continuously-rearmed HPA job
+    (foremast-service/pkg/search/elasticsearchstore.go:31-33).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+
+_KEY = b"foremast-tpu"
+
+
+def hmac_job_id(payload: dict) -> str:
+    canon = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hmac.new(_KEY, canon.encode(), hashlib.sha256).hexdigest()
+
+
+def hpa_job_id(app_name: str, namespace: str) -> str:
+    return f"{app_name}:{namespace}:hpa"

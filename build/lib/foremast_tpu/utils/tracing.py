@@ -1,0 +1,139 @@
+"""Lightweight span tracing for the engine's hot loops.
+
+The reference implements no tracing at all (SURVEY.md §5: Jaeger is
+name-dropped in its README, nothing consumes traces). This module gives
+the runtime an always-on, zero-dependency tracer:
+
+  * `span("fetch", url=...)` context manager records wall-time spans with
+    attributes; spans nest (thread-local stack) into one trace tree per
+    top-level span.
+  * finished traces land in a bounded ring buffer; `snapshot()` returns
+    recent traces as plain dicts (served at /debug/traces by the service).
+  * per-name aggregate stats (count, total, max) for cheap hot-loop
+    dashboards, rendered as Prometheus gauges via `render_metrics()` under
+    `foremast_trace_*`.
+  * inside jit nothing can be timed from Python — device work is traced by
+    XLA itself; `span` additionally emits a `jax.profiler.TraceAnnotation`
+    so host spans line up with device timelines when a profiler is
+    attached.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+try:  # resolved once: per-span import lookups would tax every hot loop
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover - jax always present in this build
+    _TraceAnnotation = None
+
+__all__ = ["Tracer", "tracer", "span"]
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "start", "end", "children")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.start = time.time()
+        self.end = 0.0
+        self.children: list[_Span] = []
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "start": self.start,
+            "duration_ms": round((self.end - self.start) * 1000.0, 3),
+        }
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class Tracer:
+    """Thread-safe tracer with a bounded ring of finished root traces."""
+
+    def __init__(self, max_traces: int = 256):
+        self.max_traces = max_traces
+        self._lock = threading.Lock()
+        self._traces: list[dict] = []
+        self._stats: dict[str, list] = {}  # name -> [count, total_s, max_s]
+        self._local = threading.local()
+
+    # -- recording --
+    @contextmanager
+    def span(self, name: str, **attrs):
+        s = _Span(name, attrs)
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        parent = stack[-1] if stack else None
+        stack.append(s)
+        try:
+            ann = None
+            if _TraceAnnotation is not None:
+                try:
+                    ann = _TraceAnnotation(name)
+                    ann.__enter__()
+                except Exception:  # profiler unavailable: host-side only
+                    ann = None
+            try:
+                yield s
+            finally:
+                if ann is not None:
+                    ann.__exit__(None, None, None)
+        finally:
+            s.end = time.time()
+            stack.pop()
+            if parent is not None:
+                parent.children.append(s)
+            else:
+                self._finish_root(s)
+            dur = s.end - s.start
+            with self._lock:
+                st = self._stats.setdefault(name, [0, 0.0, 0.0])
+                st[0] += 1
+                st[1] += dur
+                st[2] = max(st[2], dur)
+
+    def _finish_root(self, s: _Span):
+        with self._lock:
+            self._traces.append(s.to_dict())
+            if len(self._traces) > self.max_traces:
+                del self._traces[: len(self._traces) - self.max_traces]
+
+    # -- reading --
+    def snapshot(self, limit: int = 50) -> list[dict]:
+        with self._lock:
+            return list(self._traces[-limit:])
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                name: {"count": c, "total_seconds": round(t, 6),
+                       "max_seconds": round(mx, 6)}
+                for name, (c, t, mx) in sorted(self._stats.items())
+            }
+
+    def render_metrics(self) -> str:
+        """Prometheus text lines (joined into the exporter's /metrics)."""
+        lines = []
+        for name, st in self.stats().items():
+            tag = f'{{span="{name}"}}'
+            lines.append(f"foremast_trace_count{tag} {st['count']}")
+            lines.append(f"foremast_trace_seconds_total{tag} {st['total_seconds']}")
+            lines.append(f"foremast_trace_seconds_max{tag} {st['max_seconds']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self):
+        with self._lock:
+            self._traces.clear()
+            self._stats.clear()
+
+
+tracer = Tracer()  # process-wide default
+span = tracer.span

@@ -95,3 +95,48 @@ def test_fleet_summary_standalone():
     assert int(total) == 3
     got = [int(i) for i, v in zip(np.asarray(ti), np.asarray(tv)) if v > -np.inf]
     assert got == [17, 42, 3]  # severity-descending
+
+
+def test_friedman_bit_in_fused_verdict():
+    """ML_PAIRWISE_ALGORITHM=friedman drives the verdict through the paired
+    Friedman member of the family (design.md:89-92)."""
+    import numpy as np
+
+    from foremast_tpu.engine.config import EngineConfig
+    from foremast_tpu.parallel import fleet as fl
+
+    assert EngineConfig(pairwise_algorithm="friedman_all").enabled_tests() \
+        == fl.TEST_FRIEDMAN
+    assert EngineConfig(pairwise_algorithm="all").enabled_tests() & fl.TEST_FRIEDMAN
+
+    rng = np.random.default_rng(0)
+    B, T = 4, 64
+    baseline = rng.normal(10.0, 1.0, (B, T)).astype(np.float32)
+    # rows 0,1: current consistently above baseline; rows 2,3: same dist
+    current = baseline + np.array([3.0, 3.0, 0.0, 0.0])[:, None] \
+        + rng.normal(0, 0.2, (B, T)).astype(np.float32)
+    masks = np.ones((B, T), bool)
+    out = fl.score_pairs(
+        baseline, masks, current.astype(np.float32), masks,
+        np.full(B, 0.01, np.float32),
+        np.full(B, fl.TEST_FRIEDMAN, np.int32),
+        np.zeros(B, np.int32),
+        np.full(B, 10, np.int32),
+        np.full(B, 30.0, np.float32),  # very wide band: pairwise decides
+        np.zeros(B, np.int32),
+        np.zeros(B, np.float32),
+        np.tile(np.asarray([20, 20, 5], np.int32), (B, 1)),
+    )
+    pw = np.asarray(out["pairwise_unhealthy"])
+    assert pw.tolist() == [True, True, False, False]
+    # too few paired blocks -> friedman gated out, healthy by default
+    few = np.zeros((1, T), bool)
+    few[:, :3] = True
+    out2 = fl.score_pairs(
+        baseline[:1], few, current[:1].astype(np.float32), few,
+        np.full(1, 0.01, np.float32), np.full(1, fl.TEST_FRIEDMAN, np.int32),
+        np.zeros(1, np.int32), np.full(1, 10, np.int32),
+        np.full(1, 30.0, np.float32), np.zeros(1, np.int32),
+        np.zeros(1, np.float32), np.tile(np.asarray([20, 20, 5], np.int32), (1, 1)),
+    )
+    assert not bool(np.asarray(out2["pairwise_unhealthy"])[0])
