@@ -292,6 +292,7 @@ class Analyzer:
                             cfg.min_mann_whitney_points,
                             cfg.min_wilcoxon_points,
                             cfg.min_kruskal_points,
+                            cfg.min_friedman_points,
                         ],
                         np.int32,
                     ),

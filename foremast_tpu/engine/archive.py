@@ -91,20 +91,38 @@ class FileArchive:
 
     # -- reading --
     def _iter_records(self):
-        with self._lock:
-            paths = [self.path + ".1", self.path]
-            lines = []
-            for p in paths:
+        # Lock-free streaming scan: rotation swaps files with atomic
+        # os.replace and a torn tail line from a concurrent append fails
+        # JSON decode and is skipped, so readers don't take the write lock
+        # (holding it here blocked index_job for the whole scan — up to two
+        # 64 MB generations per /search call). A rotation *during* the scan
+        # could make a whole generation invisible (the current file becomes
+        # ".1" after we already read the old ".1"), so detect it by inode
+        # change and rescan; consumers are last-write-wins per id, so
+        # re-delivered records are harmless. On Windows the rotation itself
+        # can fail (os.replace on a reader-held file) — it is simply retried
+        # by the next append once reads quiesce.
+        for _attempt in range(3):
+            ino_before = self._current_inode()
+            for p in (self.path + ".1", self.path):
                 try:
-                    with open(p) as f:
-                        lines += f.readlines()
+                    f = open(p)
                 except OSError:
                     continue
-        for line in lines:
-            try:
-                yield json.loads(line)
-            except json.JSONDecodeError:
-                continue  # torn tail write after a crash
+                with f:
+                    for line in f:
+                        try:
+                            yield json.loads(line)
+                        except json.JSONDecodeError:
+                            continue  # torn tail write after a crash
+            if self._current_inode() == ino_before:
+                return
+
+    def _current_inode(self):
+        try:
+            return os.stat(self.path).st_ino
+        except OSError:
+            return None
 
     def search(self, app=None, namespace=None, status=None, strategy=None,
                limit: int = 50) -> list[dict]:

@@ -46,6 +46,7 @@ class EngineConfig:
     min_mann_whitney_points: int = 20  # MIN_MANN_WHITE_DATA_POINTS
     min_wilcoxon_points: int = 20  # MIN_WILCOXON_DATA_POINTS
     min_kruskal_points: int = 5  # MIN_KRUSKAL_DATA_POINTS
+    min_friedman_points: int = 5  # MIN_FRIEDMAN_DATA_POINTS (paired blocks)
     max_stuck_seconds: float = 90.0  # MAX_STUCK_IN_SECONDS
     max_cache_size: int = 1024  # MAX_CACHE_SIZE (model/window cache entries)
     ma_window: int = 30  # moving-average lookback (steps)
@@ -155,6 +156,7 @@ def from_env(env=None) -> EngineConfig:
         min_mann_whitney_points=_env_int(env, "MIN_MANN_WHITE_DATA_POINTS", 20),
         min_wilcoxon_points=_env_int(env, "MIN_WILCOXON_DATA_POINTS", 20),
         min_kruskal_points=_env_int(env, "MIN_KRUSKAL_DATA_POINTS", 5),
+        min_friedman_points=_env_int(env, "MIN_FRIEDMAN_DATA_POINTS", 5),
         max_stuck_seconds=_env_float(env, "MAX_STUCK_IN_SECONDS", 90.0),
         max_cache_size=_env_int(env, "MAX_CACHE_SIZE", 1024),
         ma_window=_env_int(env, "MA_WINDOW", 30),

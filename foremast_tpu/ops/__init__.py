@@ -6,6 +6,7 @@ from .pairwise import (  # noqa: F401
     kruskal_wallis,
     ks_2samp,
     mann_whitney_u,
+    sign_test_exact,
     two_sample_tests,
     wilcoxon_signed_rank,
 )

@@ -33,6 +33,7 @@ __all__ = [
     "wilcoxon_signed_rank",
     "kruskal_wallis",
     "friedman_chi_square",
+    "sign_test_exact",
     "ks_2samp",
     "mann_whitney_u_batch",
     "wilcoxon_batch",
@@ -177,6 +178,36 @@ def friedman_chi_square(data, block_mask):
     p = chi2_sf(chisq, jnp.asarray(k - 1.0, _F))
     p = jnp.where(ok, p, 1.0)
     return chisq, p
+
+
+# ---------------------------------------------------------------------------
+# Exact paired sign test — the k=2 member of the Friedman family
+# ---------------------------------------------------------------------------
+def sign_test_exact(x, y, pair_mask):
+    """Exact two-sided paired sign test on masked windows.
+
+    For k=2 treatments the Friedman statistic is a monotone function of the
+    number of blocks one treatment wins, so the exact null distribution is
+    Binom(n_untied, 1/2). scipy refuses friedmanchisquare with k<3 because
+    the df=1 chi-square approximation is anti-conservative at small n (5/5
+    one-sided wins: chi-square p~0.025 vs the exact 0.0625) — this is the
+    correct small-sample replacement. Tied blocks are dropped (the standard
+    conditional exact treatment).
+
+    Returns (n_untied, pvalue). pvalue = min(1, 2*P(X <= min(wins, losses)))
+    via the regularized incomplete beta: P(X <= k) = I_{1/2}(n-k, k+1).
+    """
+    xv = x.astype(_F)
+    yv = y.astype(_F)
+    pos = jnp.sum(((yv > xv) & pair_mask).astype(_F))
+    neg = jnp.sum(((yv < xv) & pair_mask).astype(_F))
+    n = pos + neg
+    s = jnp.minimum(pos, neg)
+    # n - s >= n/2 > 0 whenever n > 0; clamp keeps betainc's a>0 domain
+    # satisfied on the n=0 branch that jnp.where discards.
+    cdf = jax.scipy.special.betainc(jnp.maximum(n - s, 0.5), s + 1.0, 0.5)
+    p = jnp.clip(2.0 * cdf, 0.0, 1.0)
+    return n, jnp.where(n > 0, p, 1.0)
 
 
 # ---------------------------------------------------------------------------

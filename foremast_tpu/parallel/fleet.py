@@ -29,7 +29,7 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import forecast as fc
-from ..ops.pairwise import friedman_chi_square, two_sample_tests
+from ..ops.pairwise import sign_test_exact, two_sample_tests
 from .mesh import FLEET_AXIS, fleet_sharding, replicated
 
 __all__ = ["score_pairs", "make_fleet_scorer", "fleet_summary", "COMBINE_ANY", "COMBINE_ALL"]
@@ -88,12 +88,13 @@ def _pair_verdict(
     tests = two_sample_tests(baseline, b_mask, current, c_mask)
     # Friedman over time blocks: each timestep with both sides valid is a
     # block ranked across the 2 treatments (the paired-comparison member of
-    # the family, design.md:89-92)
+    # the family, design.md:89-92). With k=2 the exact null is binomial, so
+    # the p-value comes from the exact sign test rather than the df=1
+    # chi-square approximation, which is anti-conservative at small block
+    # counts (see ops.pairwise.sign_test_exact).
     paired_blocks = b_mask & c_mask
     n_blocks = jnp.sum(paired_blocks.astype(_F))
-    _, p_friedman = friedman_chi_square(
-        jnp.stack([baseline, current], axis=-1), paired_blocks
-    )
+    _, p_friedman = sign_test_exact(baseline, current, paired_blocks)
     pvals = jnp.stack(
         [
             tests["mann_whitney"][1],

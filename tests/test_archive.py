@@ -5,6 +5,7 @@ terminal jobs + hpalogs, RAM pruning made safe by it, and the
 from __future__ import annotations
 
 import json
+import os
 
 import pytest
 
@@ -236,3 +237,48 @@ def test_es_archive_requests_and_error_tolerance(monkeypatch):
     a.index_job({"id": "j2"})
     assert a.search() == []
     assert a.errors == 2
+
+
+def test_search_does_not_need_the_write_lock(tmp_path):
+    """Regression (advisor round 1): _iter_records held the archive lock for
+    the whole two-generation scan, blocking concurrent index_job writes.
+    Reads are now lock-free — a search completes even while the write lock is
+    held by someone else."""
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    a.index_job({"id": "j1", "app_name": "demo", "status": "completed_health"})
+    assert a._lock.acquire(timeout=1)
+    try:
+        assert a.search(app="demo")[0]["id"] == "j1"
+        assert a.get("j1")["id"] == "j1"
+    finally:
+        a._lock.release()
+
+
+def test_iter_records_rescans_on_rotation_race(tmp_path, monkeypatch):
+    """A rotation between reading the '.1' generation and the current file
+    must not make a fully-persisted generation invisible (review finding:
+    the first lock-free version could drop up to one whole generation)."""
+    path = str(tmp_path / "arch.jsonl")
+    a = FileArchive(path, max_bytes=10_000_000)
+    a.index_job({"id": "j1", "app_name": "demo", "status": "completed_health",
+                 "modified_at": 1.0})
+
+    real_open = open
+    state = {"rotated": False}
+
+    def racing_open(p, *args, **kwargs):
+        # After the reader has opened (missing) '.1', rotate before it opens
+        # the current file: j1's generation becomes '.1', a new current file
+        # holds only j2.
+        if p == path and not state["rotated"]:
+            state["rotated"] = True
+            os.replace(path, path + ".1")
+            a.index_job({"id": "j2", "app_name": "demo",
+                         "status": "completed_health", "modified_at": 2.0})
+        return real_open(p, *args, **kwargs)
+
+    import builtins
+
+    monkeypatch.setattr(builtins, "open", racing_open)
+    got = {r["id"] for r in a.search(app="demo")}
+    assert got == {"j1", "j2"}

@@ -140,3 +140,43 @@ def test_friedman_bit_in_fused_verdict():
         np.zeros(1, np.float32), np.tile(np.asarray([20, 20, 5], np.int32), (1, 1)),
     )
     assert not bool(np.asarray(out2["pairwise_unhealthy"])[0])
+
+
+def test_min_friedman_points_config_wired():
+    """MIN_FRIEDMAN_DATA_POINTS reaches the kernel: the analyzer passes a
+    4-wide min_points vector, and raising the gate above the available block
+    count disables the Friedman member (advisor round 1: the fifth test
+    silently fell back to the MIN_FRIEDMAN constant)."""
+    import numpy as np
+
+    from foremast_tpu.engine.config import from_env
+    from foremast_tpu.parallel import fleet as fl
+
+    cfg = from_env({"MIN_FRIEDMAN_DATA_POINTS": "12"})
+    assert cfg.min_friedman_points == 12
+
+    # 8 clean paired blocks, strongly shifted: friedman fires at gate<=8,
+    # is gated out at gate>8. Baseline must be non-constant (sigma>0) so the
+    # huge band_threshold actually disables the band detector.
+    B, T = 1, 8
+    rng = np.random.default_rng(0)
+    base = rng.normal(10.0, 1.0, (B, T)).astype(np.float32)
+    cur = base + 5.0
+    ones = np.ones((B, T), bool)
+
+    def verdict(gate):
+        out = fl.score_pairs(
+            base, ones, cur, ones,
+            np.full(B, 0.05, np.float32),
+            np.full(B, fl.TEST_FRIEDMAN, np.int32),
+            np.zeros(B, np.int32),
+            np.full(B, 4, np.int32),
+            np.full(B, 1e9, np.float32),  # band never fires
+            np.zeros(B, np.int32),
+            np.zeros(B, np.float32),
+            np.tile(np.asarray([20, 20, 5, gate], np.int32), (B, 1)),
+        )
+        return bool(np.asarray(out["unhealthy"])[0])
+
+    assert verdict(8) is True   # 8/8 wins: exact p = 2*(1/2)^8 ~ 0.0078 < 0.05
+    assert verdict(9) is False  # gated: not enough blocks -> cannot judge

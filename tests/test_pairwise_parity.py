@@ -153,3 +153,52 @@ def test_two_sample_tests_matches_standalone():
     np.testing.assert_allclose(
         float(fused["ks"][1]), float(ks_2samp(x, xm, y, ym)[1]), rtol=1e-6
     )
+
+
+# ------------------------------------------------------------ exact sign test
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("shift", [0.0, 1.5])
+def test_sign_test_exact_matches_binomtest(seed, shift):
+    """k=2 Friedman member: exact binomial p, parity vs scipy.binomtest.
+
+    For p=1/2 the null is symmetric, so scipy's minlike two-sided p equals
+    2*min-tail (clipped at 1) — the form sign_test_exact computes.
+    """
+    from foremast_tpu.ops import sign_test_exact
+
+    x, xm, y, ym = _windows(seed, T=40, shift=shift)
+    pm = xm & ym
+    n, p = sign_test_exact(x, y, pm)
+    pos = int(np.sum((y > x) & pm))
+    neg = int(np.sum((y < x) & pm))
+    assert int(n) == pos + neg
+    if pos + neg == 0:
+        assert float(p) == 1.0
+        return
+    ref = sps.binomtest(min(pos, neg), pos + neg, 0.5, alternative="two-sided")
+    assert float(p) == pytest.approx(ref.pvalue, abs=ATOL)
+
+
+def test_sign_test_exact_small_blocks_not_anticonservative():
+    """5/5 one-sided wins: exact p = 2*(1/2)^5 = 0.0625, NOT the df=1
+    chi-square approximation's ~0.025 (the advisor-flagged false-fire risk
+    in 'all'/'any' composite mode at MIN_FRIEDMAN=5)."""
+    from foremast_tpu.ops import sign_test_exact
+
+    x = np.zeros(5, np.float32)
+    y = np.ones(5, np.float32)
+    m = np.ones(5, bool)
+    n, p = sign_test_exact(x, y, m)
+    assert int(n) == 5
+    assert float(p) == pytest.approx(0.0625, abs=1e-6)
+    # and therefore it cannot reject at the default alpha=0.01
+    assert float(p) > 0.01
+
+
+def test_sign_test_exact_all_tied_is_p1():
+    from foremast_tpu.ops import sign_test_exact
+
+    x = np.ones(30, np.float32)
+    m = np.ones(30, bool)
+    n, p = sign_test_exact(x, x, m)
+    assert int(n) == 0 and float(p) == 1.0
