@@ -5,7 +5,7 @@ the engine (in-process or HTTP), applies remediation, and maintains the HPA
 score wiring — re-derived from foremast-barrelman (SURVEY.md §2.1) as a
 tick-driven reconciler over a small injectable K8s API seam.
 """
-from .analyst import HttpAnalyst, InProcessAnalyst, StatusResponse
+from .analyst import GrpcAnalyst, HttpAnalyst, InProcessAnalyst, StatusResponse
 from .barrelman import Barrelman
 from .controllers import DeploymentController, HpaController, MonitorController
 from .kube import FakeKube, KubeClient
@@ -24,6 +24,7 @@ __all__ = [
     "HpaController",
     "FakeKube",
     "KubeClient",
+    "GrpcAnalyst",
     "HttpAnalyst",
     "InProcessAnalyst",
     "StatusResponse",

@@ -10,9 +10,11 @@ to monitor phases (:227-245):
   abort                                  -> Abort
   completed_unknown                      -> Warning
 
-Two implementations share the mapping:
+Three implementations share the mapping:
   * HttpAnalyst — real HTTP with an injectable do_func (the reference's
     DoFunc test seam, analystclient.go:24).
+  * GrpcAnalyst — the gRPC dispatch transport the north star names; same
+    request/response dict shapes via service.grpc_api.DispatchClient.
   * InProcessAnalyst — calls the ForemastService handlers directly; the
     TPU-native collapse when operator + engine share a process.
 """
@@ -101,6 +103,45 @@ class HttpAnalyst:
             anomaly=doc.get("anomaly", {}) or {},
             hpa_logs=doc.get("hpalogs", []) or [],
         )
+
+
+class GrpcAnalyst:
+    """gRPC sibling of HttpAnalyst (north star: dispatch over gRPC).
+
+    Lazy import so the operator works without grpc installed; the dispatch
+    client speaks the same dict shapes as the HTTP facade, so the phase
+    mapping above applies unchanged.
+    """
+
+    def __init__(self, target: str, timeout: float = 10.0):
+        from ..service.grpc_api import DispatchClient
+
+        self.client = DispatchClient(target, timeout=timeout)
+
+    def start_analyzing(self, request: dict) -> str:
+        from ..service.grpc_api import DispatchError
+
+        try:
+            return self.client.create(request)["jobId"]
+        except DispatchError as e:
+            raise AnalystError(f"create returned {e.status}: {e.message}") from e
+
+    def get_status(self, job_id: str) -> StatusResponse:
+        from ..service.grpc_api import DispatchError
+
+        try:
+            doc = self.client.status(job_id)
+        except DispatchError as e:
+            raise AnalystError(f"status returned {e.status}: {e.message}") from e
+        return StatusResponse(
+            phase=_map_status(doc.get("status", "")),
+            reason=doc.get("reason", ""),
+            anomaly=doc.get("anomaly", {}) or {},
+            hpa_logs=doc.get("hpalogs", []) or [],
+        )
+
+    def close(self):
+        self.client.close()
 
 
 class InProcessAnalyst:

@@ -18,6 +18,8 @@ Env surface (union of the reference services'):
                          documents/hpalogs); takes precedence over ARCHIVE_PATH
   JOB_RETENTION_SECONDS  prune archived terminal jobs from RAM after this
   PORT                   HTTP port (reference :8099)
+  GRPC_PORT              gRPC dispatch port (0/unset disables; 8100 in the
+                         shipped manifests) — service/grpc_api.py
   CYCLE_SECONDS          engine cycle cadence (brain poll loop)
   WAVEFRONT_PROXY        host[:port] of a Wavefront proxy to mirror the
                          verdict series to (custom.iks.foremast.*)
@@ -68,14 +70,25 @@ class Runtime:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._server = None
+        self._grpc_server = None
+        self.grpc_bound_port: int | None = None
 
     # -- lifecycle --
     def start(self, host: str = "0.0.0.0", port: int = 8099,
-              cycle_seconds: float = 10.0, worker: str = "worker-0"):
-        """Start the HTTP server and the engine worker loop (background)."""
+              cycle_seconds: float = 10.0, worker: str = "worker-0",
+              grpc_port: int | None = None):
+        """Start the HTTP (and optional gRPC) servers and the engine worker
+        loop (background). grpc_port=0 binds an ephemeral port (see
+        grpc_bound_port); None disables the gRPC front."""
         self._server = make_server(self.service, host, port)
         t_http = threading.Thread(target=self._server.serve_forever, daemon=True)
         t_http.start()
+        if grpc_port is not None:
+            from .service.grpc_api import serve_grpc_background
+
+            self._grpc_server, self.grpc_bound_port = serve_grpc_background(
+                self.service, host=host, port=grpc_port
+            )
         t_eng = threading.Thread(
             target=self._worker_loop, args=(cycle_seconds, worker), daemon=True
         )
@@ -99,6 +112,8 @@ class Runtime:
         self._stop.set()
         if self._server is not None:
             self._server.shutdown()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=2.0)
         self.store.flush()
 
     def run_forever(self, **kw):
@@ -161,9 +176,15 @@ def main():
             rt.exporter, host=host, port=int(port or 2878)
         )
     port = int(os.environ.get("PORT", "8099"))
+    grpc_port = int(os.environ.get("GRPC_PORT", "0")) or None
     cycle = float(os.environ.get("CYCLE_SECONDS", "10"))
-    print(f"[foremast-tpu] serving :{port}, cycle={cycle}s", flush=True)
-    rt.run_forever(port=port, cycle_seconds=cycle)
+    print(
+        f"[foremast-tpu] serving :{port}"
+        + (f" grpc :{grpc_port}" if grpc_port else "")
+        + f", cycle={cycle}s",
+        flush=True,
+    )
+    rt.run_forever(port=port, cycle_seconds=cycle, grpc_port=grpc_port)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,491 @@
+"""gRPC dispatch frontend — the job-submission transport the north star
+names ("dispatches to the TPU brain over gRPC").
+
+Both transports are thin shells over the same ForemastService handlers
+(api.py): Create/GetStatus/Search/HpaAlert convert proto <-> the HTTP JSON
+dict shapes and call the exact handler the HTTP facade calls, so the two
+fronts cannot drift — tests/test_grpc.py runs one contract suite over both.
+Reference analogues: the service routes (foremast-service/cmd/manager/
+main.go:326-346) and the analyst client contract
+(foremast-barrelman/pkg/client/analyst/analystclient.go:127-249).
+
+The method stubs are hand-written against grpc's generic-handler API
+(method_handlers_generic_handler / channel.unary_unary); only protoc's
+message codegen is used (service/proto/regen.sh), keeping grpcio-tools out
+of the build.
+"""
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from . import foremast_pb2 as pb
+from .api import ApiError, ForemastService
+
+__all__ = [
+    "SERVICE_NAME",
+    "DispatchClient",
+    "make_grpc_server",
+    "serve_grpc_background",
+]
+
+SERVICE_NAME = "foremast.v1.ForemastDispatch"
+
+# HTTP status -> canonical gRPC code (both directions use this table; the
+# client maps codes back to the HTTP numbers so error behavior is
+# transport-independent)
+_HTTP_TO_CODE = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    404: grpc.StatusCode.NOT_FOUND,
+    502: grpc.StatusCode.UNAVAILABLE,
+}
+_CODE_TO_HTTP = {v: k for k, v in _HTTP_TO_CODE.items()}
+
+
+# ---------------------------------------------------------------------------
+# proto <-> HTTP-dict converters
+# ---------------------------------------------------------------------------
+def _num(x: float):
+    return int(x) if float(x).is_integer() else x
+
+
+def _metric_query_to_dict(m) -> dict:
+    d: dict = {}
+    if m.url:
+        d["url"] = m.url
+    if m.data_source_type:
+        d["dataSourceType"] = m.data_source_type
+    if m.HasField("parameters"):
+        p = m.parameters
+        # integral floats collapse to int so the materialized query URLs —
+        # and therefore the HMAC job ids — match the HTTP facade, where JSON
+        # integers arrive as Python ints
+        params: dict = {
+            "query": p.query,
+            "start": _num(p.start),
+            "end": _num(p.end),
+        }
+        if p.endpoint:
+            params["endpoint"] = p.endpoint
+        if p.HasField("step"):
+            params["step"] = p.step
+        d["parameters"] = params
+    if m.priority:
+        d["priority"] = m.priority
+    if m.HasField("is_increase"):
+        d["isIncrease"] = m.is_increase
+    d["isAbsolute"] = m.is_absolute
+    return d
+
+
+def _dict_to_metric_query(entry: dict) -> pb.MetricQuery:
+    m = pb.MetricQuery(
+        url=str(entry.get("url", "") or ""),
+        data_source_type=str(entry.get("dataSourceType", "") or ""),
+        is_absolute=bool(entry.get("isAbsolute", False)),
+    )
+    if "isIncrease" in entry:
+        m.is_increase = bool(entry["isIncrease"])
+    if "priority" in entry:
+        try:
+            m.priority = int(entry["priority"])
+        except (TypeError, ValueError):
+            # the HTTP facade rejects bad priorities with a 400, but proto
+            # int32 can't carry garbage across the wire — reject client-side
+            # with the same status so callers see one error contract
+            # (DispatchError, NOT the server-internal ApiError)
+            raise DispatchError(
+                400, f"invalid priority {entry['priority']!r}"
+            ) from None
+    params = entry.get("parameters")
+    if isinstance(params, dict):
+        p = m.parameters
+        p.endpoint = str(params.get("endpoint", "") or "")
+        p.query = str(params.get("query", "") or "")
+        p.start = float(params.get("start", 0) or 0)
+        p.end = float(params.get("end", 0) or 0)
+        if "step" in params:
+            try:
+                p.step = int(params["step"])
+            except (TypeError, ValueError):
+                raise DispatchError(
+                    400, f"invalid step {params['step']!r}"
+                ) from None
+    return m
+
+
+def create_request_to_dict(msg: pb.CreateRequest) -> dict:
+    """Proto -> the JSON shape build_document validates (HTTP parity)."""
+    req: dict = {"appName": msg.app_name}
+    if msg.namespace:
+        req["namespace"] = msg.namespace
+    if msg.strategy:
+        req["strategy"] = msg.strategy
+    if msg.start_time:
+        req["startTime"] = msg.start_time
+    if msg.end_time:
+        req["endTime"] = msg.end_time
+    if msg.pod_count_url:
+        req["podCountURL"] = msg.pod_count_url
+    info: dict = {}
+    for cat in ("current", "baseline", "historical"):
+        entries = getattr(msg.metrics_info, cat)
+        if entries:
+            info[cat] = {name: _metric_query_to_dict(entries[name]) for name in entries}
+    req["metricsInfo"] = info
+    return req
+
+
+def dict_to_create_request(req: dict) -> pb.CreateRequest:
+    """The JSON create shape -> proto (client side)."""
+    msg = pb.CreateRequest(
+        app_name=str(req.get("appName", "") or ""),
+        namespace=str(req.get("namespace", "") or ""),
+        strategy=str(req.get("strategy", "") or ""),
+        start_time=str(req.get("startTime", "") or ""),
+        end_time=str(req.get("endTime", "") or ""),
+        pod_count_url=str(req.get("podCountURL", "") or ""),
+    )
+    info = req.get("metricsInfo", {}) or {}
+    for cat in ("current", "baseline", "historical"):
+        for name, entry in (info.get(cat) or {}).items():
+            msg.metrics_info.__getattribute__(cat)[name].CopyFrom(
+                _dict_to_metric_query(entry or {})
+            )
+    return msg
+
+
+def _hpalog_to_proto(log: dict) -> pb.HpaLog:
+    out = pb.HpaLog(
+        job_id=str(log.get("job_id", "") or ""),
+        hpascore=float(log.get("hpascore", 0.0) or 0.0),
+        reason=str(log.get("reason", "") or ""),
+        timestamp=float(log.get("timestamp", 0.0) or 0.0),
+    )
+    for d in log.get("details", []) or []:
+        out.details.append(
+            pb.HpaDetail(
+                metric_type=str(d.get("metricType", "") or ""),
+                current=float(d.get("current", 0.0) or 0.0),
+                upper=float(d.get("upper", 0.0) or 0.0),
+                lower=float(d.get("lower", 0.0) or 0.0),
+            )
+        )
+    return out
+
+
+def _hpalog_to_dict(log: pb.HpaLog, include_job_id: bool = True) -> dict:
+    # the HTTP alert payload omits job_id (implied by the route); the status
+    # payload includes it — mirror both exactly
+    out = {"job_id": log.job_id} if include_job_id else {}
+    return {
+        **out,
+        "hpascore": log.hpascore,
+        "reason": log.reason,
+        "details": [
+            {
+                "metricType": d.metric_type,
+                "current": d.current,
+                "upper": d.upper,
+                "lower": d.lower,
+            }
+            for d in log.details
+        ],
+        "timestamp": log.timestamp,
+    }
+
+
+def status_payload_to_proto(payload: dict) -> pb.StatusReply:
+    reply = pb.StatusReply(
+        job_id=payload.get("jobId", ""),
+        app_name=payload.get("appName", ""),
+        namespace=payload.get("namespace", ""),
+        strategy=payload.get("strategy", ""),
+        status=payload.get("status", ""),
+        reason=payload.get("reason", ""),
+    )
+    for metric, points in (payload.get("anomaly") or {}).items():
+        reply.anomaly[metric].values.extend(float(v) for v in points)
+    for log in payload.get("hpalogs", []) or []:
+        reply.hpalogs.append(_hpalog_to_proto(log))
+    return reply
+
+
+def status_reply_to_dict(reply: pb.StatusReply) -> dict:
+    """Proto -> the HTTP /v1/healthcheck/id/:id payload shape."""
+    return {
+        "jobId": reply.job_id,
+        "appName": reply.app_name,
+        "namespace": reply.namespace,
+        "strategy": reply.strategy,
+        "status": reply.status,
+        "statusCode": "200",
+        "reason": reply.reason,
+        "anomaly": {m: list(pts.values) for m, pts in reply.anomaly.items()},
+        "hpalogs": [_hpalog_to_dict(l) for l in reply.hpalogs],
+    }
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+class _Abort(Exception):
+    """Internal: carry an HTTP-shaped (status, message) out of a handler.
+
+    Handlers raise this instead of calling context.abort directly so the
+    guard is the single place that terminates RPCs — context.abort raises a
+    bare Exception internally, which a blanket except would re-wrap as
+    INTERNAL and mask the real code.
+    """
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _abort_for(status: int, payload) -> None:
+    message = (
+        str(payload.get("error", payload)) if isinstance(payload, dict) else str(payload)
+    )
+    raise _Abort(status, message)
+
+
+def _guard(fn):
+    """Uniform ApiError/exception -> gRPC status mapping for handlers."""
+
+    def handler(request, context):
+        try:
+            return fn(request)
+        except _Abort as e:
+            context.abort(
+                _HTTP_TO_CODE.get(e.status, grpc.StatusCode.INTERNAL), e.message
+            )
+        except ApiError as e:
+            context.abort(
+                _HTTP_TO_CODE.get(e.status, grpc.StatusCode.INTERNAL), e.message
+            )
+        except Exception as e:  # noqa: BLE001 - transport boundary
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    return handler
+
+
+def make_grpc_server(
+    service: ForemastService,
+    host: str = "0.0.0.0",
+    port: int = 8100,
+    max_workers: int = 8,
+) -> tuple[grpc.Server, int]:
+    """Build (unstarted) gRPC server; returns (server, bound_port)."""
+
+    def create(request):
+        status, payload = service.create(create_request_to_dict(request))
+        if status != 200:
+            _abort_for(status, payload)
+        return pb.CreateResponse(job_id=payload["jobId"], status=payload["status"])
+
+    def get_status(request):
+        status, payload = service.status(request.job_id)
+        if status != 200:
+            _abort_for(status, payload)
+        return status_payload_to_proto(payload)
+
+    def search(request):
+        params = {}
+        for key, value in (
+            ("appName", request.app_name),
+            ("namespace", request.namespace),
+            ("status", request.status),
+            ("strategy", request.strategy),
+        ):
+            if value:
+                params[key] = [value]
+        if request.limit:
+            params["limit"] = [str(request.limit)]
+        status, payload = service.search(params)
+        if status != 200:
+            _abort_for(status, payload)
+        reply = pb.SearchReply()
+        for job in payload["jobs"]:
+            reply.jobs.append(
+                pb.JobSummary(
+                    job_id=job["jobId"],
+                    app_name=job["appName"],
+                    namespace=job["namespace"],
+                    strategy=job["strategy"],
+                    status=job["status"],
+                    internal_status=job["internalStatus"],
+                    reason=job["reason"],
+                    modified_at=float(job["modifiedAt"]),
+                )
+            )
+        return reply
+
+    def hpa_alert(request):
+        status, payload = service.alert(
+            request.app_name, request.namespace, request.strategy
+        )
+        if status != 200:
+            _abort_for(status, payload)
+        reply = pb.AlertReply(
+            app_name=payload["appName"],
+            namespace=payload["namespace"],
+            strategy=payload["strategy"],
+        )
+        for log in payload["hpalogs"]:
+            reply.hpalogs.append(_hpalog_to_proto(log))
+        return reply
+
+    rpcs = {
+        "Create": grpc.unary_unary_rpc_method_handler(
+            _guard(create),
+            request_deserializer=pb.CreateRequest.FromString,
+            response_serializer=pb.CreateResponse.SerializeToString,
+        ),
+        "GetStatus": grpc.unary_unary_rpc_method_handler(
+            _guard(get_status),
+            request_deserializer=pb.StatusRequest.FromString,
+            response_serializer=pb.StatusReply.SerializeToString,
+        ),
+        "Search": grpc.unary_unary_rpc_method_handler(
+            _guard(search),
+            request_deserializer=pb.SearchRequest.FromString,
+            response_serializer=pb.SearchReply.SerializeToString,
+        ),
+        "HpaAlert": grpc.unary_unary_rpc_method_handler(
+            _guard(hpa_alert),
+            request_deserializer=pb.AlertRequest.FromString,
+            response_serializer=pb.AlertReply.SerializeToString,
+        ),
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, rpcs),)
+    )
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise OSError(f"could not bind gRPC port {host}:{port}")
+    return server, bound
+
+
+def serve_grpc_background(
+    service: ForemastService, host: str = "127.0.0.1", port: int = 0
+) -> tuple[grpc.Server, int]:
+    """Start a gRPC server on a background thread; port=0 picks a free one."""
+    server, bound = make_grpc_server(service, host, port)
+    server.start()
+    return server, bound
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+class DispatchError(Exception):
+    """Transport-mapped service error; .status mirrors the HTTP code."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class DispatchClient:
+    """Typed client over the dispatch service.
+
+    Methods take/return the same JSON dict shapes as the HTTP facade, so
+    callers (GrpcAnalyst, the trigger, tests) can swap transports without
+    reshaping data.
+    """
+
+    def __init__(self, target: str, timeout: float = 10.0):
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(target)
+        u = self._channel.unary_unary
+        self._create = u(
+            f"/{SERVICE_NAME}/Create",
+            request_serializer=pb.CreateRequest.SerializeToString,
+            response_deserializer=pb.CreateResponse.FromString,
+        )
+        self._status = u(
+            f"/{SERVICE_NAME}/GetStatus",
+            request_serializer=pb.StatusRequest.SerializeToString,
+            response_deserializer=pb.StatusReply.FromString,
+        )
+        self._search = u(
+            f"/{SERVICE_NAME}/Search",
+            request_serializer=pb.SearchRequest.SerializeToString,
+            response_deserializer=pb.SearchReply.FromString,
+        )
+        self._alert = u(
+            f"/{SERVICE_NAME}/HpaAlert",
+            request_serializer=pb.AlertRequest.SerializeToString,
+            response_deserializer=pb.AlertReply.FromString,
+        )
+
+    def _call(self, stub, request):
+        try:
+            return stub(request, timeout=self.timeout)
+        except grpc.RpcError as e:
+            status = _CODE_TO_HTTP.get(e.code(), 500)
+            raise DispatchError(status, e.details() or str(e.code())) from e
+
+    def create(self, req: dict) -> dict:
+        resp = self._call(self._create, dict_to_create_request(req))
+        return {"jobId": resp.job_id, "status": resp.status}
+
+    def status(self, job_id: str) -> dict:
+        return status_reply_to_dict(
+            self._call(self._status, pb.StatusRequest(job_id=job_id))
+        )
+
+    def search(
+        self, app=None, namespace=None, status=None, strategy=None, limit=0
+    ) -> list[dict]:
+        reply = self._call(
+            self._search,
+            pb.SearchRequest(
+                app_name=app or "",
+                namespace=namespace or "",
+                status=status or "",
+                strategy=strategy or "",
+                limit=int(limit or 0),
+            ),
+        )
+        return [
+            {
+                "jobId": j.job_id,
+                "appName": j.app_name,
+                "namespace": j.namespace,
+                "strategy": j.strategy,
+                "status": j.status,
+                "internalStatus": j.internal_status,
+                "reason": j.reason,
+                "modifiedAt": j.modified_at,
+            }
+            for j in reply.jobs
+        ]
+
+    def alert(self, app: str, namespace: str, strategy: str) -> dict:
+        reply = self._call(
+            self._alert,
+            pb.AlertRequest(app_name=app, namespace=namespace, strategy=strategy),
+        )
+        return {
+            "appName": reply.app_name,
+            "namespace": reply.namespace,
+            "strategy": reply.strategy,
+            "hpalogs": [
+                _hpalog_to_dict(l, include_job_id=False) for l in reply.hpalogs
+            ],
+        }
+
+    def close(self):
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

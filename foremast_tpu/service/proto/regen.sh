@@ -1,0 +1,10 @@
+#!/bin/sh
+# Regenerate foremast_pb2.py from foremast.proto.
+#
+# Only protoc (message codegen) is required; the gRPC method stubs are
+# hand-written in service/grpc_api.py against grpc's generic-handler API,
+# so grpcio-tools is deliberately not a build dependency.
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=.. -I . foremast.proto
+echo "wrote $(cd .. && pwd)/foremast_pb2.py"

@@ -1,0 +1,367 @@
+"""gRPC dispatch frontend: one contract suite over both transports.
+
+The north star names gRPC as the job-dispatch transport; the build keeps
+the HTTP facade for reference parity (main.go:326-346). Both fronts wrap
+the same ForemastService handlers, and these tests prove the contract is
+transport-independent: every scenario runs over real HTTP and real gRPC
+and must produce identical logical payloads — including error statuses.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.jobs import HpaLog, JobStore
+from foremast_tpu.service.api import ForemastService, serve_background
+from foremast_tpu.service.grpc_api import (
+    DispatchClient,
+    DispatchError,
+    serve_grpc_background,
+)
+from foremast_tpu.utils.ids import hpa_job_id
+
+CREATE_REQ = {
+    "appName": "demo",
+    "namespace": "default",
+    "strategy": "canary",
+    "startTime": "2026-07-29T00:00:00Z",
+    "endTime": "2026-07-29T00:10:00Z",
+    "metricsInfo": {
+        "current": {
+            "error5xx": {
+                "url": "http://prom/api/v1/query_range?query=cur",
+                "priority": 1,
+            }
+        },
+        "baseline": {
+            "error5xx": {"url": "http://prom/api/v1/query_range?query=base"}
+        },
+        "historical": {
+            "error5xx": {"url": "http://prom/api/v1/query_range?query=hist"}
+        },
+    },
+}
+
+
+class HttpDispatch:
+    """urllib adapter exposing the same method surface as DispatchClient,
+    raising DispatchError with the HTTP status so error-path assertions are
+    shared verbatim across transports."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint.rstrip("/")
+
+    def _req(self, method, path, body=None):
+        req = urllib.request.Request(
+            self.endpoint + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            payload = json.loads(e.read() or b"{}")
+            raise DispatchError(e.code, payload.get("error", "")) from e
+
+    def create(self, req: dict) -> dict:
+        return self._req("POST", "/v1/healthcheck/create", req)
+
+    def status(self, job_id: str) -> dict:
+        return self._req("GET", f"/v1/healthcheck/id/{job_id}")
+
+    def search(self, app=None, namespace=None, status=None, strategy=None,
+               limit=0) -> list[dict]:
+        q = []
+        for k, v in (("appName", app), ("namespace", namespace),
+                     ("status", status), ("strategy", strategy)):
+            if v:
+                q.append(f"{k}={v}")
+        if limit:
+            q.append(f"limit={limit}")
+        qs = ("?" + "&".join(q)) if q else ""
+        return self._req("GET", f"/v1/healthcheck/search{qs}")["jobs"]
+
+    def alert(self, app, namespace, strategy) -> dict:
+        return self._req("GET", f"/alert/{app}/{namespace}/{strategy}")
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One service, two live transports."""
+    store = JobStore()
+    service = ForemastService(store)
+    http_server = serve_background(service, port=0)
+    http_port = http_server.server_address[1]
+    grpc_server, grpc_port = serve_grpc_background(service, port=0)
+    clients = {
+        "http": HttpDispatch(f"http://127.0.0.1:{http_port}"),
+        "grpc": DispatchClient(f"127.0.0.1:{grpc_port}"),
+    }
+    yield store, service, clients
+    clients["grpc"].close()
+    grpc_server.stop(grace=0.5)
+    http_server.shutdown()
+
+
+@pytest.fixture(params=["http", "grpc"])
+def dispatch(request, stack):
+    _, _, clients = stack
+    return clients[request.param]
+
+
+# ------------------------------------------------------------- create
+def test_create_same_job_id_on_both_transports(stack):
+    _, _, clients = stack
+    got = {name: c.create(CREATE_REQ) for name, c in clients.items()}
+    assert got["http"]["jobId"] == got["grpc"]["jobId"]
+    assert got["http"]["status"] == got["grpc"]["status"] == "new"
+
+
+def test_create_dedupes(dispatch):
+    a = dispatch.create(CREATE_REQ)
+    b = dispatch.create(CREATE_REQ)
+    assert a["jobId"] == b["jobId"]
+
+
+def test_create_structured_parameters_match_url_form(stack):
+    """The reference's {dataSourceType, parameters} shape builds the same
+    query URLs over both transports (constructURL, main.go:34-48)."""
+    _, _, clients = stack
+    req = {
+        "appName": "paramapp",
+        "strategy": "canary",
+        "metricsInfo": {
+            "current": {
+                "latency": {
+                    "dataSourceType": "prometheus",
+                    "parameters": {
+                        "endpoint": "http://prom:9090/api/v1/",
+                        "query": "namespace_pod_latency",
+                        "start": 1000,
+                        "end": 1600,
+                        "step": 60,
+                    },
+                }
+            }
+        },
+    }
+    ids = {name: c.create(req)["jobId"] for name, c in clients.items()}
+    assert ids["http"] == ids["grpc"]
+
+
+def test_create_invalid_app_rejected(dispatch):
+    with pytest.raises(DispatchError) as exc:
+        dispatch.create({"appName": "bad app!", "strategy": "canary",
+                         "metricsInfo": {"current": {"m": {"url": "http://x"}}}})
+    assert exc.value.status == 400
+
+
+def test_create_invalid_strategy_rejected(dispatch):
+    with pytest.raises(DispatchError) as exc:
+        dispatch.create({"appName": "demo", "strategy": "nope",
+                         "metricsInfo": {"current": {"m": {"url": "http://x"}}}})
+    assert exc.value.status == 400
+
+
+# ------------------------------------------------------------- status
+def test_status_unknown_job_404(dispatch):
+    with pytest.raises(DispatchError) as exc:
+        dispatch.status("no-such-job")
+    assert exc.value.status == 404
+
+
+def test_status_terminal_job_identical_payloads(stack):
+    store, _, clients = stack
+    job_id = clients["http"].create(CREATE_REQ)["jobId"]
+    store.transition(job_id, J.PREPROCESS_INPROGRESS)
+    store.transition(job_id, J.PREPROCESS_COMPLETED)
+    store.transition(job_id, J.POSTPROCESS_INPROGRESS)
+    store.transition(
+        job_id,
+        J.COMPLETED_UNHEALTH,
+        reason="anomaly detected on error5xx",
+        anomaly={"error5xx": [1000.0, 42.0, 1060.0, 43.0]},
+    )
+    got = {name: c.status(job_id) for name, c in clients.items()}
+    assert got["http"] == got["grpc"]
+    assert got["grpc"]["status"] == "anomaly"
+    assert got["grpc"]["anomaly"]["error5xx"] == [1000.0, 42.0, 1060.0, 43.0]
+
+
+def test_status_hpa_job_carries_hpalogs(stack):
+    store, _, clients = stack
+    job_id = hpa_job_id("hpaapp", "default")
+    clients["grpc"].create({
+        "appName": "hpaapp",
+        "namespace": "default",
+        "strategy": "hpa",
+        "metricsInfo": {},
+    })
+    store.add_hpalog(HpaLog(
+        job_id=job_id,
+        hpascore=78.0,
+        reason="tps above predicted band",
+        details=[{"metricType": "tps", "current": 900.0, "upper": 800.0,
+                  "lower": 400.0}],
+        timestamp=time.time(),
+    ))
+    got = {name: c.status(job_id) for name, c in clients.items()}
+    assert got["http"] == got["grpc"]
+    log = got["grpc"]["hpalogs"][0]
+    assert log["hpascore"] == 78.0
+    assert log["details"][0]["metricType"] == "tps"
+
+
+# ------------------------------------------------------------- search/alert
+def test_search_identical_across_transports(stack):
+    _, _, clients = stack
+    clients["grpc"].create(CREATE_REQ)
+    got = {
+        name: c.search(app="demo", status="anomaly", limit=10)
+        for name, c in clients.items()
+    }
+    assert got["http"] == got["grpc"]
+    assert all(j["appName"] == "demo" for j in got["grpc"])
+
+
+def test_search_unknown_status_rejected(dispatch):
+    with pytest.raises(DispatchError) as exc:
+        dispatch.search(status="bogus")
+    assert exc.value.status == 400
+
+
+def test_alert_identical_across_transports(stack):
+    _, _, clients = stack
+    got = {name: c.alert("hpaapp", "default", "hpa") for name, c in clients.items()}
+    assert got["http"] == got["grpc"]
+    assert got["grpc"]["hpalogs"], "hpa logs recorded earlier must surface"
+
+
+# ------------------------------------------------- operator e2e over gRPC
+def test_operator_grpc_engine_e2e():
+    """Flagship path with the gRPC hop in the middle: operator (GrpcAnalyst)
+    -> gRPC dispatch -> shared service -> engine scores on the accelerator
+    path -> verdict flows back over gRPC -> rollback."""
+    from test_operator import _deployment, _metadata, _pod, _replicaset
+
+    from foremast_tpu.dataplane.exporter import VerdictExporter
+    from foremast_tpu.dataplane.fetch import FixtureDataSource
+    from foremast_tpu.engine.analyzer import Analyzer
+    from foremast_tpu.engine.config import EngineConfig
+    from foremast_tpu.operator import FakeKube
+    from foremast_tpu.operator.analyst import GrpcAnalyst
+    from foremast_tpu.operator.loop import OperatorLoop
+    from foremast_tpu.operator.types import (
+        PHASE_HEALTHY,
+        PHASE_RUNNING,
+        PHASE_UNHEALTHY,
+        RemediationAction,
+    )
+
+    rng = np.random.default_rng(7)
+    now = time.time()
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata(endpoint="http://prom/api/v1/"))
+    store = JobStore()
+    exporter = VerdictExporter()
+
+    def resolver(url):
+        # decoded match — see test_operator's flagship resolver note
+        url = urllib.parse.unquote(url)
+        n_hist = 1440
+        if "pod=~" in url and "p-new" in url:
+            return ([now - 600 + 60 * i for i in range(10)],
+                    list(rng.poisson(300, 10).astype(float)))
+        if "pod=~" in url:
+            return ([now - 1200 + 60 * i for i in range(10)],
+                    list(rng.poisson(30, 10).astype(float)))
+        return ([now - 86400 + 60 * i for i in range(n_hist)],
+                list(rng.poisson(30, n_hist).astype(float)))
+
+    engine = Analyzer(EngineConfig(), FixtureDataSource(resolver=resolver),
+                      store, exporter=exporter)
+    service = ForemastService(store, exporter=exporter)
+    server, port = serve_grpc_background(service, port=0)
+    analyst = GrpcAnalyst(f"127.0.0.1:{port}")
+    try:
+        loop = OperatorLoop(kube, analyst)
+
+        kube.deployments[("default", "demo")] = _deployment(
+            "demo", image="app:v1", revision=1
+        )
+        kube.replicasets[("default", "rs1")] = _replicaset("rs1", "demo", 1, "h1")
+        kube.pods[("default", "p-old")] = _pod("p-old", "demo", "h1")
+        loop.tick(now)
+        assert kube.get_monitor("default", "demo").status.phase == PHASE_HEALTHY
+
+        kube.deployments[("default", "demo")] = _deployment(
+            "demo", image="app:v2", revision=2
+        )
+        kube.replicasets[("default", "rs2")] = _replicaset("rs2", "demo", 2, "h2")
+        kube.pods[("default", "p-new")] = _pod("p-new", "demo", "h2")
+        m = kube.get_monitor("default", "demo")
+        m.spec.remediation = RemediationAction(option="AutoRollback")
+        kube.upsert_monitor(m)
+
+        loop.tick(now)
+        assert kube.get_monitor("default", "demo").status.phase == PHASE_RUNNING
+
+        engine.run_cycle(now=now)
+        loop.tick(now)
+        m = kube.get_monitor("default", "demo")
+        assert m.status.phase == PHASE_UNHEALTHY
+        assert m.status.anomaly.anomalous_metrics
+        assert m.status.remediation_taken
+        d = kube.get_deployment("default", "demo")
+        assert d["spec"]["template"]["spec"]["containers"][0]["image"] == "app:r1"
+    finally:
+        analyst.close()
+        server.stop(grace=0.5)
+
+
+def test_explicit_step_zero_survives_both_transports(stack):
+    """step=0 must not be rewritten to the 60 s default over gRPC (proto3
+    zero-vs-unset: step is presence-tracked in the schema) — otherwise the
+    materialized URLs and HMAC job ids diverge across transports."""
+    _, _, clients = stack
+    req = {
+        "appName": "stepzero",
+        "strategy": "canary",
+        "metricsInfo": {
+            "current": {
+                "m": {
+                    "parameters": {"query": "q", "start": 1, "end": 2, "step": 0}
+                }
+            }
+        },
+    }
+    ids = {name: c.create(req)["jobId"] for name, c in clients.items()}
+    assert ids["http"] == ids["grpc"]
+
+
+def test_client_side_validation_raises_dispatch_error(stack):
+    """Garbage that can't cross the proto wire fails client-side with the
+    SAME error type/status the server path produces (review finding: it
+    leaked the server-internal ApiError, which GrpcAnalyst doesn't catch)."""
+    _, _, clients = stack
+    bad = {
+        "appName": "demo",
+        "strategy": "canary",
+        "metricsInfo": {"current": {"m": {"url": "http://x", "priority": "high"}}},
+    }
+    for c in clients.values():
+        with pytest.raises(DispatchError) as exc:
+            c.create(bad)
+        assert exc.value.status == 400

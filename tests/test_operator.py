@@ -6,6 +6,7 @@ path: deploy healthy v1, roll a bad v2, assert the monitor goes Unhealthy
 and the deployment auto-rolls back (docs/guides/installation.md:88-150).
 """
 import time
+import urllib.parse
 
 import numpy as np
 import pytest
@@ -547,7 +548,11 @@ def test_flagship_rollout_unhealthy_rollback_e2e():
 
     def resolver(url):
         # old pods (baseline) healthy, new pods (current) error storm;
-        # 7-day app-level history healthy
+        # 7-day app-level history healthy. Match on the DECODED url — the
+        # query is percent-encoded in the materialized URL, and an encoded
+        # 'pod%3D~' silently routed every fetch to the historical branch,
+        # leaving the verdict to band-check noise (seed-dependent).
+        url = urllib.parse.unquote(url)
         n_hist = 1440
         if "pod=~" in url and "p-new" in url:
             return (

@@ -306,3 +306,26 @@ def test_runtime_end_to_end(tmp_path, port):
         assert "foremastbrain:error5xx_anomaly" in m
     finally:
         rt.stop()
+
+
+def test_runtime_serves_grpc_when_enabled():
+    """Runtime.start(grpc_port=0) brings up the gRPC dispatch front on an
+    ephemeral port alongside HTTP; a create round-trips through it."""
+    from foremast_tpu.dataplane.fetch import FixtureDataSource
+    from foremast_tpu.runtime import Runtime
+    from foremast_tpu.service.grpc_api import DispatchClient
+
+    rt = Runtime(data_source=FixtureDataSource({}), cache=False)
+    rt.start(host="127.0.0.1", port=0, cycle_seconds=3600, grpc_port=0)
+    try:
+        assert rt.grpc_bound_port > 0
+        with DispatchClient(f"127.0.0.1:{rt.grpc_bound_port}") as c:
+            resp = c.create({
+                "appName": "rt-grpc",
+                "strategy": "canary",
+                "metricsInfo": {"current": {"m": {"url": "http://x"}}},
+            })
+            assert resp["status"] == "new"
+            assert c.status(resp["jobId"])["appName"] == "rt-grpc"
+    finally:
+        rt.stop()
