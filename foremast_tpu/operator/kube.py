@@ -17,6 +17,8 @@ from __future__ import annotations
 import json
 import os
 import ssl
+import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable
 
@@ -24,7 +26,13 @@ from .types import DeploymentMetadata, DeploymentMonitor
 
 
 class KubeError(Exception):
-    pass
+    """Kubernetes API failure; .status carries the HTTP code (0 = transport
+    error), so callers can tell not-found (404) from a broken apiserver —
+    treating a 500 as "missing" would make controllers recreate state."""
+
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
 
 
 class FakeKube:
@@ -63,7 +71,10 @@ class FakeKube:
     def patch_deployment(self, ns: str, name: str, patch: dict) -> dict:
         d = self.deployments.get((ns, name))
         if d is None:
-            raise KubeError(f"deployment {ns}/{name} not found")
+            # status=404 keeps the fake's error shape identical to
+            # KubeClient's, so `except KubeError as e: if e.status == 404`
+            # behaves the same against either seam
+            raise KubeError(f"deployment {ns}/{name} not found", status=404)
         _deep_merge(d, patch)
         self.patches.append(("deployment", ns, name, patch))
         self._notify("deployment", d)
@@ -104,7 +115,7 @@ class FakeKube:
         """Merge-PATCH a subset of a monitor (KubeClient contract)."""
         m = self.monitors.get((ns, name))
         if m is None:
-            raise KubeError(f"deploymentmonitor {ns}/{name} not found")
+            raise KubeError(f"deploymentmonitor {ns}/{name} not found", status=404)
         obj = _monitor_to_k8s(m)
         _deep_merge(obj, patch)
         merged = _monitor_from_k8s(obj)
@@ -186,13 +197,38 @@ class KubeClient:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout, context=self.ctx) as r:
                 return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            detail = e.read()[:300]
+            raise KubeError(
+                f"{method} {path}: HTTP {e.code}: {detail!r}", status=e.code
+            ) from e
         except Exception as e:  # noqa: BLE001 - API boundary
             raise KubeError(f"{method} {path}: {e}") from e
 
+    LIST_PAGE_LIMIT = 500
+
+    def _list(self, path: str) -> list[dict]:
+        """GET a collection in pages, following metadata.continue.
+
+        The limit parameter is load-bearing: a real apiserver only returns
+        continue tokens when the client asks for a page size, so without it
+        a 100k-object fleet comes back as one giant response."""
+        sep = "&" if "?" in path else "?"
+        items: list[dict] = []
+        token = ""
+        while True:
+            page = f"{path}{sep}limit={self.LIST_PAGE_LIMIT}"
+            if token:
+                page += "&continue=" + urllib.parse.quote(token, safe="")
+            obj = self._req("GET", page)
+            items += obj.get("items", [])
+            token = (obj.get("metadata") or {}).get("continue") or ""
+            if not token:
+                return items
+
     # -- namespaces --
     def list_namespaces(self) -> list[str]:
-        items = self._req("GET", "/api/v1/namespaces").get("items", [])
-        return [i["metadata"]["name"] for i in items]
+        return [i["metadata"]["name"] for i in self._list("/api/v1/namespaces")]
 
     def namespace_annotations(self, ns: str) -> dict:
         obj = self._req("GET", f"/api/v1/namespaces/{ns}")
@@ -202,11 +238,13 @@ class KubeClient:
     def get_deployment(self, ns: str, name: str) -> dict | None:
         try:
             return self._req("GET", f"/apis/apps/v1/namespaces/{ns}/deployments/{name}")
-        except KubeError:
-            return None
+        except KubeError as e:
+            if e.status == 404:
+                return None
+            raise
 
     def list_deployments(self, ns: str) -> list[dict]:
-        return self._req("GET", f"/apis/apps/v1/namespaces/{ns}/deployments").get("items", [])
+        return self._list(f"/apis/apps/v1/namespaces/{ns}/deployments")
 
     def patch_deployment(self, ns: str, name: str, patch: dict) -> dict:
         return self._req(
@@ -217,56 +255,82 @@ class KubeClient:
         )
 
     def list_replicasets(self, ns: str) -> list[dict]:
-        return self._req("GET", f"/apis/apps/v1/namespaces/{ns}/replicasets").get("items", [])
+        return self._list(f"/apis/apps/v1/namespaces/{ns}/replicasets")
 
     def list_pods(self, ns: str, selector: dict | None = None) -> list[dict]:
         sel = ""
         if selector:
             sel = "?labelSelector=" + ",".join(f"{k}%3D{v}" for k, v in selector.items())
-        return self._req("GET", f"/api/v1/namespaces/{ns}/pods{sel}").get("items", [])
+        return self._list(f"/api/v1/namespaces/{ns}/pods{sel}")
 
     def list_hpas(self, ns: str) -> list[dict]:
-        return self._req(
-            "GET", f"/apis/autoscaling/v2/namespaces/{ns}/horizontalpodautoscalers"
-        ).get("items", [])
+        return self._list(
+            f"/apis/autoscaling/v2/namespaces/{ns}/horizontalpodautoscalers"
+        )
 
     # -- CRDs --
     def _crd(self, ns: str, plural: str, name: str = "") -> str:
         path = f"/apis/{self.CRD_GROUP}/namespaces/{ns}/{plural}"
         return f"{path}/{name}" if name else path
 
+    def _upsert_crd(self, collection: str, path: str, patch_body: dict,
+                    post_body: dict) -> None:
+        """merge-PATCH, falling back to POST on not-found: no GET round-trip,
+        no resourceVersion bookkeeping, and no clobbering of fields this
+        caller didn't set. A lost create race (PATCH 404, POST 409) retries
+        the PATCH — the object exists now."""
+        try:
+            self._req(
+                "PATCH", path, patch_body,
+                content_type="application/merge-patch+json",
+            )
+        except KubeError as e:
+            if e.status != 404:
+                raise
+            try:
+                self._req("POST", collection, post_body)
+            except KubeError as e2:
+                if e2.status != 409:
+                    raise
+                self._req(
+                    "PATCH", path, patch_body,
+                    content_type="application/merge-patch+json",
+                )
+
+    def _delete_crd(self, path: str) -> None:
+        """Idempotent delete: a 404 is success, anything else surfaces."""
+        try:
+            self._req("DELETE", path)
+        except KubeError as e:
+            if e.status != 404:
+                raise
+
     def get_monitor(self, ns: str, name: str) -> DeploymentMonitor | None:
         try:
             obj = self._req("GET", self._crd(ns, "deploymentmonitors", name))
-        except KubeError:
-            return None
+        except KubeError as e:
+            if e.status == 404:
+                return None
+            raise
         return _monitor_from_k8s(obj)
 
     def list_monitors(self, ns: str | None = None) -> list[DeploymentMonitor]:
         if ns is None:
-            obj = self._req("GET", f"/apis/{self.CRD_GROUP}/deploymentmonitors")
+            items = self._list(f"/apis/{self.CRD_GROUP}/deploymentmonitors")
         else:
-            obj = self._req("GET", self._crd(ns, "deploymentmonitors"))
-        return [_monitor_from_k8s(i) for i in obj.get("items", [])]
+            items = self._list(self._crd(ns, "deploymentmonitors"))
+        return [_monitor_from_k8s(i) for i in items]
 
     def upsert_monitor(self, monitor: DeploymentMonitor) -> DeploymentMonitor:
         path = self._crd(monitor.namespace, "deploymentmonitors", monitor.name)
         body = _monitor_to_k8s(monitor)
-        # merge-PATCH spec+metadata, falling back to POST on not-found: no
-        # GET round-trip, no resourceVersion bookkeeping, and no clobbering
-        # of fields this caller didn't set
-        try:
-            self._req(
-                "PATCH",
-                path,
-                {"metadata": {"annotations": body["metadata"]["annotations"]},
-                 "spec": body["spec"]},
-                content_type="application/merge-patch+json",
-            )
-        except KubeError:
-            self._req(
-                "POST", self._crd(monitor.namespace, "deploymentmonitors"), body
-            )
+        self._upsert_crd(
+            self._crd(monitor.namespace, "deploymentmonitors"),
+            path,
+            {"metadata": {"annotations": body["metadata"]["annotations"]},
+             "spec": body["spec"]},
+            body,
+        )
         # status is a subresource (deploy/crds/deploymentmonitor.yaml): the
         # write above silently DROPS .status, so persist it with a separate
         # PATCH against /status or phases/verdicts never survive in-cluster
@@ -277,8 +341,9 @@ class KubeClient:
                 {"status": body["status"]},
                 content_type="application/merge-patch+json",
             )
-        except KubeError:
-            pass  # CRD installed without the status subresource
+        except KubeError as e:
+            if e.status != 404:
+                raise  # only tolerate a CRD installed without the subresource
         return monitor
 
     def patch_monitor(self, ns: str, name: str, patch: dict) -> None:
@@ -294,26 +359,37 @@ class KubeClient:
         )
 
     def delete_monitor(self, ns: str, name: str):
-        try:
-            self._req("DELETE", self._crd(ns, "deploymentmonitors", name))
-        except KubeError:
-            pass
+        self._delete_crd(self._crd(ns, "deploymentmonitors", name))
 
     def get_metadata(self, ns: str, name: str) -> DeploymentMetadata | None:
         try:
             obj = self._req("GET", self._crd(ns, "deploymentmetadatas", name))
-        except KubeError:
-            return None
+        except KubeError as e:
+            if e.status == 404:
+                return None
+            raise
         return _metadata_from_k8s(obj)
 
     def upsert_metadata(self, md: DeploymentMetadata) -> DeploymentMetadata:
-        raise NotImplementedError("metadata is user-managed in-cluster")
+        """Create-or-replace a DeploymentMetadata record.
+
+        The reference operator both writes and deletes metadata
+        (DeploymentController.go:381-407), and the shipped default-metadata
+        flow (deploy/stack/50-deployment-metadata-default.yaml) expects the
+        operator to be able to stamp per-app records. No status subresource
+        on this CRD — one merge-PATCH (or POST on first write) suffices.
+        """
+        body = _metadata_to_k8s(md)
+        self._upsert_crd(
+            self._crd(md.namespace, "deploymentmetadatas"),
+            self._crd(md.namespace, "deploymentmetadatas", md.name),
+            {"spec": body["spec"]},
+            body,
+        )
+        return md
 
     def delete_metadata(self, ns: str, name: str):
-        try:
-            self._req("DELETE", self._crd(ns, "deploymentmetadatas", name))
-        except KubeError:
-            pass
+        self._delete_crd(self._crd(ns, "deploymentmetadatas", name))
 
     def record_event(self, kind: str, ns: str, name: str, reason: str, message: str):
         # K8s Events API; failures are non-fatal observability loss
@@ -341,27 +417,31 @@ class KubeClient:
 
 # --- CRD JSON codecs (camelCase wire shape of deploy/crds/*.yaml) ---
 
+_CAMEL_TABLE = {
+    "start_time": "startTime", "wait_until": "waitUntil",
+    "rollback_revision": "rollbackRevision",
+    "hpa_score_template": "hpaScoreTemplate",
+    "hpa_score_templates": "hpaScoreTemplates",
+    "data_source_type": "dataSourceType",
+    "metric_name": "metricName", "metric_type": "metricType",
+    "metric_alias": "metricAlias",
+    "observed_generation": "observedGeneration", "job_id": "jobId",
+    "remediation_taken": "remediationTaken",
+    "hpa_score_enabled": "hpaScoreEnabled", "hpa_logs": "hpaLogs",
+    "anomalous_metrics": "anomalousMetrics",
+}
+
+
+def _camel(d):
+    if isinstance(d, dict):
+        return {_CAMEL_TABLE.get(k, k): _camel(v) for k, v in d.items()}
+    if isinstance(d, list):
+        return [_camel(v) for v in d]
+    return d
+
+
 def _monitor_to_k8s(m: DeploymentMonitor) -> dict:
     from dataclasses import asdict
-
-    def camel(d):
-        table = {
-            "start_time": "startTime", "wait_until": "waitUntil",
-            "rollback_revision": "rollbackRevision",
-            "hpa_score_template": "hpaScoreTemplate",
-            "data_source_type": "dataSourceType",
-            "metric_name": "metricName", "metric_type": "metricType",
-            "metric_alias": "metricAlias",
-            "observed_generation": "observedGeneration", "job_id": "jobId",
-            "remediation_taken": "remediationTaken",
-            "hpa_score_enabled": "hpaScoreEnabled", "hpa_logs": "hpaLogs",
-            "anomalous_metrics": "anomalousMetrics",
-        }
-        if isinstance(d, dict):
-            return {table.get(k, k): camel(v) for k, v in d.items()}
-        if isinstance(d, list):
-            return [camel(v) for v in d]
-        return d
 
     return {
         "apiVersion": KubeClient.CRD_GROUP,
@@ -371,8 +451,22 @@ def _monitor_to_k8s(m: DeploymentMonitor) -> dict:
             "namespace": m.namespace,
             "annotations": m.annotations,
         },
-        "spec": camel(asdict(m.spec)),
-        "status": camel(asdict(m.status)),
+        "spec": _camel(asdict(m.spec)),
+        "status": _camel(asdict(m.status)),
+    }
+
+
+def _metadata_to_k8s(md: DeploymentMetadata) -> dict:
+    from dataclasses import asdict
+
+    d = asdict(md)
+    d.pop("name", None)
+    d.pop("namespace", None)
+    return {
+        "apiVersion": KubeClient.CRD_GROUP,
+        "kind": "DeploymentMetadata",
+        "metadata": {"name": md.name, "namespace": md.namespace},
+        "spec": _camel(d),
     }
 
 

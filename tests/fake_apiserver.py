@@ -1,0 +1,297 @@
+"""In-process HTTP stand-in for the slice of the Kubernetes REST API that
+KubeClient speaks.
+
+FakeKube (foremast_tpu.operator.kube) is the *logic* seam for controller
+tests; this is the *wire* seam — the answer the reference got from its
+generated fake clientsets (foremast-barrelman/pkg/client/clientset/
+versioned/fake/clientset_generated.go). It validates what fakes can't:
+
+  * patch content-type handling (merge-patch vs strategic-merge vs 415),
+  * the status-subresource contract: plain writes to a subresource'd CRD
+    silently DROP .status; only /status writes persist it (the 761c95c
+    bug class),
+  * real status codes: 401 (bad token), 404, 409 on create conflicts,
+  * list pagination via metadata.continue (page_cap forces multi-page
+    lists even when the client asks for everything),
+  * label selectors on pod lists.
+
+Storage is plain dicts in the K8s JSON shape. Strategic-merge is
+approximated as a deep merge (no list-key merging — KubeClient's patches
+replace whole lists, so the approximation is exact for this client).
+RFC 7386 null-deletes are honored for merge-patch.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# plurals whose status is a subresource (deploy/crds/deploymentmonitor.yaml)
+STATUS_SUBRESOURCE = {"deploymentmonitors"}
+
+PATCH_TYPES = {
+    "application/merge-patch+json",
+    "application/strategic-merge-patch+json",
+    "application/json-patch+json",
+}
+
+
+class ApiState:
+    """Shared mutable cluster state."""
+
+    def __init__(self, token: str = "test-token", page_cap: int | None = None):
+        self.token = token
+        self.page_cap = page_cap
+        # (api_group_version, namespace, plural) -> {name: obj}
+        self.objects: dict[tuple, dict[str, dict]] = {}
+        self.namespaces: dict[str, dict] = {"default": {"metadata": {"name": "default"}}}
+        self.events: list[dict] = []
+        self.requests: list[tuple] = []  # audit: (method, path, content_type)
+        self.fail_next: int | None = None  # force an error code once
+        self.lock = threading.Lock()
+
+    def bucket(self, gv: str, ns: str, plural: str) -> dict:
+        return self.objects.setdefault((gv, ns, plural), {})
+
+    def put(self, gv: str, ns: str, plural: str, obj: dict):
+        name = obj["metadata"]["name"]
+        obj["metadata"].setdefault("namespace", ns)
+        self.bucket(gv, ns, plural)[name] = obj
+
+    def all_namespaced(self, gv: str, plural: str) -> list[dict]:
+        out = []
+        for (g, _ns, p), items in sorted(self.objects.items()):
+            if g == gv and p == plural:
+                out += items.values()
+        return out
+
+
+def _merge(dst: dict, patch: dict):
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)  # RFC 7386
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+class _Err(Exception):
+    def __init__(self, code: int, reason: str):
+        super().__init__(reason)
+        self.code = code
+        self.reason = reason
+
+
+def make_apiserver(state: ApiState | None = None):
+    """Returns (server, state); server binds an ephemeral port."""
+    st = state or ApiState()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        # -- plumbing ----------------------------------------------------
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def _authed(self):
+            auth = self.headers.get("Authorization", "")
+            if auth != f"Bearer {st.token}":
+                raise _Err(401, "Unauthorized")
+
+        def _route(self):
+            """-> (gv, ns|None, plural, name|None, subresource|None, query)"""
+            parsed = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(parsed.query)
+            parts = [p for p in parsed.path.split("/") if p]
+            if parts[0] == "api" and parts[1] == "v1":
+                gv, rest = "v1", parts[2:]
+            elif parts[0] == "apis":
+                gv, rest = f"{parts[1]}/{parts[2]}", parts[3:]
+            else:
+                raise _Err(404, f"unknown path {parsed.path}")
+            if rest[:1] == ["namespaces"]:
+                if len(rest) == 1:
+                    return gv, None, "namespaces", None, None, q
+                if len(rest) == 2:
+                    return gv, None, "namespaces", rest[1], None, q
+                ns, plural = rest[1], rest[2]
+                name = rest[3] if len(rest) > 3 else None
+                sub = rest[4] if len(rest) > 4 else None
+                return gv, ns, plural, name, sub, q
+            # cluster-scope collection (e.g. all-namespace CRD list)
+            return gv, None, rest[0], rest[1] if len(rest) > 1 else None, None, q
+
+        def _dispatch(self, method: str):
+            ct = self.headers.get("Content-Type", "")
+            st.requests.append((method, self.path, ct))
+            try:
+                if st.fail_next is not None:
+                    code, st.fail_next = st.fail_next, None
+                    raise _Err(code, "injected failure")
+                self._authed()
+                with st.lock:
+                    self._handle(method, ct)
+            except _Err as e:
+                self._send(
+                    e.code,
+                    {"kind": "Status", "status": "Failure", "code": e.code,
+                     "message": e.reason},
+                )
+
+        def _paginate(self, items: list[dict], q: dict) -> dict:
+            limit = int(q.get("limit", ["0"])[0]) or st.page_cap
+            start = int(q.get("continue", ["0"])[0] or 0)
+            meta: dict = {}
+            if limit and start + limit < len(items):
+                meta["continue"] = str(start + limit)
+                page = items[start:start + limit]
+            else:
+                page = items[start:]
+            return {"kind": "List", "metadata": meta, "items": page}
+
+        # -- semantics ---------------------------------------------------
+        def _handle(self, method: str, ct: str):
+            gv, ns, plural, name, sub, q = self._route()
+
+            # namespaces (cluster-scoped)
+            if plural == "namespaces":
+                if method != "GET":
+                    raise _Err(405, "namespaces are read-only here")
+                if name is None:
+                    items = sorted(st.namespaces.values(),
+                                   key=lambda o: o["metadata"]["name"])
+                    return self._send(200, self._paginate(items, q))
+                obj = st.namespaces.get(name)
+                if obj is None:
+                    raise _Err(404, f"namespace {name} not found")
+                return self._send(200, obj)
+
+            # events sink
+            if plural == "events" and method == "POST":
+                st.events.append(self._body())
+                return self._send(201, {})
+
+            # cluster-scope CRD list
+            if ns is None:
+                if method != "GET" or name is not None:
+                    raise _Err(405, "cluster scope: list only")
+                items = st.all_namespaced(gv, plural)
+                return self._send(200, self._paginate(items, q))
+
+            bucket = st.bucket(gv, ns, plural)
+            has_status_sub = plural in STATUS_SUBRESOURCE
+            if sub not in (None, "status"):
+                raise _Err(404, f"unknown subresource {sub}")
+            if sub == "status" and not has_status_sub:
+                raise _Err(404, f"{plural} has no status subresource")
+
+            if method == "GET":
+                if name is None:
+                    sel = q.get("labelSelector", [""])[0]
+                    items = sorted(bucket.values(),
+                                   key=lambda o: o["metadata"]["name"])
+                    if sel:
+                        want = dict(
+                            kv.split("=", 1)
+                            for kv in urllib.parse.unquote(sel).split(",")
+                        )
+                        items = [
+                            o for o in items
+                            if all(
+                                (o["metadata"].get("labels") or {}).get(k) == v
+                                for k, v in want.items()
+                            )
+                        ]
+                    return self._send(200, self._paginate(items, q))
+                obj = bucket.get(name)
+                if obj is None:
+                    raise _Err(404, f"{plural}/{name} not found")
+                return self._send(200, obj)
+
+            if method == "POST":
+                body = self._body()
+                new_name = (body.get("metadata") or {}).get("name", "")
+                if not new_name:
+                    raise _Err(422, "metadata.name required")
+                if new_name in bucket:
+                    raise _Err(409, f"{plural}/{new_name} already exists")
+                if has_status_sub:
+                    body.pop("status", None)  # the subresource contract
+                st.put(gv, ns, plural, body)
+                return self._send(201, body)
+
+            if method == "PATCH":
+                if ct not in PATCH_TYPES:
+                    raise _Err(415, f"unsupported patch content-type {ct!r}")
+                if ct == "application/json-patch+json":
+                    raise _Err(415, "json-patch not supported by this stand-in")
+                if name is None or name not in bucket:
+                    raise _Err(404, f"{plural}/{name} not found")
+                patch = self._body()
+                obj = bucket[name]
+                if sub == "status":
+                    _merge(obj, {"status": patch.get("status", {})})
+                else:
+                    if has_status_sub:
+                        patch.pop("status", None)  # dropped, never merged
+                    _merge(obj, patch)
+                return self._send(200, obj)
+
+            if method == "PUT":
+                body = self._body()
+                if name is None or name not in bucket:
+                    raise _Err(404, f"{plural}/{name} not found")
+                if sub == "status":
+                    bucket[name]["status"] = body.get("status", {})
+                    return self._send(200, bucket[name])
+                if has_status_sub:
+                    # replace spec/metadata; keep the stored status
+                    body["status"] = bucket[name].get("status", {})
+                st.put(gv, ns, plural, body)
+                return self._send(200, body)
+
+            if method == "DELETE":
+                if name is None or name not in bucket:
+                    raise _Err(404, f"{plural}/{name} not found")
+                del bucket[name]
+                return self._send(200, {"kind": "Status", "status": "Success"})
+
+            raise _Err(405, f"method {method}")
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+        def do_PATCH(self):
+            self._dispatch("PATCH")
+
+        def do_PUT(self):
+            self._dispatch("PUT")
+
+        def do_DELETE(self):
+            self._dispatch("DELETE")
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    return server, st
+
+
+def serve_apiserver(state: ApiState | None = None):
+    """Start in background; returns (base_url, state, server)."""
+    server, st = make_apiserver(state)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return f"http://127.0.0.1:{server.server_address[1]}", st, server

