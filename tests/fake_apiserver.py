@@ -151,7 +151,11 @@ def make_apiserver(state: ApiState | None = None):
                 )
 
         def _paginate(self, items: list[dict], q: dict) -> dict:
-            limit = int(q.get("limit", ["0"])[0]) or st.page_cap
+            # A real apiserver serves min(client limit, server page cap):
+            # the client cannot ask for pages larger than the server allows.
+            client_limit = int(q.get("limit", ["0"])[0])
+            caps = [x for x in (client_limit, st.page_cap) if x]
+            limit = min(caps) if caps else 0
             start = int(q.get("continue", ["0"])[0] or 0)
             meta: dict = {}
             if limit and start + limit < len(items):
