@@ -1,24 +1,36 @@
-"""Benchmark: canary metric-pair scoring throughput on the fused TPU program.
+"""Benchmark: canary metric-pair scoring, shaped like the north-star claim.
 
 North star (BASELINE.json / BASELINE.md): score 100k concurrent
-(baseline, canary) metric-pair windows in <1 s p99 on a v5e-8 — i.e.
-12,500 pairs/s/chip. This bench runs the single-chip fused scorer
-(pairwise test family + forecast-band check, parallel/fleet.py) on
-realistic windows (T=128 ≈ 2h of 60s-step points — wider than the
-reference's 10-min canary window) and reports pairs scored per second
-per chip. vs_baseline = value / 12500 (>1.0 beats the 8-chip-in-1s
-target pro-rated to one chip).
+(baseline, canary) metric-pair windows in <1 s **p99** on a v5e-8.
+The engine shards the fleet batch evenly over the 8-chip fleet axis
+(parallel/fleet.py:make_fleet_scorer), so each chip scores exactly
+B_total/8 = 12,500 pairs; the scoring itself is embarrassingly parallel
+(the only cross-chip traffic is the O(k*n_chips) verdict reduction).
+This bench therefore runs the per-chip shard — B=12,500 pairs, T=128
+(~2h of 60s-step points, wider than the reference's 10-min canary
+window) — on the one available chip and pro-rates explicitly: the wall
+time of one chip's shard IS the fleet's time to 100k, up to the top-k
+reduction, which is measured separately on the 8-device dryrun mesh.
+
+Protocol (VERDICT r02 #2): p99 over >=100 timed runs (default 150,
+override BENCH_RUNS); compile time reported separately; min/max/std
+included so round-over-round drift in the headline is characterized
+instead of mysterious.
 
 Prints exactly one JSON line.
 """
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
-TARGET_PAIRS_PER_SEC_PER_CHIP = 100_000 / 8.0  # BASELINE.json north star, per chip
+TARGET_PAIRS_PER_SEC_PER_CHIP = 100_000 / 8.0  # north star pro-rated per chip
+B_TOTAL = 100_000
+N_CHIPS = 8
+B_CHIP = B_TOTAL // N_CHIPS  # 12,500: one chip's shard of the 100k fleet
 
 
 def main() -> None:
@@ -26,7 +38,7 @@ def main() -> None:
 
     from foremast_tpu.parallel.fleet import score_pairs
 
-    B, T = 8192, 128
+    B, T = B_CHIP, 128
     rng = np.random.default_rng(0)
     baseline = rng.normal(10.0, 2.0, (B, T)).astype(np.float32)
     current = rng.normal(10.0, 2.0, (B, T)).astype(np.float32)
@@ -49,19 +61,37 @@ def main() -> None:
         jax.block_until_ready(out["unhealthy"])
         return out
 
-    run()  # compile
+    t0 = time.perf_counter()
+    run()  # compile + first execute
+    compile_s = time.perf_counter() - t0
+
+    n_runs = int(os.environ.get("BENCH_RUNS", "150"))
     times = []
-    for _ in range(10):
+    for _ in range(n_runs):
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
-    p50 = float(np.median(times))
+    ts = np.sort(np.asarray(times))
+    p50 = float(np.median(ts))
+    p99 = float(np.percentile(ts, 99))
     pairs_per_sec = B / p50
     print(json.dumps({
         "metric": "canary_pairs_scored_per_sec_per_chip",
         "value": round(pairs_per_sec, 1),
         "unit": "pairs/s/chip",
         "vs_baseline": round(pairs_per_sec / TARGET_PAIRS_PER_SEC_PER_CHIP, 3),
+        # the claim, measured in its own shape: time for one chip's 12,500-pair
+        # shard of the 100k fleet batch == fleet time to 100k on v5e-8
+        # (pro-rated; the O(k*8) top-k reduction is excluded — see docstring)
+        "p99_s_at_100k": round(p99, 6),
+        "p50_s_at_100k": round(p50, 6),
+        "min_s": round(float(ts[0]), 6),
+        "max_s": round(float(ts[-1]), 6),
+        "std_s": round(float(np.std(ts)), 6),
+        "runs": n_runs,
+        "batch_per_chip": B,
+        "compile_s": round(compile_s, 3),
+        "backend": jax.default_backend(),
     }))
 
 
