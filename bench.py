@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -33,7 +35,40 @@ N_CHIPS = 8
 B_CHIP = B_TOTAL // N_CHIPS  # 12,500: one chip's shard of the 100k fleet
 
 
+def _cycle_bench() -> dict:
+    """Host-path numbers: a 10k-job cycle through analyzer.run_cycle with
+    the native parser on vs off (foremast_tpu/bench_cycle.py). One
+    subprocess per variant (FOREMAST_NATIVE latches at first load),
+    CPU-pinned so they never contend for the parent's TPU grant — the
+    host path is what these measure; the device bound is the headline."""
+    extra: dict = {}
+    for flag, key in (("1", "native"), ("0", "python")):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["FOREMAST_NATIVE"] = flag
+        env.setdefault("BENCH_CYCLE_JOBS", "10000")
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "foremast_tpu.bench_cycle"],
+                capture_output=True, text=True, timeout=900, env=env,
+                check=True, cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            rec = json.loads(out.stdout.strip().splitlines()[-1])
+            extra[f"cycle_jobs_per_sec_{key}"] = rec["value"]
+            extra[f"cycle_preprocess_s_{key}"] = rec["preprocess_s_per_cycle"]
+        except Exception as e:  # noqa: BLE001 - the headline must still print
+            extra[f"cycle_error_{key}"] = f"{type(e).__name__}: {e}"
+    nat = extra.get("cycle_preprocess_s_native")
+    py = extra.get("cycle_preprocess_s_python")
+    if nat and py:
+        extra["cycle_native_preprocess_speedup"] = round(py / nat, 2)
+    return extra
+
+
 def main() -> None:
+    cycle_extra = _cycle_bench()
+
     import jax
 
     from foremast_tpu.parallel.fleet import score_pairs
@@ -92,6 +127,7 @@ def main() -> None:
         "batch_per_chip": B,
         "compile_s": round(compile_s, 3),
         "backend": jax.default_backend(),
+        **cycle_extra,
     }))
 
 

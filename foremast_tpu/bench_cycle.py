@@ -1,0 +1,137 @@
+"""Host-path cycle benchmark: fetch -> parse -> resample -> pack -> score -> verdict.
+
+The device kernel's pairs/s (bench.py headline) bounds only the score
+stage; at fleet scale the reference brain spent its cycle on the host
+(ES poll, HTTP fetch, JSON parse, pandas resample — SURVEY.md §3.1,
+foremast-brain's worker loop). This bench measures OUR host path: a
+synthetic fleet of N pair jobs whose canned Prometheus query_range
+responses flow through the production parse path
+(dataplane.fetch.RawFixtureDataSource) and Analyzer.run_cycle to
+verdict writes and the snapshot flush.
+
+Run as a module; prints ONE JSON line on stdout:
+
+    FOREMAST_NATIVE=0|1 BENCH_CYCLE_JOBS=10000 python -m foremast_tpu.bench_cycle
+
+bench.py runs it twice — native parser on and off — and merges both
+numbers into the headline bench line. FOREMAST_NATIVE is latched at the
+first native-library load, which is why each variant needs its own
+process. Scoring runs wherever JAX lands (bench.py pins the
+subprocesses to CPU so they never contend with the parent's TPU grant);
+the device-side bound is bench.py's own headline measurement.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+
+def _prom_body(ts0: int, values, step: int = 60) -> bytes:
+    """A Prometheus query_range matrix response (values serialized as
+    strings, as the real API does)."""
+    vals = [[ts0 + i * step, f"{v:.4f}"] for i, v in enumerate(values)]
+    return json.dumps(
+        {
+            "status": "success",
+            "data": {
+                "resultType": "matrix",
+                "result": [
+                    {"metric": {"__name__": "namespace_app_http_errors_5xx"},
+                     "values": vals}
+                ],
+            },
+        }
+    ).encode()
+
+
+def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128) -> dict:
+    import numpy as np
+
+    from .dataplane.fetch import RawFixtureDataSource
+    from .engine import jobs as J
+    from .engine.analyzer import Analyzer
+    from .engine.config import EngineConfig
+    from . import native
+    from .utils import tracing
+    from .utils.timeutils import to_rfc3339
+
+    t_end = int(time.time()) // 60 * 60
+    ts0 = t_end - window_steps * 60
+    rng = np.random.default_rng(7)
+    # 64 distinct series shapes; baseline and current of one job share a
+    # body (identical samples -> provably healthy -> the fleet requeues
+    # intact every cycle, keeping jobs/s denominators comparable)
+    bodies = [
+        _prom_body(ts0, 10.0 + rng.normal(0.0, 2.0, window_steps))
+        for _ in range(64)
+    ]
+
+    def resolver(url: str) -> bytes:
+        i = int(url.rsplit("job=", 1)[1].split("&", 1)[0])
+        return bodies[i % len(bodies)]
+
+    source = RawFixtureDataSource(resolver=resolver)
+    docs = []
+    for i in range(n_jobs):
+        docs.append(
+            J.Document(
+                id=f"bench-{i}",
+                app_name=f"app-{i % 128}",
+                namespace="bench",
+                strategy="canary",
+                start_time=to_rfc3339(t_end - 3600),
+                end_time=to_rfc3339(t_end + 86_400),
+                metrics={
+                    "http_errors_5xx": J.MetricQueries(
+                        current=f"http://prom/q?job={i}&w=cur",
+                        baseline=f"http://prom/q?job={i}&w=base",
+                    )
+                },
+            )
+        )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store = J.JobStore(snapshot_path=os.path.join(tmp, "jobs.json"))
+        for d in docs:
+            store.create(d)
+        engine = Analyzer(EngineConfig(), source, store)
+
+        out = engine.run_cycle(now=t_end)  # warmup: jit compile + caches
+        not_requeued = sum(1 for s in out.values() if s != J.INITIAL)
+        tracing.tracer.reset()
+        source.requests.clear()
+
+        t0 = time.perf_counter()
+        for _ in range(cycles):
+            engine.run_cycle(now=t_end)
+        wall = time.perf_counter() - t0
+
+    stats = tracing.tracer.stats()
+    per_cycle = lambda name: round(  # noqa: E731
+        stats.get(name, {}).get("total_seconds", 0.0) / cycles, 4
+    )
+    return {
+        "metric": "engine_cycle_jobs_per_sec",
+        "value": round(n_jobs * cycles / wall, 1),
+        "unit": "jobs/s",
+        "native": native.available(),
+        "jobs": n_jobs,
+        "cycles": cycles,
+        "fetches_per_cycle": len(source.requests) // max(cycles, 1),
+        "preprocess_s_per_cycle": per_cycle("engine.preprocess"),
+        "score_s_per_cycle": per_cycle("engine.score"),
+        "wall_s": round(wall, 3),
+        "unhealthy_or_terminal": not_requeued,
+    }
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_CYCLE_JOBS", "10000"))
+    cycles = int(os.environ.get("BENCH_CYCLE_REPS", "2"))
+    print(json.dumps(run(n, cycles)))
+
+
+if __name__ == "__main__":
+    main()

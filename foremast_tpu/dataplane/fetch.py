@@ -12,6 +12,8 @@ open job. Sources are pluggable:
   * FixtureDataSource — dict/url -> series or a callable; the test/demo seam
     (the reference's equivalent seam was the injectable HTTP DoFunc,
     foremast-barrelman/pkg/client/analyst/analystclient.go:24).
+  * RawFixtureDataSource — dict/url -> raw response BYTES through the real
+    parse path; the seam for parser-sensitive benchmarks and tests.
 
 All sources return (timestamps, values) sequences (lists, or numpy arrays
 when the native parser handled the response).
@@ -49,6 +51,34 @@ def _avg_series(series: list[list[tuple[float, float]]]):
     return out_ts, [sum(acc[t]) / len(acc[t]) for t in out_ts]
 
 
+def parse_prometheus_body(raw: bytes):
+    """Response body -> (ts, vals); native fast path with Python fallback.
+
+    Fast path: single-pass native scan (no DOM). The status probe only
+    scans a prefix: Prometheus serializes the top-level "status" first,
+    and a full-body scan would false-positive on series whose LABELS
+    contain status="error" (common on the error metrics we monitor),
+    permanently disabling the fast path for them. Error responses also
+    arrive with non-2xx codes (the transport raised before reaching
+    here) — this probe is belt-and-braces for proxies that flatten the
+    status code.
+    """
+    head = raw[:256]
+    if b'"status":"error"' not in head and b'"status": "error"' not in head:
+        parsed = native.parse_series(raw, native.FLAVOR_PROMETHEUS)
+        if parsed is not None:
+            return parsed
+    payload = json.loads(raw)
+    if payload.get("status") not in (None, "success"):
+        raise FetchError(f"prometheus error: {payload}")
+    result = payload.get("data", {}).get("result", [])
+    series = [
+        [(float(ts), float(v)) for ts, v in item.get("values", [])]
+        for item in result
+    ]
+    return _avg_series(series)
+
+
 class PrometheusDataSource:
     def __init__(self, timeout: float = 10.0):
         self.timeout = timeout
@@ -59,27 +89,7 @@ class PrometheusDataSource:
                 raw = r.read()
         except Exception as e:  # noqa: BLE001 - network boundary
             raise FetchError(f"prometheus fetch failed: {e}") from e
-        # fast path: single-pass native scan (no DOM). The status probe only
-        # scans a prefix: Prometheus serializes the top-level "status" first,
-        # and a full-body scan would false-positive on series whose LABELS
-        # contain status="error" (common on the error metrics we monitor),
-        # permanently disabling the fast path for them. Error responses also
-        # arrive with non-2xx codes (urlopen raised above) — this probe is
-        # belt-and-braces for proxies that flatten the status code.
-        head = raw[:256]
-        if b'"status":"error"' not in head and b'"status": "error"' not in head:
-            parsed = native.parse_series(raw, native.FLAVOR_PROMETHEUS)
-            if parsed is not None:
-                return parsed
-        payload = json.loads(raw)
-        if payload.get("status") not in (None, "success"):
-            raise FetchError(f"prometheus error: {payload}")
-        result = payload.get("data", {}).get("result", [])
-        series = [
-            [(float(ts), float(v)) for ts, v in item.get("values", [])]
-            for item in result
-        ]
-        return _avg_series(series)
+        return parse_prometheus_body(raw)
 
 
 class WavefrontDataSource:
@@ -105,6 +115,32 @@ class WavefrontDataSource:
             for item in payload.get("timeseries", [])
         ]
         return _avg_series(series)
+
+
+class RawFixtureDataSource:
+    """URL -> canned raw Prometheus response BYTES, parsed through the same
+    path as the live source (native scanner + Python fallback).
+
+    FixtureDataSource hands the engine pre-parsed series, which is right
+    for logic tests but skips the parse stage entirely; this source keeps
+    the parse in the loop, so parser-sensitive paths (bench_cycle's
+    FOREMAST_NATIVE comparison, parser regression tests) exercise the
+    production code without a network."""
+
+    def __init__(self, pages: dict | None = None,
+                 resolver: Callable[[str], bytes] | None = None):
+        self.pages = {} if pages is None else pages
+        self.resolver = resolver
+        self.requests: list[str] = []
+
+    def fetch(self, url: str):
+        self.requests.append(url)
+        raw = self.pages.get(url)
+        if raw is None and self.resolver is not None:
+            raw = self.resolver(url)
+        if raw is None:
+            raise FetchError(f"no fixture page for {url}")
+        return parse_prometheus_body(raw)
 
 
 class FixtureDataSource:

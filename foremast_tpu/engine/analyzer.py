@@ -660,7 +660,9 @@ class Analyzer:
         now = time.time() if now is None else now
         with tracing.span("engine.claim"):
             claimed = self.store.claim_open_jobs(
-                worker, max_stuck_seconds=self.config.max_stuck_seconds
+                worker,
+                limit=self.config.max_claim_per_cycle,
+                max_stuck_seconds=self.config.max_stuck_seconds,
             )
         states: dict[str, _JobState] = {}
         all_pairs: list[_PairItem] = []

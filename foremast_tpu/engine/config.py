@@ -49,6 +49,11 @@ class EngineConfig:
     min_friedman_points: int = 5  # MIN_FRIEDMAN_DATA_POINTS (paired blocks)
     max_stuck_seconds: float = 90.0  # MAX_STUCK_IN_SECONDS
     max_cache_size: int = 1024  # MAX_CACHE_SIZE (model/window cache entries)
+    # jobs leased per cycle (MAX_CLAIM_PER_CYCLE). The batched cycle scores
+    # every claimed job in one device program per bucket, so this is the
+    # fleet batch size, not a per-worker work-queue depth; at 100k-fleet
+    # scale the default must not silently cap the cycle.
+    max_claim_per_cycle: int = 100_000
     ma_window: int = 30  # moving-average lookback (steps)
     # windows at/above this length use the time-parallel associative-scan
     # SES smoother (ops/seqscan.py) instead of sequential lax.scan; DES
@@ -159,6 +164,7 @@ def from_env(env=None) -> EngineConfig:
         min_friedman_points=_env_int(env, "MIN_FRIEDMAN_DATA_POINTS", 5),
         max_stuck_seconds=_env_float(env, "MAX_STUCK_IN_SECONDS", 90.0),
         max_cache_size=_env_int(env, "MAX_CACHE_SIZE", 1024),
+        max_claim_per_cycle=_env_int(env, "MAX_CLAIM_PER_CYCLE", 100_000),
         ma_window=_env_int(env, "MA_WINDOW", 30),
         long_window_steps=_env_int(env, "LONG_WINDOW_STEPS", 4096),
         hw_period=_env_int(env, "HW_PERIOD", 1440),

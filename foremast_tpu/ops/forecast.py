@@ -92,9 +92,13 @@ def _moving_average_1d(x, mask, window: int):
     Time-based, not count-based: a gap shrinks the sample, it does not pull
     older points into the window — a 5-step MA always looks back 5 minutes at
     a 60 s step, matching how the brain's moving-average band tracks recency.
-    When the whole window is a gap, the prediction falls back to the most
-    recent valid value strictly before t (causal forward-fill); only slots
-    before the first observation ever see the first valid value.
+    When the whole window is a gap, the prediction freezes at the most
+    recent DEFINED rolling mean, not the last raw sample: band checks
+    extrapolate this prediction across the whole judged region, and a
+    single noisy final observation anchoring every extrapolated step
+    inflates the false-positive rate by an order of magnitude (a last
+    sample 2 sigma low condemns ~half of an identical current window).
+    Only slots before the first observation see the first valid value.
     """
     T = x.shape[0]
     xf = x.astype(_F)
@@ -106,13 +110,20 @@ def _moving_average_1d(x, mask, window: int):
     lo = jnp.maximum(t - window, 0)
     s = csum[t] - csum[lo]
     c = ccnt[t] - ccnt[lo]
-    # causal forward-fill: value at the last valid index strictly before t
+    ma = s / jnp.where(c == 0, 1.0, c)
+    defined = c > 0
+    # freeze-fill at the rolling mean evaluated just AFTER the last
+    # observation, where the window still holds up to `window` trailing
+    # points. (Freezing at the last slot whose window held ANY data would
+    # re-anchor to the final sample alone: that window has slid to a
+    # single point.)
     idx = jnp.where(mask, t, -1)
     last_le = lax.cummax(idx)  # last valid index <= t
     prev_idx = jnp.concatenate([jnp.full((1,), -1), last_le[:-1]])
+    t0 = jnp.minimum(prev_idx + 1, T - 1)
     first = _first_valid(x, mask)
-    fallback = jnp.where(prev_idx >= 0, xf[jnp.maximum(prev_idx, 0)], first)
-    return jnp.where(c > 0, s / jnp.where(c == 0, 1.0, c), fallback)
+    filled = jnp.where(prev_idx >= 0, ma[t0], first)
+    return jnp.where(defined, ma, filled)
 
 
 def _ses_1d(x, mask, alpha):

@@ -1,0 +1,49 @@
+"""The host-path cycle benchmark is itself product surface: the driver
+runs it inside bench.py every round. Pin its contract — a steady
+synthetic fleet (healthy jobs requeue forever), raw fixture bytes
+flowing through the production Prometheus parse path, and sane stats."""
+import json
+
+import numpy as np
+import pytest
+
+from foremast_tpu import bench_cycle
+from foremast_tpu.dataplane.fetch import (
+    FetchError,
+    RawFixtureDataSource,
+    parse_prometheus_body,
+)
+
+
+def test_raw_fixture_source_parses_through_production_path():
+    body = bench_cycle._prom_body(1_700_000_000 // 60 * 60, [1.5, 2.25, 3.0])
+    src = RawFixtureDataSource(pages={"http://p/q": body})
+    ts, vals = src.fetch("http://p/q")
+    np.testing.assert_allclose(np.asarray(vals, float), [1.5, 2.25, 3.0])
+    assert np.all(np.diff(np.asarray(ts, float)) == 60)
+    assert src.requests == ["http://p/q"]
+    with pytest.raises(FetchError):
+        src.fetch("http://p/unknown")
+
+
+def test_raw_fixture_source_error_status_raises():
+    raw = json.dumps({"status": "error", "error": "boom"}).encode()
+    src = RawFixtureDataSource(pages={"u": raw})
+    with pytest.raises(FetchError):
+        src.fetch("u")
+
+
+def test_parse_prometheus_body_plain_python_parity():
+    body = bench_cycle._prom_body(1_700_000_040, [9.875, 10.5])
+    ts, vals = parse_prometheus_body(body)
+    assert list(np.asarray(vals, float)) == [9.875, 10.5]
+
+
+def test_cycle_bench_small_fleet_is_steady():
+    rec = bench_cycle.run(n_jobs=24, cycles=2, window_steps=64)
+    assert rec["value"] > 0
+    # identical baseline/current series must stay healthy and requeue:
+    # a shrinking fleet would skew every jobs/s number the driver records
+    assert rec["unhealthy_or_terminal"] == 0
+    assert rec["fetches_per_cycle"] == 48  # baseline+current per job
+    assert rec["jobs"] == 24 and rec["cycles"] == 2

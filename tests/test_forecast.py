@@ -185,6 +185,22 @@ def test_moving_average_long_gap_forward_fills_recent():
     np.testing.assert_allclose(got[30], 9.0)  # last seen level, not 1.0
 
 
+def test_moving_average_extrapolation_freezes_mean_not_last_point():
+    # band-path finding (round 3): beyond `window` steps past the last
+    # observation the prediction must hold the last rolling MEAN;
+    # forward-filling the last raw sample anchors the entire extrapolated
+    # band to one noisy point (an identical current window then scores
+    # ~half its points outside the band whenever the final baseline
+    # sample lands low)
+    T = 40
+    x = np.full(T, 10.0, np.float32)
+    x[19] = 4.0  # noisy final observation
+    mask = np.ones(T, bool)
+    mask[20:] = False
+    got = np.asarray(fc.moving_average_predictions(x[None], mask[None], 5))[0]
+    np.testing.assert_allclose(got[30], np.mean(x[15:20]))  # 8.8, not 4.0
+
+
 def test_kolmogorov_sf_small_x_is_one():
     from foremast_tpu.ops.stats import kolmogorov_sf
 
