@@ -41,18 +41,48 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def cmd_operator(args) -> int:
+def make_analyst(endpoint: str = "", transport: str = ""):
+    """Analyst client from endpoint + transport selection.
+
+    Transport comes from --analyst-transport / ANALYST_TRANSPORT
+    (default http); a grpc:// endpoint scheme also selects gRPC, so
+    pointing ANALYST_ENDPOINT at grpc://runtime:8100 needs no second
+    knob. The runtime serves both fronts (:8099 HTTP, :8100 gRPC —
+    deploy/stack/20-runtime.yaml), and the north-star dispatch path is
+    the gRPC one.
+    """
+    transport = (transport or "http").lower()
+    if endpoint.startswith("grpc://"):
+        transport, endpoint = "grpc", endpoint[len("grpc://"):]
+    if transport == "grpc":
+        from .operator.analyst import GrpcAnalyst
+
+        return GrpcAnalyst(endpoint or "localhost:8100")
+    if transport != "http":
+        raise ValueError(f"unknown analyst transport {transport!r} "
+                         "(expected 'http' or 'grpc')")
     from .operator.analyst import HttpAnalyst
+
+    return HttpAnalyst(endpoint or "http://localhost:8099/v1/healthcheck/")
+
+
+def build_operator_loop(args, kube=None):
+    """Operator loop from CLI args + env — the shipped configuration path.
+
+    Returns (loop, description); kube is injectable for tests."""
     from .operator.loop import OperatorLoop
 
-    endpoint = args.analyst or os.environ.get(
-        "ANALYST_ENDPOINT", "http://localhost:8099/v1/healthcheck/"
+    endpoint = args.analyst or os.environ.get("ANALYST_ENDPOINT", "")
+    transport = (
+        getattr(args, "analyst_transport", "")
+        or os.environ.get("ANALYST_TRANSPORT", "")
     )
+    analyst = make_analyst(endpoint, transport)
     watch = [n.strip() for n in os.environ.get("WATCH_NAMESPACES", "").split(",")
              if n.strip()]
     loop = OperatorLoop(
-        _kube(),
-        HttpAnalyst(endpoint),
+        kube if kube is not None else _kube(),
+        analyst,
         mode=os.environ.get("MODE", "hpa_and_healthy_monitoring"),
         hpa_strategy=os.environ.get("HPA_STRATEGY", "hpa_exists"),
         watch_namespaces=watch or None,
@@ -62,8 +92,14 @@ def cmd_operator(args) -> int:
     ns = os.environ.get("OPERATOR_NAMESPACE") or os.environ.get("NAMESPACE", "")
     if ns:
         loop.barrelman.operator_namespace = ns
+    desc = f"analyst={type(analyst).__name__}({endpoint or 'default'})"
+    return loop, desc
+
+
+def cmd_operator(args) -> int:
+    loop, desc = build_operator_loop(args)
     tick = float(os.environ.get("TICK_SECONDS", "10"))
-    print(f"[foremast-tpu] operator: analyst={endpoint} tick={tick}s", flush=True)
+    print(f"[foremast-tpu] operator: {desc} tick={tick}s", flush=True)
     loop.run_forever(interval=tick)
     return 0
 
@@ -141,7 +177,12 @@ def build_parser() -> argparse.ArgumentParser:
         func=cmd_serve
     )
     op = sub.add_parser("operator", help="run the K8s operator loop")
-    op.add_argument("--analyst", default="", help="job API endpoint")
+    op.add_argument("--analyst", default="",
+                    help="job API endpoint (grpc:// scheme selects gRPC)")
+    op.add_argument("--analyst-transport", default="",
+                    choices=("http", "grpc"),
+                    help="dispatch transport (env ANALYST_TRANSPORT; "
+                         "default http)")
     op.set_defaults(func=cmd_operator)
     sub.add_parser(
         "trigger",

@@ -59,6 +59,20 @@ class ApiError(Exception):
         self.message = message
 
 
+def _canon_time(x):
+    """Collapse integral floats to int. Materialized query URLs — and the
+    deterministic HMAC job ids derived from them — must be identical for
+    the same logical request on every transport: gRPC carries start/end as
+    protobuf doubles and JSON clients may send 1234.0, while JSON integers
+    arrive as Python ints. Normalizing here, in the shared build path,
+    keeps the facades transport-agnostic."""
+    try:
+        f = float(x)
+    except (TypeError, ValueError):
+        return x
+    return int(f) if f.is_integer() else x
+
+
 def _category_url(entry: dict, strategy: str) -> str:
     """One MetricQuery wire object -> concrete query URL.
 
@@ -80,8 +94,8 @@ def _category_url(entry: dict, strategy: str) -> str:
         if not query:
             return ""
         endpoint = params.get("endpoint", "http://prometheus:9090/api/v1/")
-        start = params.get("start", 0)
-        end = params.get("end", 0)
+        start = _canon_time(params.get("start", 0))
+        end = _canon_time(params.get("end", 0))
         try:
             step = int(params.get("step", 60))
         except (TypeError, ValueError):

@@ -46,10 +46,6 @@ _CODE_TO_HTTP = {v: k for k, v in _HTTP_TO_CODE.items()}
 # ---------------------------------------------------------------------------
 # proto <-> HTTP-dict converters
 # ---------------------------------------------------------------------------
-def _num(x: float):
-    return int(x) if float(x).is_integer() else x
-
-
 def _metric_query_to_dict(m) -> dict:
     d: dict = {}
     if m.url:
@@ -58,13 +54,13 @@ def _metric_query_to_dict(m) -> dict:
         d["dataSourceType"] = m.data_source_type
     if m.HasField("parameters"):
         p = m.parameters
-        # integral floats collapse to int so the materialized query URLs —
-        # and therefore the HMAC job ids — match the HTTP facade, where JSON
-        # integers arrive as Python ints
+        # protobuf doubles pass through raw; the shared build path
+        # (service.api._canon_time) collapses integral floats for every
+        # transport, so URLs and HMAC job ids match the HTTP facade
         params: dict = {
             "query": p.query,
-            "start": _num(p.start),
-            "end": _num(p.end),
+            "start": p.start,
+            "end": p.end,
         }
         if p.endpoint:
             params["endpoint"] = p.endpoint

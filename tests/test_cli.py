@@ -103,6 +103,38 @@ def test_operator_watch_namespaces_restricts(kube):
     assert kube.get_monitor("staging", "b") is None
 
 
+def test_make_analyst_transport_selection():
+    from foremast_tpu.operator.analyst import GrpcAnalyst, HttpAnalyst
+
+    default = cli.make_analyst()
+    assert isinstance(default, HttpAnalyst)
+    assert default.endpoint == "http://localhost:8099"  # normalized base
+
+    grpc_flag = cli.make_analyst("127.0.0.1:1", transport="grpc")
+    assert isinstance(grpc_flag, GrpcAnalyst)
+    grpc_flag.close()
+
+    # grpc:// endpoint scheme selects the transport without a second knob
+    grpc_scheme = cli.make_analyst("grpc://svc:8100")
+    assert isinstance(grpc_scheme, GrpcAnalyst)
+    grpc_scheme.close()
+
+    with pytest.raises(ValueError):
+        cli.make_analyst(transport="carrier-pigeon")
+
+
+def test_build_operator_loop_reads_transport_env(kube, monkeypatch):
+    from foremast_tpu.operator.analyst import GrpcAnalyst
+
+    monkeypatch.setenv("ANALYST_TRANSPORT", "grpc")
+    monkeypatch.setenv("ANALYST_ENDPOINT", "127.0.0.1:1")
+    args = cli.build_parser().parse_args(["operator"])
+    loop, desc = cli.build_operator_loop(args, kube=kube)
+    assert isinstance(loop.barrelman.analyst, GrpcAnalyst)
+    assert "GrpcAnalyst" in desc
+    loop.barrelman.analyst.close()
+
+
 def test_demo_hpa_scale_up_story():
     """Hermetic HPA loop: template stamped by the operator, breath-gated 50
     first, sustained surge pushes the score above 50, hpalogs reach the
