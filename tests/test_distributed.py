@@ -1,0 +1,103 @@
+"""Multi-process (DCN) smoke test: two OS processes join one JAX world
+through parallel.distributed.initialize and run a psum whose operands
+live in different processes.
+
+This is the boundary the 8-device virtual mesh cannot reach: that mesh
+is one process, so its collectives never cross a process gap. Here the
+coordinator handshake, the global device view (2 processes x 1 CPU
+device), make_array_from_process_local_data, and a cross-process psum
+all run for real — the same code path a TPU pod uses over DCN
+(SURVEY.md §2.8), shrunk to two local CPU processes.
+
+Skips gracefully when the installed jax cannot serve cross-process CPU
+collectives (the capability, not our wiring, is what varies by build).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from foremast_tpu.parallel import distributed as D
+from foremast_tpu.parallel.mesh import FLEET_AXIS
+
+did_init = D.initialize()  # env contract: COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID
+assert did_init, "initialize() must join the 2-process world"
+assert jax.process_count() == 2, jax.process_count()
+
+info = D.host_info()
+assert info.num_processes == 2
+assert info.global_devices == 2, info.global_devices
+
+mesh = D.global_fleet_mesh()
+global_batch = 4
+sl = D.process_batch_slice(global_batch, info)
+full = np.arange(1.0, global_batch + 1.0, dtype=np.float32)  # 1+2+3+4 = 10
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(FLEET_AXIS)), full[sl], (global_batch,)
+)
+
+@partial(jax.shard_map, mesh=mesh, in_specs=P(FLEET_AXIS), out_specs=P())
+def total(x):
+    return jax.lax.psum(jnp.sum(x), FLEET_AXIS)
+
+out = jax.jit(total)(arr)
+print("PSUM_TOTAL", float(out), flush=True)
+assert float(out) == 10.0, float(out)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_psum_over_coordinator():
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        # one CPU device per process: the world is 2 devices across 2 procs
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["NUM_PROCESSES"] = "2"
+        env["PROCESS_ID"] = str(rank)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=os.path.dirname(os.path.dirname(__file__)),
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("DCN smoke workers timed out (coordinator handshake hung)")
+    combined = "\n\n".join(outs)
+    if any(p.returncode != 0 for p in procs):
+        lowered = combined.lower()
+        if "unimplemented" in lowered or "not supported" in lowered:
+            pytest.skip(f"cross-process CPU collectives unavailable: "
+                        f"{combined[-500:]}")
+        pytest.fail(f"DCN smoke failed:\n{combined[-4000:]}")
+    # both ranks computed the same global reduction over DCN
+    assert combined.count("PSUM_TOTAL 10.0") == 2, combined[-2000:]
