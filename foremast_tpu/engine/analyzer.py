@@ -164,7 +164,14 @@ class Analyzer:
         self.source = data_source
         self.store = store
         self.exporter = exporter or VerdictExporter()
-        self.breath = breath or hpa_ops.BreathState()
+        if breath is None:
+            # restart-safe cooldowns: hydrate armed breath timers from the
+            # store snapshot (persisted at every cycle boundary below), so
+            # a runtime bounce mid-cooldown still suppresses the flip
+            # (dynamic_autoscaling.md:117-126)
+            breath = hpa_ops.BreathState()
+            breath.load(store.get_state("breath") or {})
+        self.breath = breath
         # LSTM-AE model cache (MAX_CACHE_SIZE semantics,
         # foremast-brain/README.md:30): key -> (params, err_mu, err_sigma);
         # insertion-ordered dict doubles as the LRU eviction queue.
@@ -827,6 +834,7 @@ class Analyzer:
                     reason="insufficient data points to judge", worker=worker,
                 )
                 outcomes[job_id] = J.COMPLETED_UNKNOWN
+        self.store.put_state("breath", self.breath.export())
         self.store.flush()
         return outcomes
 

@@ -154,6 +154,7 @@ class JobStore:
         self._lock = threading.RLock()
         self._jobs: dict[str, Document] = {}
         self._hpalogs: list[HpaLog] = []
+        self._state: dict = {}  # engine-owned durable blobs (breath timers)
         self._snapshot_path = snapshot_path
         self.archive = archive
         self._dirty = False
@@ -254,6 +255,20 @@ class JobStore:
         if self.archive is not None:
             self.archive.index_hpalog(asdict(log))
 
+    # -- durable engine state (checkpoint/resume for non-job state) --
+    def put_state(self, key: str, value) -> None:
+        """Persist a JSON-safe engine blob through the snapshot. The engine
+        writes these at cycle boundaries (run_cycle ends with flush()), so
+        restart-sensitive scoring state — HPA breath cooldowns — rides the
+        same durability path as the jobs themselves."""
+        with self._lock:
+            self._state[key] = value
+            self._persist()
+
+    def get_state(self, key: str, default=None):
+        with self._lock:
+            return self._state.get(key, default)
+
     def gc(self, max_age_seconds: float = 24 * 3600.0,
            now: float | None = None) -> int:
         """Prune terminal jobs older than the retention window.
@@ -349,6 +364,7 @@ class JobStore:
             data = {
                 "jobs": [d.to_json() for d in self._jobs.values()],
                 "hpalogs": [asdict(l) for l in self._hpalogs],
+                "state": self._state,
             }
             self._dirty = False
             self._last_write = time.time()
@@ -365,6 +381,7 @@ class JobStore:
                 data = json.load(f)
             jobs = {d["id"]: Document.from_json(d) for d in data.get("jobs", [])}
             logs = [HpaLog(**l) for l in data.get("hpalogs", [])]
+            state = data.get("state", {}) or {}
         except (json.JSONDecodeError, OSError, KeyError, TypeError):
             # a torn/corrupt snapshot must not brick the service: quarantine
             # it and start empty (jobs are re-submitted by the operator tick)
@@ -372,3 +389,4 @@ class JobStore:
             return
         self._jobs = jobs
         self._hpalogs = logs
+        self._state = state if isinstance(state, dict) else {}

@@ -210,3 +210,23 @@ class BreathState:
         if held >= need:
             return float(raw_score)
         return 50.0
+
+    # -- persistence (dynamic_autoscaling.md:117-126: cooldowns must span
+    # process restarts — a runtime bounce right after a redeploy must not
+    # forget an armed timer and let a flip-flop through) --
+    def export(self) -> dict:
+        """JSON-safe {service: [direction, t0]} snapshot of armed timers."""
+        return {svc: [d, t0] for svc, (d, t0) in self._since.items()}
+
+    def load(self, state: dict) -> None:
+        """Restore timers from `export()` output; bad entries are dropped
+        (a corrupt snapshot must not brick scoring — worst case a cooldown
+        re-arms from scratch, the pre-persistence behavior)."""
+        restored = {}
+        for svc, pair in (state or {}).items():
+            try:
+                d, t0 = pair
+                restored[str(svc)] = (int(d), float(t0))
+            except (TypeError, ValueError):
+                continue
+        self._since = restored

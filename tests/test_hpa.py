@@ -87,3 +87,52 @@ def test_breath_cooldowns():
     # neutral clears state
     assert st.apply("svc", 50.0, now=800.0) == 50.0
     assert st.apply("svc", 80.0, now=810.0) == 50.0
+
+
+def test_breath_state_survives_restart(tmp_path):
+    """A runtime bounce mid-cooldown must not forget armed timers: the
+    timers ride the JobStore snapshot (dynamic_autoscaling.md:117-126)."""
+    from foremast_tpu.engine.jobs import JobStore
+
+    snap = str(tmp_path / "jobs.json")
+    store = JobStore(snapshot_path=snap)
+    st = hpa.BreathState(breath_up_s=120, breath_down_s=600)
+    # a scale-down signal arms the (long) down-cooldown at t=1000
+    assert st.apply("svc", 30.0, now=1000.0) == 50.0
+    store.put_state("breath", st.export())
+    store.flush()
+
+    # restart: new store from the same snapshot, fresh BreathState
+    st2 = hpa.BreathState(breath_up_s=120, breath_down_s=600)
+    st2.load(JobStore(snapshot_path=snap).get_state("breath") or {})
+    # t=1300: only 300s held — the flip is STILL suppressed post-restart
+    assert st2.apply("svc", 30.0, now=1300.0) == 50.0
+    # t=1700: 700s >= 600s — the sustained signal finally passes
+    assert st2.apply("svc", 30.0, now=1700.0) == 30.0
+
+
+def test_breath_load_drops_corrupt_entries():
+    st = hpa.BreathState()
+    st.load({"good": [1, 100.0], "bad": "nope", "worse": [1], "none": None})
+    assert st._since == {"good": (1, 100.0)}
+
+
+def test_analyzer_hydrates_breath_from_store(tmp_path):
+    """Analyzer persists breath timers at cycle boundaries and re-hydrates
+    them on construction — the restart path the runtime actually takes."""
+    from foremast_tpu.dataplane.fetch import FixtureDataSource
+    from foremast_tpu.engine.analyzer import Analyzer
+    from foremast_tpu.engine.config import EngineConfig
+    from foremast_tpu.engine.jobs import JobStore
+
+    snap = str(tmp_path / "jobs.json")
+    store = JobStore(snapshot_path=snap)
+    eng = Analyzer(EngineConfig(), FixtureDataSource({}), store)
+    assert eng.breath.apply("app/ns", 80.0, now=2000.0) == 50.0  # arm up
+    eng.run_cycle(now=2000.0)  # cycle boundary persists the armed timer
+
+    store2 = JobStore(snapshot_path=snap)
+    eng2 = Analyzer(EngineConfig(), FixtureDataSource({}), store2)
+    assert eng2.breath._since == {"app/ns": (1, 2000.0)}
+    # held >= breath_up_s since the pre-restart arm: signal passes
+    assert eng2.breath.apply("app/ns", 80.0, now=2130.0) == 80.0
