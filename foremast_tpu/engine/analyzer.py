@@ -642,6 +642,7 @@ class Analyzer:
             np.full(B, 1e9, np.float32),  # static SLA unset -> huge
             np.full(B, hpa_ops.SLA_DYNAMIC, np.int32),
             np.full(B, self.config.threshold, np.float32),
+            np.full(B, self.config.sla_headroom_safe, np.float32),
         )
         for i, (job_id, tps_it, sla_it) in enumerate(rows):
             out[job_id] = {
@@ -844,7 +845,8 @@ class Analyzer:
             self.store.requeue(doc.id, worker=worker)
             return J.INITIAL
         gated = self.breath.apply(doc.id, res["raw_score"], now=now)
-        reason_names = {0: "predicted trend", 1: "anomaly trend", 2: "SLA violation"}
+        reason_names = {0: "predicted trend", 1: "anomaly trend",
+                        2: "SLA violation", 3: "SLA headroom"}
         reason = (
             f"hpa score {gated:.1f} (raw {res['raw_score']:.1f}) via "
             f"{reason_names.get(res['reason_code'], '?')} on {res['tps_metric']}"

@@ -74,6 +74,10 @@ class EngineConfig:
     # near-zero-variance error metrics, so noisy metrics need the gate.
     band_min_points: int = 2
     band_violation_fraction: float = 0.1
+    # HPA reward shaping (SLA_HEADROOM_SAFE): below this SLA-budget
+    # utilization scale-down is fully model-driven; between it and 1.0 the
+    # reward ramps scale-down off (ops/hpa.py reward-shaping block)
+    sla_headroom_safe: float = 0.7
     policies: dict = field(default_factory=lambda: dict(DEFAULT_POLICIES))
 
     def policy_for(self, metric_name: str) -> MetricPolicy:
@@ -174,5 +178,6 @@ def from_env(env=None) -> EngineConfig:
         lstm_hidden=_env_int(env, "LSTM_HIDDEN", 32),
         lstm_latent=_env_int(env, "LSTM_LATENT", 16),
         lstm_threshold=_env_float(env, "LSTM_THRESHOLD", 3.0),
+        sla_headroom_safe=_env_float(env, "SLA_HEADROOM_SAFE", 0.7),
         policies=policies,
     )

@@ -35,6 +35,7 @@ __all__ = [
     "REASON_PREDICTED_TREND",
     "REASON_ANOMALY_TREND",
     "REASON_SLA_VIOLATION",
+    "REASON_SLA_HEADROOM",
     "hpa_scores",
     "BreathState",
 ]
@@ -48,6 +49,7 @@ SLA_MIN = 2  # min(static, dynamic)
 REASON_PREDICTED_TREND = 0
 REASON_ANOMALY_TREND = 1
 REASON_SLA_VIOLATION = 2
+REASON_SLA_HEADROOM = 3  # scale-down suppressed: too close to the SLA limit
 
 
 def _masked_mean(x, m, axis=-1):
@@ -80,6 +82,7 @@ def hpa_scores(
     sla_static_limit,
     sla_mode,
     threshold,
+    sla_safe_fraction=None,
 ):
     """Compute fleet HPA scores.
 
@@ -97,6 +100,9 @@ def hpa_scores(
       sla_static_limit: (B,) static SLA limit per service.
       sla_mode:   (B,) int32 — SLA_STATIC / SLA_DYNAMIC / SLA_MIN.
       threshold:  (B,) band half-width in sigmas for the traffic band.
+      sla_safe_fraction: (B,) optional — the SLA utilization below which
+                  scale-down is fully model-driven (default 0.7); between
+                  it and 1.0 the reward ramps scale-down off (see below).
 
     Returns dict:
       score:  (B,) float in [0, 100] — 50 = keep replicas.
@@ -156,16 +162,41 @@ def hpa_scores(
     sla_current = _masked_mean(sla, sla_mask & region)
     sla_violated = sla_current > limit
 
-    # SLA violation floors the score at 75 so a struggling service always
-    # scales up regardless of what the traffic model says.
+    # Reward shaping over SLA headroom (dynamic_autoscaling.md:45-56:
+    # "reward lower resource allocation as long as SLA is not violated"
+    # — with a safety ramp instead of a cliff at the limit). Let
+    # h = sla_current / limit (SLA budget utilization):
+    #   h <= safe        comfortable; the traffic model decides (R rewards
+    #                    scale-down while the SLA is met).
+    #   safe < h < 1     thin; the model's scale-down opinion is weighted
+    #                    by w = (1-h)/(1-safe) -> 50 as h -> 1: R(DOWN)
+    #                    flips sign BEFORE the limit is breached, so a
+    #                    scale-down never rides an SLA already on fire.
+    #                    Scale-up signals pass through untouched.
+    #   h >= 1           violated; floor grows with overshoot, from 75
+    #                    at the limit to 100 at 2x the limit.
+    safe = (
+        jnp.full_like(sla_current, 0.7)
+        if sla_safe_fraction is None
+        else sla_safe_fraction.astype(_F)
+    )
+    h = sla_current / jnp.maximum(limit, 1e-9)
     base = 50.0 * demand / jnp.maximum(provisioned, 1e-6)
-    score = jnp.where(sla_violated, jnp.maximum(base, 75.0), base)
+    w = jnp.clip((1.0 - h) / jnp.maximum(1.0 - safe, 1e-6), 0.0, 1.0)
+    shaped = jnp.where(base < 50.0, 50.0 - (50.0 - base) * w, base)
+    viol_floor = 75.0 + 25.0 * jnp.clip(h - 1.0, 0.0, 1.0)
+    score = jnp.where(sla_violated, jnp.maximum(base, viol_floor), shaped)
     score = jnp.clip(score, 0.0, 100.0)
 
+    suppressed = (~sla_violated) & (base < 50.0) & (w < 1.0)
     reason = jnp.where(
         sla_violated,
         REASON_SLA_VIOLATION,
-        jnp.where(anomalous, REASON_ANOMALY_TREND, REASON_PREDICTED_TREND),
+        jnp.where(
+            suppressed,
+            REASON_SLA_HEADROOM,
+            jnp.where(anomalous, REASON_ANOMALY_TREND, REASON_PREDICTED_TREND),
+        ),
     ).astype(jnp.int32)
 
     return {

@@ -64,6 +64,52 @@ def test_sla_violation_forces_scale_up():
     assert int(out["reason"][0]) == hpa.REASON_SLA_VIOLATION
 
 
+def test_sla_violation_floor_grows_with_overshoot():
+    mild = hpa.hpa_scores(**_setup(100, sla_current=55.0))  # just over 50
+    severe = hpa.hpa_scores(**_setup(100, sla_current=95.0))  # ~1.9x limit
+    assert float(mild["score"][0]) >= 75
+    assert float(severe["score"][0]) > float(mild["score"][0])
+
+
+def test_thin_headroom_suppresses_scale_down_via_reward():
+    """R(DOWN) flips sign BEFORE the limit is breached: with the traffic
+    model demanding scale-down (collapse to 20 tps) but SLA at 95% of its
+    budget, the reward — not the breath cooldown — pins the score at ~50."""
+    # sanity: same traffic with comfortable SLA does scale down
+    comfortable = hpa.hpa_scores(**_setup(20, sla_current=5.0))
+    base = float(comfortable["score"][0])
+    assert base < 50
+
+    thin = hpa.hpa_scores(**_setup(20, sla_current=47.5))  # h = 0.95 of 50
+    s = float(thin["score"][0])
+    assert s > base, "reward must pull the scale-down toward hold"
+    # w = (1-0.95)/(1-0.7) ~= 0.17: ~5/6 of the down-signal is gone
+    # (base ~10 -> shaped ~50 - 40*0.17 ~= 43)
+    assert 40 <= s < 50, s
+    assert int(thin["reason"][0]) == hpa.REASON_SLA_HEADROOM
+
+
+def test_comfortable_headroom_is_model_driven():
+    """Below the safe utilization the reward stays out of the way: the
+    score equals the raw traffic-model score on both sides of 50."""
+    down = hpa.hpa_scores(**_setup(20, sla_current=5.0))  # h = 0.1
+    assert float(down["score"][0]) < 50
+    assert int(down["reason"][0]) in (
+        hpa.REASON_PREDICTED_TREND, hpa.REASON_ANOMALY_TREND
+    )
+    up = hpa.hpa_scores(**_setup(300, sla_current=5.0))
+    assert float(up["score"][0]) > 50
+    assert int(up["reason"][0]) == hpa.REASON_ANOMALY_TREND
+
+
+def test_scale_up_passes_through_thin_headroom():
+    # the ramp only gates scale-DOWN; a surge with thin headroom must
+    # still scale up on the traffic signal
+    out = hpa.hpa_scores(**_setup(300, sla_current=47.5))
+    assert float(out["score"][0]) > 50
+    assert int(out["reason"][0]) == hpa.REASON_ANOMALY_TREND
+
+
 def test_sla_dynamic_mode_uses_history_sigma():
     cfg = _setup(100, sla_current=9.0)  # way above mean+3sigma of ~5+-0.5
     cfg["sla_mode"] = np.int32([hpa.SLA_DYNAMIC])
