@@ -54,6 +54,9 @@ class FileArchive:
         self.path = path
         self.max_bytes = max_bytes
         self._lock = threading.Lock()
+        # times a lock-free scan exhausted its rescans and fell back to a
+        # locked scan (sustained-rotation churn); exposed for observability
+        self.locked_scan_fallbacks = 0
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -101,22 +104,31 @@ class FileArchive:
         # change and rescan; consumers are last-write-wins per id, so
         # re-delivered records are harmless. On Windows the rotation itself
         # can fail (os.replace on a reader-held file) — it is simply retried
-        # by the next append once reads quiesce.
+        # by the next append once reads quiesce. If churn outlasts the
+        # rescans, one final scan runs UNDER the write lock (rotation
+        # cannot race it), so a /search never silently returns a partial
+        # view; the fallback is counted for observability.
         for _attempt in range(3):
             ino_before = self._current_inode()
-            for p in (self.path + ".1", self.path):
-                try:
-                    f = open(p)
-                except OSError:
-                    continue
-                with f:
-                    for line in f:
-                        try:
-                            yield json.loads(line)
-                        except json.JSONDecodeError:
-                            continue  # torn tail write after a crash
+            yield from self._scan_once()
             if self._current_inode() == ino_before:
                 return
+        self.locked_scan_fallbacks += 1
+        with self._lock:
+            yield from self._scan_once()
+
+    def _scan_once(self):
+        for p in (self.path + ".1", self.path):
+            try:
+                f = open(p)
+            except OSError:
+                continue
+            with f:
+                for line in f:
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail write after a crash
 
     def _current_inode(self):
         try:

@@ -65,6 +65,24 @@ def test_file_archive_rotation_keeps_one_generation(tmp_path):
     assert res[0]["id"] == "j29"
 
 
+def test_sustained_rotation_falls_back_to_locked_scan(tmp_path):
+    """When rotation churn outlasts the lock-free rescans, the reader must
+    take one consistent scan under the write lock — never silently return
+    a partial view (round-2 advisor finding)."""
+    a = FileArchive(str(tmp_path / "arch.jsonl"))
+    a.index_job({"id": "x", "app_name": "a", "namespace": "d",
+                 "status": "completed_health", "modified_at": 1.0})
+    # simulate an inode changing under every scan attempt
+    inodes = iter(range(100))
+    a._current_inode = lambda: next(inodes)
+    res = a.search()
+    assert [r["id"] for r in res] == ["x"], "fallback scan must be complete"
+    assert a.locked_scan_fallbacks == 1
+    # and the lock must have been released for subsequent writes
+    assert a.index_job({"id": "y", "app_name": "a", "namespace": "d",
+                        "status": "completed_health", "modified_at": 2.0})
+
+
 def test_file_archive_survives_torn_tail_line(tmp_path):
     path = str(tmp_path / "arch.jsonl")
     a = FileArchive(path)
