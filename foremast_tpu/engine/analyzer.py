@@ -20,6 +20,7 @@ and forecast-band checks fused (parallel.fleet), HPA scores batched
 from __future__ import annotations
 
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -680,17 +681,36 @@ class Analyzer:
         all_hpas: list[_HpaItem] = []
         with tracing.span("engine.preprocess", jobs=len(claimed)):
             for doc in claimed:
-                st = _JobState(doc)
-                states[doc.id] = st
+                states[doc.id] = _JobState(doc)
+
+            def prep(doc):
                 try:
-                    pairs, bands, bis, multis, hpas = self._preprocess(doc, now)
-                    all_pairs += pairs
-                    all_bands += bands
-                    all_bis += bis
-                    all_multis += multis
-                    all_hpas += hpas
+                    return doc.id, self._preprocess(doc, now), ""
                 except FetchError as e:
-                    st.failed = str(e)
+                    return doc.id, None, str(e)
+
+            # per-job fetches overlap on a bounded pool: fetch is
+            # network-bound in production (and the native parser releases
+            # the GIL during its C scan), so cycle time tracks store
+            # latency, not fleet size. ex.map preserves claim order, so
+            # item lists — and with them bucket packing and verdict
+            # folding — stay deterministic.
+            workers = min(max(self.config.fetch_concurrency, 1), len(claimed) or 1)
+            if workers <= 1:
+                results = [prep(d) for d in claimed]
+            else:
+                with ThreadPoolExecutor(max_workers=workers) as ex:
+                    results = list(ex.map(prep, claimed))
+            for doc_id, items, failed in results:
+                if failed:
+                    states[doc_id].failed = failed
+                    continue
+                pairs, bands, bis, multis, hpas = items
+                all_pairs += pairs
+                all_bands += bands
+                all_bis += bis
+                all_multis += multis
+                all_hpas += hpas
         for doc_id, st in states.items():
             if st.failed:
                 if st.doc.strategy in CONTINUOUS_STRATEGIES:

@@ -54,6 +54,11 @@ class EngineConfig:
     # fleet batch size, not a per-worker work-queue depth; at 100k-fleet
     # scale the default must not silently cap the cycle.
     max_claim_per_cycle: int = 100_000
+    # per-job window fetches run on a bounded thread pool
+    # (FETCH_CONCURRENCY; 1 = serial). In production the fetch stage is
+    # network-bound against the metric store, so overlap is the difference
+    # between cycle time scaling with fleet size and with store latency.
+    fetch_concurrency: int = 16
     ma_window: int = 30  # moving-average lookback (steps)
     # windows at/above this length use the time-parallel associative-scan
     # SES smoother (ops/seqscan.py) instead of sequential lax.scan; DES
@@ -169,6 +174,7 @@ def from_env(env=None) -> EngineConfig:
         max_stuck_seconds=_env_float(env, "MAX_STUCK_IN_SECONDS", 90.0),
         max_cache_size=_env_int(env, "MAX_CACHE_SIZE", 1024),
         max_claim_per_cycle=_env_int(env, "MAX_CLAIM_PER_CYCLE", 100_000),
+        fetch_concurrency=_env_int(env, "FETCH_CONCURRENCY", 16),
         ma_window=_env_int(env, "MA_WINDOW", 30),
         long_window_steps=_env_int(env, "LONG_WINDOW_STEPS", 4096),
         hw_period=_env_int(env, "HW_PERIOD", 1440),
