@@ -251,6 +251,130 @@ def test_example_manifests_parse_and_decode():
     assert float(e2["DEMO_ERROR5XX_PER_SECOND"]) > 0
 
 
+def _pm_docs():
+    """All docs in the prometheus-operator bundle, keyed by file name."""
+    return {
+        os.path.basename(path): docs
+        for path, docs in ALL.items()
+        if os.path.dirname(path) == "prometheus-operator"
+    }
+
+
+def test_prometheus_operator_bundle_is_complete_and_namespaced():
+    pm = _pm_docs()
+    flat = [d for docs in pm.values() for d in docs]
+    # the four CRDs the stack's resources rely on are registered
+    crds = {d["spec"]["names"]["plural"]
+            for d in flat if d["kind"] == "CustomResourceDefinition"}
+    assert {"prometheuses", "alertmanagers", "servicemonitors",
+            "prometheusrules"} <= crds
+    # one of each workload kind the reference bundle ships
+    kinds = {d["kind"] for d in flat}
+    assert {"Namespace", "Deployment", "DaemonSet", "Prometheus",
+            "Alertmanager", "ServiceMonitor", "Secret", "ConfigMap",
+            "Service", "ClusterRole", "ClusterRoleBinding",
+            "ServiceAccount"} <= kinds
+    # every namespaced doc sits in the monitoring namespace
+    for d in flat:
+        ns = d.get("metadata", {}).get("namespace")
+        if ns is not None:
+            assert ns == "monitoring", d["metadata"]["name"]
+    # the kustomization applies every manifest in the directory
+    [kust] = pm["kustomization.yaml"]
+    yaml_files = {n for n in pm if n != "kustomization.yaml"}
+    assert set(kust["resources"]) == yaml_files
+
+
+def test_prometheus_cr_selects_foremast_rules_and_monitors():
+    pm = _pm_docs()
+    prom = next(d for d in pm["20-prometheus.yaml"] if d["kind"] == "Prometheus")
+    spec = prom["spec"]
+    # rule selection matches the recording-rules labels (the series contract)
+    [rules] = ALL[os.path.join("prometheus", "recording-rules.yaml")]
+    want = spec["ruleSelector"]["matchLabels"]
+    have = rules["metadata"]["labels"]
+    assert want.items() <= have.items(), (want, have)
+    assert spec.get("ruleNamespaceSelector") == {}
+    # ServiceMonitor selection is all-namespaces/all-labels, so the stack's
+    # runtime monitor (deploy/stack/40-servicemonitor.yaml) is picked up
+    assert spec["serviceMonitorSelector"] == {}
+    assert spec["serviceMonitorNamespaceSelector"] == {}
+    # the service account it runs as exists and RBAC binds it
+    sas = {d["metadata"]["name"] for d in pm["20-prometheus.yaml"]
+           if d["kind"] == "ServiceAccount"}
+    assert spec["serviceAccountName"] in sas
+    crb = next(d for d in pm["20-prometheus.yaml"]
+               if d["kind"] == "ClusterRoleBinding")
+    assert crb["subjects"][0]["name"] == spec["serviceAccountName"]
+    # alerting points at the alertmanager service shipped alongside
+    am_svcs = {d["metadata"]["name"] for d in pm["30-alertmanager.yaml"]
+               if d["kind"] == "Service"}
+    [am] = spec["alerting"]["alertmanagers"]
+    assert am["name"] in am_svcs and am["namespace"] == "monitoring"
+    # the additional scrape config secret exists, the key matches, and the
+    # embedded config keeps pod labels (the `app` join the rules need)
+    sec = next(d for d in pm["20-prometheus.yaml"] if d["kind"] == "Secret")
+    ref = spec["additionalScrapeConfigs"]
+    assert sec["metadata"]["name"] == ref["name"]
+    scrape = yaml.safe_load(sec["stringData"][ref["key"]])
+    relabels = scrape[0]["relabel_configs"]
+    assert any(r.get("action") == "labelmap" for r in relabels)
+    targets = {r.get("target_label") for r in relabels}
+    assert {"namespace", "pod"} <= targets
+
+
+def test_operator_rbac_covers_monitoring_crds():
+    pm = _pm_docs()
+    role = next(d for d in pm["10-operator.yaml"] if d["kind"] == "ClusterRole")
+    rule = next(r for r in role["rules"]
+                if "monitoring.coreos.com" in r["apiGroups"])
+    assert {"prometheuses", "alertmanagers", "servicemonitors",
+            "prometheusrules"} <= set(rule["resources"])
+    crb = next(d for d in pm["10-operator.yaml"]
+               if d["kind"] == "ClusterRoleBinding")
+    dep = next(d for d in pm["10-operator.yaml"] if d["kind"] == "Deployment")
+    sa = dep["spec"]["template"]["spec"]["serviceAccountName"]
+    assert crb["subjects"][0]["name"] == sa
+
+
+def test_grafana_is_provisioned_with_foremast_dashboard():
+    import json
+
+    pm = _pm_docs()
+    docs = pm["60-grafana.yaml"]
+    cms = {d["metadata"]["name"]: d for d in docs if d["kind"] == "ConfigMap"}
+    # datasource points at the prometheus service/port shipped in this bundle
+    prom_svc = next(d for d in pm["20-prometheus.yaml"]
+                    if d["kind"] == "Service")
+    ds = yaml.safe_load(cms["grafana-datasources"]["data"]["datasources.yaml"])
+    [entry] = ds["datasources"]
+    assert prom_svc["metadata"]["name"] in entry["url"]
+    assert str(prom_svc["spec"]["ports"][0]["port"]) in entry["url"]
+    # the dashboard is valid JSON charting the exporter's series contract
+    dash = json.loads(
+        cms["grafana-dashboard-foremast"]["data"]["foremast-health.json"])
+    exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
+    joined = "\n".join(exprs)
+    for series in ("foremastbrain:http_server_requests_errors_5xx_upper",
+                   "foremastbrain:http_server_requests_latency_lower",
+                   "foremastbrain:http_server_requests_errors_5xx_anomaly",
+                   "foremastbrain:namespace_app_per_pod:hpa_score"):
+        assert series in joined, series
+    # version-change annotations join on kube_pod_labels, which
+    # kube-state-metrics must allow-list
+    anns = dash["annotations"]["list"]
+    assert any("kube_pod_labels" in a.get("expr", "") for a in anns)
+    ksm = next(d for d in pm["40-kube-state-metrics.yaml"]
+               if d["kind"] == "Deployment")
+    args = ksm["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert any("metric-labels-allowlist" in a and "app" in a for a in args)
+    # every grafana volume's configmap is shipped in the same file
+    graf = next(d for d in docs if d["kind"] == "Deployment")
+    for vol in graf["spec"]["template"]["spec"]["volumes"]:
+        if "configMap" in vol:
+            assert vol["configMap"]["name"] in cms, vol
+
+
 def test_stack_wiring_is_consistent():
     runtime_docs = ALL[os.path.join("stack", "20-runtime.yaml")]
     operator_docs = ALL[os.path.join("stack", "30-operator.yaml")]
