@@ -32,11 +32,53 @@ import urllib.request
 from collections import OrderedDict
 from typing import Callable
 
+import numpy as np
+
 from .. import native
+from ..ops.windowing import MAX_WINDOW_STEPS, Window, align_step, resample_to_grid
 
 
 class FetchError(Exception):
     pass
+
+
+def grid_from_series(ts, vals, step: int = 60,
+                     max_steps: int = MAX_WINDOW_STEPS) -> Window:
+    """(ts, vals) -> the engine's grid Window: span from the data's own
+    min/max timestamps, clamped to the largest compiled bucket keeping the
+    most recent samples (a query returning >11 days must not produce an
+    unbucketable window). np.max/np.min because ts may be a 10k-point
+    ndarray off the native parser (builtin max would box every element)."""
+    if len(ts) == 0:
+        return Window(np.zeros(1, np.float32), np.zeros(1, bool), 0, step)
+    end = align_step(float(np.max(ts)), step) + step
+    start = max(align_step(float(np.min(ts)), step), end - max_steps * step)
+    return resample_to_grid(ts, vals, start, end, step)
+
+
+def _probably_error_body(raw: bytes) -> bool:
+    """Status probe shared by every native fast path. Only a PREFIX is
+    scanned: Prometheus serializes the top-level "status" first, and a
+    full-body scan would false-positive on series whose LABELS contain
+    status="error" (common on the error metrics we monitor), permanently
+    disabling the fast path for them."""
+    head = raw[:256]
+    return b'"status":"error"' in head or b'"status": "error"' in head
+
+
+def window_from_prometheus_body(raw: bytes, step: int = 60,
+                                max_steps: int = MAX_WINDOW_STEPS) -> Window:
+    """Response body -> grid Window; single fused native call when the
+    extension is built (parse+align+clamp+resample without intermediate
+    arrays), else the parse_series/Python path + grid_from_series. Same
+    error-probe rules as parse_prometheus_body."""
+    if not _probably_error_body(raw):
+        win = native.parse_grid(raw, native.FLAVOR_PROMETHEUS, step, max_steps)
+        if win is not None:
+            vals, mask, start = win
+            return Window(vals, mask, start, step)
+    ts, vals = parse_prometheus_body(raw)
+    return grid_from_series(ts, vals, step, max_steps)
 
 
 def _avg_series(series: list[list[tuple[float, float]]]):
@@ -54,17 +96,12 @@ def _avg_series(series: list[list[tuple[float, float]]]):
 def parse_prometheus_body(raw: bytes):
     """Response body -> (ts, vals); native fast path with Python fallback.
 
-    Fast path: single-pass native scan (no DOM). The status probe only
-    scans a prefix: Prometheus serializes the top-level "status" first,
-    and a full-body scan would false-positive on series whose LABELS
-    contain status="error" (common on the error metrics we monitor),
-    permanently disabling the fast path for them. Error responses also
-    arrive with non-2xx codes (the transport raised before reaching
-    here) — this probe is belt-and-braces for proxies that flatten the
-    status code.
+    Fast path: single-pass native scan (no DOM), gated by the
+    _probably_error_body prefix probe. Error responses normally arrive
+    with non-2xx codes (the transport raised before reaching here) — the
+    probe is belt-and-braces for proxies that flatten the status code.
     """
-    head = raw[:256]
-    if b'"status":"error"' not in head and b'"status": "error"' not in head:
+    if not _probably_error_body(raw):
         parsed = native.parse_series(raw, native.FLAVOR_PROMETHEUS)
         if parsed is not None:
             return parsed
@@ -83,13 +120,21 @@ class PrometheusDataSource:
     def __init__(self, timeout: float = 10.0):
         self.timeout = timeout
 
-    def fetch(self, url: str):
+    def _raw(self, url: str) -> bytes:
         try:
             with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                raw = r.read()
+                return r.read()
         except Exception as e:  # noqa: BLE001 - network boundary
             raise FetchError(f"prometheus fetch failed: {e}") from e
-        return parse_prometheus_body(raw)
+
+    def fetch(self, url: str):
+        return parse_prometheus_body(self._raw(url))
+
+    def fetch_window(self, url: str) -> Window:
+        """Engine fast path: body bytes -> grid Window (fused native parse
+        when built). Sources exposing fetch_window let the engine skip the
+        intermediate (ts, vals) arrays entirely."""
+        return window_from_prometheus_body(self._raw(url))
 
 
 class WavefrontDataSource:
@@ -133,14 +178,20 @@ class RawFixtureDataSource:
         self.resolver = resolver
         self.requests: list[str] = []
 
-    def fetch(self, url: str):
+    def _raw(self, url: str) -> bytes:
         self.requests.append(url)
         raw = self.pages.get(url)
         if raw is None and self.resolver is not None:
             raw = self.resolver(url)
         if raw is None:
             raise FetchError(f"no fixture page for {url}")
-        return parse_prometheus_body(raw)
+        return raw
+
+    def fetch(self, url: str):
+        return parse_prometheus_body(self._raw(url))
+
+    def fetch_window(self, url: str) -> Window:
+        return window_from_prometheus_body(self._raw(url))
 
 
 class FixtureDataSource:
@@ -186,19 +237,32 @@ class CachingDataSource:
         self.misses = 0
 
     def fetch(self, url: str):
+        return self._cached(url, self.inner.fetch)
+
+    def fetch_window(self, url: str):
+        """Delegate the engine's Window fast path through the same cache
+        (separate key space — a cached parsed series is not a Window).
+        Returns None when the inner source has no byte-level path, which
+        tells the engine to use fetch() instead."""
+        fw = getattr(self.inner, "fetch_window", None)
+        if fw is None:
+            return None
+        return self._cached(("window", url), fw, url)
+
+    def _cached(self, key, fn, *args):
         now = time.time()
         with self._lock:
-            if url in self._cache:
-                res, at = self._cache[url]
+            if key in self._cache:
+                res, at = self._cache[key]
                 if now - at <= self.ttl_seconds:
-                    self._cache.move_to_end(url)
+                    self._cache.move_to_end(key)
                     self.hits += 1
                     return res
-                del self._cache[url]
-        res = self.inner.fetch(url)
+                del self._cache[key]
+        res = fn(*(args or (key,)))
         with self._lock:
             self.misses += 1
-            self._cache[url] = (res, now)
+            self._cache[key] = (res, now)
             if len(self._cache) > self.max_entries:
                 self._cache.popitem(last=False)
         return res

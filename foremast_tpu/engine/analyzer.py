@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..dataplane.exporter import VerdictExporter
-from ..dataplane.fetch import FetchError
+from ..dataplane.fetch import FetchError, grid_from_series
 from ..dataplane.promql import (
     CONTINUOUS_STRATEGIES,
     STRATEGY_HPA,
@@ -40,10 +40,8 @@ from ..ops import hpa as hpa_ops
 from ..ops.windowing import (
     MAX_WINDOW_STEPS,
     Window,
-    align_step,
     bucket_length,
     pack_windows,
-    resample_to_grid,
 )
 from ..parallel import fleet as fl
 from ..utils import tracing
@@ -184,17 +182,18 @@ class Analyzer:
         if not url:
             return None
         url = materialize_placeholders(url, now)
+        # byte-level sources expose fetch_window: body -> grid Window in one
+        # fused native call, skipping the intermediate (ts, vals) arrays
+        # (fetch.window_from_prometheus_body). Series-level sources (fixture
+        # dicts, wavefront) go through fetch() + grid_from_series — the two
+        # paths are asserted equivalent in tests/test_native.py.
+        fw = getattr(self.source, "fetch_window", None)
+        if fw is not None:
+            win = fw(url)
+            if win is not None:
+                return win
         ts, vals = self.source.fetch(url)
-        if len(ts) == 0:
-            return Window(np.zeros(1, np.float32), np.zeros(1, bool), 0)
-        # clamp the grid span to the largest compiled bucket, keeping the
-        # most recent samples: a user query returning >11 days of data must
-        # not produce an unbucketable window (and with it a poisoned batch).
-        # np.max/np.min: ts may be a 10k-point ndarray off the native parser
-        # (builtin max would box every element)
-        end = align_step(float(np.max(ts))) + 60
-        start = max(align_step(float(np.min(ts))), end - MAX_WINDOW_STEPS * 60)
-        return resample_to_grid(ts, vals, start, end, 60)
+        return grid_from_series(ts, vals)
 
     def _preprocess(self, doc: J.Document, now: float):
         """Fetch all windows for a job; returns (pair, band, bi, multi, hpa)
@@ -683,24 +682,33 @@ class Analyzer:
             for doc in claimed:
                 states[doc.id] = _JobState(doc)
 
-            def prep(doc):
-                try:
-                    return doc.id, self._preprocess(doc, now), ""
-                except FetchError as e:
-                    return doc.id, None, str(e)
+            def prep_many(chunk):
+                out = []
+                for doc in chunk:
+                    try:
+                        out.append((doc.id, self._preprocess(doc, now), ""))
+                    except FetchError as e:
+                        out.append((doc.id, None, str(e)))
+                return out
 
             # per-job fetches overlap on a bounded pool: fetch is
             # network-bound in production (and the native parser releases
             # the GIL during its C scan), so cycle time tracks store
-            # latency, not fleet size. ex.map preserves claim order, so
-            # item lists — and with them bucket packing and verdict
-            # folding — stay deterministic.
+            # latency, not fleet size. Jobs are mapped in CHUNKS (several
+            # per worker for tail-balance) — at 10k+ fleet sizes, per-job
+            # task dispatch costs more GIL time than the preprocess itself.
+            # ex.map preserves submission order, and chunks are cut in claim
+            # order, so item lists — and with them bucket packing and
+            # verdict folding — stay deterministic.
             workers = min(max(self.config.fetch_concurrency, 1), len(claimed) or 1)
             if workers <= 1:
-                results = [prep(d) for d in claimed]
+                results = prep_many(claimed)
             else:
+                step = max(1, -(-len(claimed) // (workers * 8)))
+                chunks = [claimed[i:i + step]
+                          for i in range(0, len(claimed), step)]
                 with ThreadPoolExecutor(max_workers=workers) as ex:
-                    results = list(ex.map(prep, claimed))
+                    results = [r for rs in ex.map(prep_many, chunks) for r in rs]
             for doc_id, items, failed in results:
                 if failed:
                     states[doc_id].failed = failed

@@ -159,6 +159,15 @@ class JobStore:
         self.archive = archive
         self._dirty = False
         self._last_write = 0.0
+        # background flusher: serialization/IO happen off the callers'
+        # threads (see _persist); writes are ordered by a sequence number so
+        # a slow older flush can never clobber a newer snapshot
+        self._write_lock = threading.Lock()
+        self._flush_seq = 0  # bumped under _lock when a payload is cut
+        self._written_seq = 0  # last seq that reached disk (under _write_lock)
+        self._flush_wake = threading.Event()
+        self._flusher: threading.Thread | None = None
+        self._closed = False
         if snapshot_path:
             self._load()
 
@@ -338,23 +347,56 @@ class JobStore:
 
     # -- checkpoint/resume --
     def _persist(self):
-        """Debounced write-behind: serializing the whole store on every
-        transition would be O(jobs^2) per cycle under the lock; the 90 s
-        lease takeover already tolerates a snapshot up to a second stale."""
+        """Write-behind: mark dirty and wake the background flusher.
+
+        Serializing the whole store on every transition would be O(jobs^2)
+        per cycle under the lock — and even debounced to 1 Hz, a synchronous
+        flush makes some unlucky transition pay the whole serialize+write
+        while every other worker blocks on the lock. Instead callers only
+        flip a bit; the flusher thread owns the 1 Hz cadence. Durability is
+        unchanged (snapshot ≤ ~1 s stale, exactly what the 90 s lease
+        takeover already tolerates), and run_cycle/stop() still call flush()
+        synchronously at cycle/shutdown boundaries. Always called under
+        self._lock, which is what makes the lazy thread start race-free."""
         if not self._snapshot_path:
             return
-        now = time.time()
         self._dirty = True
-        if now - self._last_write < 1.0:
-            return
-        self.flush()
+        if self._flusher is None and not self._closed:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="jobstore-flush", daemon=True
+            )
+            self._flusher.start()
+        self._flush_wake.set()
+
+    def _flush_loop(self):
+        while not self._closed:
+            self._flush_wake.wait()
+            if self._closed:
+                return
+            self._flush_wake.clear()
+            # hold the 1 Hz cadence without holding any lock
+            delay = 1.0 - (time.time() - self._last_write)
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                self.flush()
+            except Exception as e:  # noqa: BLE001 - flusher must survive
+                # snapshot dir gone (teardown), disk trouble, or a
+                # non-JSON-safe state blob: stay alive — a dead flusher
+                # silently downgrades "≤1 s stale" to cycle-length staleness.
+                # The next synchronous flush() surfaces the error to a caller.
+                print(f"[foremast-tpu] snapshot flush failed: {e}", flush=True)
+                time.sleep(1.0)
 
     def flush(self):
         """Force-write the snapshot (called at cycle boundaries/shutdown).
 
-        Serialize AND write under the lock: concurrent flushes share one
-        .tmp path, so an unlocked write lets two threads interleave bytes
-        and os.replace() a corrupt snapshot into place.
+        The payload is cut under the store lock (to_json/asdict deep-copy,
+        so the cut is a consistent point-in-time view); dumps+write happen
+        outside it so transitions never wait on disk. _write_lock keeps the
+        shared .tmp path single-writer, and the sequence check drops a flush
+        that lost the race to a newer one — os.replace()ing an older
+        snapshot over a newer one would be a durability regression.
         """
         if not self._snapshot_path:
             return
@@ -368,10 +410,30 @@ class JobStore:
             }
             self._dirty = False
             self._last_write = time.time()
-            tmp = self._snapshot_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(data, f)
-            os.replace(tmp, self._snapshot_path)
+            self._flush_seq += 1
+            seq = self._flush_seq
+        try:
+            payload = json.dumps(data)
+            with self._write_lock:
+                if seq <= self._written_seq:
+                    return  # a newer snapshot already reached disk
+                tmp = self._snapshot_path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(payload)
+                os.replace(tmp, self._snapshot_path)
+                self._written_seq = seq
+        except BaseException:
+            with self._lock:
+                self._dirty = True  # this payload never landed; don't lose it
+            raise
+
+    def close(self):
+        """Final flush + stop the background flusher (idempotent)."""
+        self._closed = True
+        self._flush_wake.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=5.0)
+        self.flush()
 
     def _load(self):
         if not os.path.exists(self._snapshot_path):

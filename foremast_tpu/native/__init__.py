@@ -15,7 +15,7 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "parse_series", "resample", "lib_path"]
+__all__ = ["available", "parse_series", "parse_grid", "resample", "lib_path"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "foremast_native.cpp")
@@ -45,47 +45,84 @@ def _build() -> bool:
 
 def _load():
     global _lib, _state
+    # lock-free fast path: after the first load, every parse/resample call
+    # lands here — taking _lock each time serializes the fetch pool's
+    # threads on a hot mutex for no reason (double-checked locking; the
+    # GIL makes the two reads atomic, and _state is written last)
+    if _state == "ready":
+        return _lib
+    if _state == "failed":
+        return None
     with _lock:
         if _state != "unloaded":
             return _lib
-        _state = "failed"
-        if os.environ.get("FOREMAST_NATIVE", "1") == "0":
-            return None
-        if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        ):
-            if not _build():
-                return None
+        # outcome is decided before _state leaves "unloaded" (the finally
+        # below), so lock-free readers either see a final state or block
+        # here behind the loading thread — never a transient "failed"
         try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+            return _try_load()
+        finally:
+            if _state == "unloaded":
+                _state = "failed"
+
+
+def _try_load():
+    global _lib, _state
+    if os.environ.get("FOREMAST_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_SO) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+    ):
+        if not _build():
             return None
-        lib.fm_parse_series.restype = ctypes.c_int
-        lib.fm_parse_series.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_long,
-            ctypes.c_int,
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
-            ctypes.POINTER(ctypes.c_long),
-        ]
-        lib.fm_resample.restype = None
-        lib.fm_resample.argtypes = [
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            ctypes.c_long,
-            ctypes.c_long,
-            ctypes.c_long,
-            ctypes.c_long,
-            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
-        ]
-        lib.fm_free.restype = None
-        lib.fm_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        _state = "ready"
-        return _lib
+    try:
+        lib = ctypes.CDLL(_SO)
+        _bind(lib)
+    except (OSError, AttributeError):
+        # AttributeError: a stale prebuilt .so missing a newer symbol (src
+        # absent so the rebuild check couldn't fire) — degrade to the
+        # Python path rather than crashing the first fetch
+        return None
+    _lib = lib
+    _state = "ready"
+    return _lib
+
+
+def _bind(lib):
+    lib.fm_parse_series.restype = ctypes.c_int
+    lib.fm_parse_series.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.fm_resample.restype = None
+    lib.fm_resample.argtypes = [
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_long,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+    ]
+    lib.fm_parse_grid.restype = ctypes.c_long
+    lib.fm_parse_grid.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.c_int,
+        ctypes.c_long,
+        ctypes.c_long,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.fm_free.restype = None
+    lib.fm_free.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -116,6 +153,32 @@ def parse_series(buf: bytes, flavor: int):
         lib.fm_free(ts_p)
         lib.fm_free(val_p)
     return ts, vals
+
+
+def parse_grid(buf: bytes, flavor: int, step: int = 60,
+               max_steps: int = 16384):
+    """Fused parse+grid: response bytes -> (values f32, mask bool, start)
+    in one native call — the window the engine would build from
+    parse_series + the align/clamp/resample steps, without intermediate
+    arrays crossing the ctypes boundary. Returns None when the library is
+    unavailable or the body is malformed (caller falls back to the
+    parse_series / Python path); an empty-but-valid body yields the
+    1-slot empty window the engine uses as its "no data" marker."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_vals = np.empty(max_steps, np.float32)
+    out_mask = np.empty(max_steps, np.uint8)
+    start = ctypes.c_long()
+    T = lib.fm_parse_grid(
+        buf, len(buf), flavor, step, max_steps, out_vals, out_mask,
+        ctypes.byref(start),
+    )
+    if T < 0:
+        return None
+    if T == 0:
+        return np.zeros(1, np.float32), np.zeros(1, bool), 0
+    return out_vals[:T].copy(), out_mask[:T].astype(bool), int(start.value)
 
 
 def resample(ts, vals, start: int, end: int, step: int):

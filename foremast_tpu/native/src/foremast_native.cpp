@@ -23,6 +23,14 @@
 //   fm_resample(ts, vals, n, start, end, step, out_vals, out_mask)
 //     Snap samples onto the [start, end) grid: nearest slot, later samples
 //     win, non-finite dropped — semantics of ops.windowing.resample_to_grid.
+//   fm_parse_grid(buf, len, flavor, step, max_steps, out_vals, out_mask,
+//                 &start) -> T | 0 (no samples) | -1 (malformed)
+//     The fused hot path: response bytes -> dense grid in ONE call (and one
+//     GIL release), combining fm_parse_series' scan/merge with the grid
+//     derivation the engine does per window (engine/analyzer.py
+//     _fetch_window: end = align(max_ts)+step, start clamped to max_steps)
+//     and fm_resample — no intermediate (ts, vals) arrays ever cross the
+//     ctypes boundary.
 //   fm_free(p) frees arrays returned by fm_parse_series.
 
 #include <cmath>
@@ -230,6 +238,26 @@ class Scanner {
     int depth_ = 0;
 };
 
+// Sort by timestamp and average duplicates in place (same-key accumulation
+// as fetch._avg_series); returns the compacted length.
+long merge_pairs(std::vector<Pair>& pairs) {
+    std::stable_sort(pairs.begin(), pairs.end(),
+                     [](const Pair& a, const Pair& b) { return a.ts < b.ts; });
+    long n = (long)pairs.size();
+    long m = 0;
+    long i = 0;
+    while (i < n) {
+        long j = i;
+        double acc = 0.0;
+        while (j < n && pairs[j].ts == pairs[i].ts) acc += pairs[j++].val;
+        pairs[m].ts = pairs[i].ts;
+        pairs[m].val = acc / (double)(j - i);
+        ++m;
+        i = j;
+    }
+    return m;
+}
+
 }  // namespace
 
 extern "C" {
@@ -242,32 +270,73 @@ int fm_parse_series(const char* buf, long len, int flavor,
     Scanner sc(buf, len, flavor, &pairs);
     if (!sc.value()) return -2;
 
-    std::stable_sort(pairs.begin(), pairs.end(),
-                     [](const Pair& a, const Pair& b) { return a.ts < b.ts; });
-    long n = (long)pairs.size();
-    double* ts = (double*)std::malloc(sizeof(double) * (n ? n : 1));
-    double* vals = (double*)std::malloc(sizeof(double) * (n ? n : 1));
+    long m = merge_pairs(pairs);
+    double* ts = (double*)std::malloc(sizeof(double) * (m ? m : 1));
+    double* vals = (double*)std::malloc(sizeof(double) * (m ? m : 1));
     if (!ts || !vals) {
         std::free(ts);
         std::free(vals);
         return -3;
     }
-    // average duplicate timestamps (same-key accumulation as _avg_series)
-    long m = 0;
-    long i = 0;
-    while (i < n) {
-        long j = i;
-        double acc = 0.0;
-        while (j < n && pairs[j].ts == pairs[i].ts) acc += pairs[j++].val;
-        ts[m] = pairs[i].ts;
-        vals[m] = acc / (double)(j - i);
-        ++m;
-        i = j;
+    for (long i = 0; i < m; ++i) {
+        ts[i] = pairs[i].ts;
+        vals[i] = pairs[i].val;
     }
     *out_ts = ts;
     *out_vals = vals;
     *out_n = m;
     return 0;
+}
+
+long fm_parse_grid(const char* buf, long len, int flavor,
+                   long step, long max_steps,
+                   float* out_vals, unsigned char* out_mask,
+                   long* out_start) {
+    if (!buf || len <= 0 || step <= 0 || max_steps <= 0) return -1;
+    std::vector<Pair> pairs;
+    pairs.reserve(1024);
+    Scanner sc(buf, len, flavor, &pairs);
+    if (!sc.value()) return -1;
+    long m = merge_pairs(pairs);
+
+    // grid span from the finite timestamps (truncating align matches
+    // align_step's int(t)//step*step for the positive unix times in play)
+    double tmin = 0.0, tmax = 0.0;
+    bool any = false;
+    for (long i = 0; i < m; ++i) {
+        double t = pairs[i].ts;
+        if (!std::isfinite(t)) continue;
+        if (!any) { tmin = tmax = t; any = true; }
+        else {
+            if (t < tmin) tmin = t;
+            if (t > tmax) tmax = t;
+        }
+    }
+    *out_start = 0;
+    if (!any) return 0;
+    long end = (long)tmax / step * step + step;
+    long start = (long)tmin / step * step;
+    if (start < end - max_steps * step) start = end - max_steps * step;
+    long T = (end - start) / step;
+    if (T < 1) T = 1;
+    if (T > max_steps) T = max_steps;
+
+    for (long i = 0; i < T; ++i) {
+        out_vals[i] = 0.0f;
+        out_mask[i] = 0;
+    }
+    for (long i = 0; i < m; ++i) {
+        double t = pairs[i].ts, v = pairs[i].val;
+        if (!std::isfinite(t) || !std::isfinite(v)) continue;
+        if (t < (double)start || t >= (double)end) continue;
+        long idx = (long)std::nearbyint((t - (double)start) / (double)step);
+        if (idx < 0) idx = 0;
+        if (idx > T - 1) idx = T - 1;
+        out_vals[idx] = (float)v;
+        out_mask[idx] = 1;
+    }
+    *out_start = start;
+    return T;
 }
 
 void fm_resample(const double* ts, const double* vals, long n,

@@ -114,7 +114,7 @@ class Runtime:
             self._server.shutdown()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=2.0)
-        self.store.flush()
+        self.store.close()
 
     def run_forever(self, **kw):
         self.start(**kw)

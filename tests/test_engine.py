@@ -4,6 +4,9 @@ The e2e test is SURVEY.md §7's "minimum end-to-end slice": a synthetic
 ErrorGenerator scenario (reference demo app self-inflicts 5xx) through a
 fixture data source -> job -> batched TPU-kernel scoring -> verdict.
 """
+import os
+import time
+
 import numpy as np
 import pytest
 
@@ -72,9 +75,37 @@ def test_snapshot_resume(tmp_path):
     store.create(Document(id="j", app_name="a", strategy="canary",
                           start_time="", end_time="",
                           metrics={"error5xx": MetricQueries(current="u1")}))
+    store.flush()  # write-behind store: boundaries flush explicitly
     store2 = JobStore(snapshot_path=p)
     doc = store2.get("j")
     assert doc is not None and doc.metrics["error5xx"].current == "u1"
+
+
+def test_snapshot_background_flusher_writes_without_explicit_flush(tmp_path):
+    """Mutations persist via the background flusher alone (write-behind
+    durability: snapshot at most ~1 s stale with no flush() call)."""
+    p = str(tmp_path / "snap.json")
+    store = JobStore(snapshot_path=p)
+    store.create(Document(id="j", app_name="a", strategy="canary",
+                          start_time="", end_time=""))
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if os.path.exists(p) and JobStore(snapshot_path=str(p)).get("j"):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("background flusher never wrote the snapshot")
+    store.close()
+
+
+def test_store_close_flushes_and_is_idempotent(tmp_path):
+    p = str(tmp_path / "snap.json")
+    store = JobStore(snapshot_path=p)
+    store.create(Document(id="j", app_name="a", strategy="canary",
+                          start_time="", end_time=""))
+    store.close()
+    store.close()  # second close is a no-op, not an error
+    assert JobStore(snapshot_path=p).get("j") is not None
 
 
 # ---------------------------------------------------------------- e2e slice
@@ -340,3 +371,67 @@ def test_engine_lstm_mode_passes_healthy_and_caches_model():
     store.create(_multi_job(fixtures, bad=False))
     analyzer.run_cycle(now=1_000_001.0)
     assert len(analyzer._lstm_cache) == 1
+
+
+# ------------------------------------------------- fetch_window fallback path
+# These live here (NOT in test_native.py, which skips wholesale without a
+# toolchain) because they are exactly the coverage for the no-native case.
+def _prom_raw(series):
+    import json as _json
+
+    return _json.dumps({
+        "status": "success",
+        "data": {"resultType": "matrix",
+                 "result": [{"metric": {}, "values": [[t, str(v)] for t, v in s]}
+                            for s in series]},
+    }).encode()
+
+
+def test_fetch_window_matches_fetch_plus_grid():
+    """RawFixtureDataSource.fetch_window == grid_from_series(fetch(url)) —
+    the two engine paths stay equivalent whether or not native is built."""
+    from foremast_tpu.dataplane.fetch import RawFixtureDataSource, grid_from_series
+
+    t0 = 1_700_000_000 // 60 * 60
+    raw = _prom_raw([[(t0 + 60 * i, float(i) * 1.5) for i in range(100)]])
+    src = RawFixtureDataSource({"http://q": raw})
+    win = src.fetch_window("http://q")
+    ts, vals = src.fetch("http://q")
+    want = grid_from_series(ts, vals)
+    assert win.start == want.start and win.step == want.step
+    np.testing.assert_array_equal(win.values, want.values)
+    np.testing.assert_array_equal(win.mask, want.mask)
+    assert src.requests == ["http://q", "http://q"]
+
+
+def test_fetch_window_empty_body_parity_any_step():
+    """Empty responses produce the same 1-slot empty Window (including
+    step) on both the native and pure-Python paths."""
+    from foremast_tpu.dataplane.fetch import window_from_prometheus_body
+
+    raw = _prom_raw([])
+    for step in (60, 300):
+        w = window_from_prometheus_body(raw, step=step)
+        assert len(w.values) == 1 and not w.mask.any()
+        assert w.start == 0 and w.step == step
+
+
+def test_caching_source_caches_windows_separately():
+    from foremast_tpu.dataplane.fetch import (
+        CachingDataSource,
+        FixtureDataSource,
+        RawFixtureDataSource,
+    )
+
+    t0 = 1_700_000_000 // 60 * 60
+    raw = _prom_raw([[(t0 + 60 * i, 2.0) for i in range(10)]])
+    inner = RawFixtureDataSource({"http://q": raw})
+    src = CachingDataSource(inner, ttl_seconds=60.0)
+    w1 = src.fetch_window("http://q")
+    w2 = src.fetch_window("http://q")
+    assert w2 is w1 and src.hits == 1  # second hit served from cache
+    src.fetch("http://q")  # parsed-series entry is a SEPARATE key
+    assert src.misses == 2
+    # non-byte inner -> fetch_window signals "use fetch()"
+    plain = CachingDataSource(FixtureDataSource({"u": ([1], [1.0])}))
+    assert plain.fetch_window("u") is None

@@ -198,3 +198,54 @@ def test_deeply_nested_body_falls_back_not_segfault():
     assert native.parse_series(b"[" * 200_000, native.FLAVOR_PROMETHEUS) is None
     deep = b"[" * 200_000 + b"]" * 200_000
     assert native.parse_series(deep, native.FLAVOR_PROMETHEUS) is None
+
+
+# ---------------------------------------------------- fused parse_grid path
+def _grid_ref(raw, step=60, max_steps=16384):
+    """Reference: python parse + the engine's span derivation + resampler."""
+    from foremast_tpu.dataplane.fetch import grid_from_series
+
+    ts, vals = _py_prom(raw)
+    return grid_from_series(ts, vals, step, max_steps)
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_parse_grid_parity_with_python_pipeline():
+    rng = np.random.default_rng(3)
+    t0 = 1_700_000_000 // 60 * 60
+    # ragged, duplicated, string-encoded, multi-series
+    s1 = [(t0 + 60 * i, float(rng.normal())) for i in range(200)]
+    s2 = [(t0 + 60 * i + 17, float(rng.normal())) for i in range(0, 200, 3)]
+    s2 += s2[:5]  # duplicates -> averaged
+    raw = _prom_payload([s1, s2])
+    got = native.parse_grid(raw, native.FLAVOR_PROMETHEUS)
+    assert got is not None
+    vals, mask, start = got
+    want = _grid_ref(raw)
+    assert start == want.start
+    np.testing.assert_array_equal(mask, want.mask)
+    np.testing.assert_allclose(vals, want.values, rtol=0, atol=0)
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_parse_grid_clamps_span_to_max_steps():
+    t0 = 1_700_000_000 // 60 * 60
+    # 2-day span at 60 s, clamped to a 1-day grid keeping the NEWEST samples
+    s = [(t0 + 60 * i, float(i)) for i in range(2880)]
+    raw = _prom_payload([s])
+    vals, mask, start = native.parse_grid(
+        raw, native.FLAVOR_PROMETHEUS, 60, 1440
+    )
+    want = _grid_ref(raw, 60, 1440)
+    assert len(vals) == 1440 and start == want.start
+    np.testing.assert_array_equal(vals, want.values)
+    # the retained slots are the most recent ones
+    assert vals[-1] == 2879.0
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_parse_grid_empty_and_malformed():
+    empty = _prom_payload([])
+    vals, mask, start = native.parse_grid(empty, native.FLAVOR_PROMETHEUS)
+    assert len(vals) == 1 and not mask.any() and start == 0
+    assert native.parse_grid(b"{nope", native.FLAVOR_PROMETHEUS) is None
