@@ -237,7 +237,7 @@ class CachingDataSource:
         self.misses = 0
 
     def fetch(self, url: str):
-        return self._cached(url, self.inner.fetch)
+        return self._cached(url, self.inner.fetch, url)
 
     def fetch_window(self, url: str):
         """Delegate the engine's Window fast path through the same cache
@@ -259,7 +259,7 @@ class CachingDataSource:
                     self.hits += 1
                     return res
                 del self._cache[key]
-        res = fn(*(args or (key,)))
+        res = fn(*args)
         with self._lock:
             self.misses += 1
             self._cache[key] = (res, now)

@@ -733,8 +733,8 @@ class Analyzer:
                         doc_id, J.PREPROCESS_FAILED, reason=st.failed, worker=worker
                     )
             else:
-                self.store.transition(doc_id, J.PREPROCESS_COMPLETED, worker=worker)
-                self.store.transition(doc_id, J.POSTPROCESS_INPROGRESS, worker=worker)
+                self.store.advance(doc_id, J.PREPROCESS_COMPLETED,
+                                   J.POSTPROCESS_INPROGRESS, worker=worker)
 
         live = {k: v for k, v in states.items() if not v.failed}
         with tracing.span("engine.score", pairs=len(all_pairs),

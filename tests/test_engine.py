@@ -435,3 +435,40 @@ def test_caching_source_caches_windows_separately():
     # non-byte inner -> fetch_window signals "use fetch()"
     plain = CachingDataSource(FixtureDataSource({"u": ([1], [1.0])}))
     assert plain.fetch_window("u") is None
+
+
+def test_document_to_json_covers_every_dataclass_field():
+    """to_json is hand-rolled for flush speed; this pins it against the
+    dataclass so adding a field without serializing it fails here."""
+    import dataclasses
+
+    doc = Document(id="j", app_name="a", strategy="canary",
+                   start_time="s", end_time="e",
+                   metrics={"m": MetricQueries(current="u", priority=2)},
+                   anomaly={"m": [1, 2.0]})
+    d = doc.to_json()
+    assert set(d) == {f.name for f in dataclasses.fields(Document)}
+    assert set(d["metrics"]["m"]) == {
+        f.name for f in dataclasses.fields(MetricQueries)
+    }
+    # the payload is detached: mutating it cannot corrupt the doc
+    d["anomaly"]["m"].append(99)
+    d["metrics"]["m"]["current"] = "x"
+    assert doc.anomaly["m"] == [1, 2.0]
+    assert doc.metrics["m"].current == "u"
+    # and it round-trips
+    assert Document.from_json(doc.to_json()) == doc
+
+
+def test_advance_validates_each_hop_and_rejects_terminal():
+    store = JobStore()
+    store.create(Document(id="j", app_name="a", strategy="canary",
+                          start_time="", end_time=""))
+    store.claim_open_jobs("w")
+    store.advance("j", J.PREPROCESS_COMPLETED, J.POSTPROCESS_INPROGRESS,
+                  worker="w")
+    assert store.get("j").status == J.POSTPROCESS_INPROGRESS
+    with pytest.raises(J.InvalidTransition):
+        store.advance("j", J.COMPLETED_HEALTH)  # terminal -> transition()
+    with pytest.raises(J.InvalidTransition):
+        store.advance("j", J.PREPROCESS_COMPLETED)  # invalid hop
