@@ -137,29 +137,47 @@ class PrometheusDataSource:
         return window_from_prometheus_body(self._raw(url))
 
 
+def parse_wavefront_body(raw: bytes):
+    """Chart-API body -> (ts, vals); native fast path, Python fallback."""
+    parsed = native.parse_series(raw, native.FLAVOR_WAVEFRONT)
+    if parsed is not None:
+        return parsed
+    payload = json.loads(raw)
+    series = [
+        [(float(ts), float(v)) for ts, v in item.get("data", [])]
+        for item in payload.get("timeseries", [])
+    ]
+    return _avg_series(series)
+
+
 class WavefrontDataSource:
     def __init__(self, token: str = "", timeout: float = 10.0):
         self.token = token
         self.timeout = timeout
 
-    def fetch(self, url: str):
+    def _raw(self, url: str) -> bytes:
         req = urllib.request.Request(url)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                raw = r.read()
+                return r.read()
         except Exception as e:  # noqa: BLE001
             raise FetchError(f"wavefront fetch failed: {e}") from e
-        parsed = native.parse_series(raw, native.FLAVOR_WAVEFRONT)
-        if parsed is not None:
-            return parsed
-        payload = json.loads(raw)
-        series = [
-            [(float(ts), float(v)) for ts, v in item.get("data", [])]
-            for item in payload.get("timeseries", [])
-        ]
-        return _avg_series(series)
+
+    def fetch(self, url: str):
+        return parse_wavefront_body(self._raw(url))
+
+    def fetch_window(self, url: str, step: int = 60,
+                     max_steps: int = MAX_WINDOW_STEPS) -> Window:
+        """Fused byte path, same shape as the Prometheus sources'."""
+        raw = self._raw(url)
+        win = native.parse_grid(raw, native.FLAVOR_WAVEFRONT, step, max_steps)
+        if win is not None:
+            vals, mask, start = win
+            return Window(vals, mask, start, step)
+        ts, vals = parse_wavefront_body(raw)
+        return grid_from_series(ts, vals, step, max_steps)
 
 
 class RawFixtureDataSource:

@@ -283,15 +283,18 @@ class JobStore:
         go through transition())."""
         with self._lock:
             doc = self._jobs[job_id]
+            # validate the WHOLE chain before touching the doc: a mid-chain
+            # failure must not leave it half-advanced with a stale snapshot
+            cur = doc.status
             for new_status in statuses:
-                allowed = _TRANSITIONS.get(doc.status, set())
-                if new_status not in allowed:
-                    raise InvalidTransition(f"{doc.status} -> {new_status}")
+                if new_status not in _TRANSITIONS.get(cur, set()):
+                    raise InvalidTransition(f"{cur} -> {new_status}")
                 if new_status in TERMINAL_STATUSES:
                     raise InvalidTransition(
                         f"terminal {new_status} must go through transition()"
                     )
-                doc.status = new_status
+                cur = new_status
+            doc.status = cur
             doc.modified_at = time.time()
             if worker:
                 doc.lease_holder = worker

@@ -21,6 +21,10 @@ Env surface (union of the reference services'):
   GRPC_PORT              gRPC dispatch port (0/unset disables; 8100 in the
                          shipped manifests) — service/grpc_api.py
   CYCLE_SECONDS          engine cycle cadence (brain poll loop)
+  HTTP_MAX_INFLIGHT      HTTP admission gate: in-flight handler ceiling,
+                         excess connections shed with 503 (default 128)
+  GRPC_MAX_CONCURRENT    gRPC admission gate: maximum_concurrent_rpcs,
+                         excess rejected RESOURCE_EXHAUSTED (default 256)
   WAVEFRONT_PROXY        host[:port] of a Wavefront proxy to mirror the
                          verdict series to (custom.iks.foremast.*)
 """
@@ -80,14 +84,20 @@ class Runtime:
         """Start the HTTP (and optional gRPC) servers and the engine worker
         loop (background). grpc_port=0 binds an ephemeral port (see
         grpc_bound_port); None disables the gRPC front."""
-        self._server = make_server(self.service, host, port)
+        self._server = make_server(
+            self.service, host, port,
+            max_in_flight=int(os.environ.get("HTTP_MAX_INFLIGHT", "128")),
+        )
         t_http = threading.Thread(target=self._server.serve_forever, daemon=True)
         t_http.start()
         if grpc_port is not None:
             from .service.grpc_api import serve_grpc_background
 
             self._grpc_server, self.grpc_bound_port = serve_grpc_background(
-                self.service, host=host, port=grpc_port
+                self.service, host=host, port=grpc_port,
+                max_concurrent_rpcs=int(
+                    os.environ.get("GRPC_MAX_CONCURRENT", "256")
+                ),
             )
         t_eng = threading.Thread(
             target=self._worker_loop, args=(cycle_seconds, worker), daemon=True

@@ -331,7 +331,8 @@ class ForemastService:
             return 500, {"error": f"dashboard assets unavailable: {e}"}
 
 
-def make_server(service: ForemastService, host: str = "0.0.0.0", port: int = 8099):
+def make_server(service: ForemastService, host: str = "0.0.0.0",
+                port: int = 8099, max_in_flight: int = 128):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet
             pass
@@ -409,12 +410,64 @@ def make_server(service: ForemastService, host: str = "0.0.0.0", port: int = 809
             except Exception as e:  # noqa: BLE001
                 self._send(500, {"error": str(e)})
 
-    server = ThreadingHTTPServer((host, port), Handler)
+    server = BoundedThreadingHTTPServer((host, port), Handler,
+                                        max_in_flight=max_in_flight)
     return server
 
 
-def serve_background(service: ForemastService, host="127.0.0.1", port=8099):
-    server = make_server(service, host, port)
+class BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with admission control.
+
+    The stdlib server spawns one thread per accepted connection with no
+    ceiling — under a create flood that is unbounded thread growth and
+    eventual memory exhaustion (round-2 front-door finding). Here a
+    saturation gate caps in-flight handlers: excess connections are shed
+    on the ACCEPTOR thread with a minimal `503 Retry-After` and closed,
+    costing one syscall rather than a thread. Clients see fast, explicit
+    backpressure instead of an unbounded queue with growing latency.
+    """
+
+    daemon_threads = True
+
+    _SHED_BODY = b'{"error": "server saturated, retry"}'
+    _SHED = (
+        b"HTTP/1.1 503 Service Unavailable\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: " + str(len(_SHED_BODY)).encode() + b"\r\n"
+        b"Retry-After: 1\r\n"
+        b"Connection: close\r\n\r\n" + _SHED_BODY
+    )
+
+    def __init__(self, addr, handler_cls, max_in_flight: int = 128):
+        super().__init__(addr, handler_cls)
+        self._slots = threading.BoundedSemaphore(max_in_flight)
+        self.shed_count = 0  # observability: how often the gate fired
+
+    def process_request(self, request, client_address):
+        if not self._slots.acquire(blocking=False):
+            self.shed_count += 1
+            try:
+                request.sendall(self._SHED)
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        try:
+            super().process_request(request, client_address)
+        except BaseException:
+            self._slots.release()
+            raise
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._slots.release()
+
+
+def serve_background(service: ForemastService, host="127.0.0.1", port=8099,
+                     max_in_flight: int = 128):
+    server = make_server(service, host, port, max_in_flight=max_in_flight)
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server

@@ -273,8 +273,14 @@ def make_grpc_server(
     host: str = "0.0.0.0",
     port: int = 8100,
     max_workers: int = 8,
+    max_concurrent_rpcs: int | None = 256,
 ) -> tuple[grpc.Server, int]:
-    """Build (unstarted) gRPC server; returns (server, bound_port)."""
+    """Build (unstarted) gRPC server; returns (server, bound_port).
+
+    max_concurrent_rpcs is the admission gate (same role as the HTTP
+    facade's BoundedThreadingHTTPServer): past it, grpc rejects new RPCs
+    with RESOURCE_EXHAUSTED immediately instead of queueing them behind
+    the worker pool — callers get explicit backpressure, not timeouts."""
 
     def create(request):
         status, payload = service.create(create_request_to_dict(request))
@@ -356,7 +362,10 @@ def make_grpc_server(
             response_serializer=pb.AlertReply.SerializeToString,
         ),
     }
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        maximum_concurrent_rpcs=max_concurrent_rpcs,
+    )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE_NAME, rpcs),)
     )
@@ -367,10 +376,14 @@ def make_grpc_server(
 
 
 def serve_grpc_background(
-    service: ForemastService, host: str = "127.0.0.1", port: int = 0
+    service: ForemastService, host: str = "127.0.0.1", port: int = 0,
+    max_workers: int = 8, max_concurrent_rpcs: int | None = 256,
 ) -> tuple[grpc.Server, int]:
     """Start a gRPC server on a background thread; port=0 picks a free one."""
-    server, bound = make_grpc_server(service, host, port)
+    server, bound = make_grpc_server(
+        service, host, port, max_workers=max_workers,
+        max_concurrent_rpcs=max_concurrent_rpcs,
+    )
     server.start()
     return server, bound
 

@@ -472,3 +472,37 @@ def test_advance_validates_each_hop_and_rejects_terminal():
         store.advance("j", J.COMPLETED_HEALTH)  # terminal -> transition()
     with pytest.raises(J.InvalidTransition):
         store.advance("j", J.PREPROCESS_COMPLETED)  # invalid hop
+
+
+def test_wavefront_fetch_window_matches_fetch_plus_grid(monkeypatch):
+    import json as _json
+
+    from foremast_tpu.dataplane import fetch as F
+
+    t0 = 1_700_000_000 // 60 * 60
+    raw = _json.dumps({"timeseries": [
+        {"data": [[t0 + 60 * i, float(i)] for i in range(50)]}
+    ]}).encode()
+    src = F.WavefrontDataSource()
+    monkeypatch.setattr(src, "_raw", lambda url: raw)
+    win = src.fetch_window("http://wf")
+    ts, vals = src.fetch("http://wf")
+    want = F.grid_from_series(ts, vals)
+    assert win.start == want.start
+    np.testing.assert_array_equal(win.values, want.values)
+    np.testing.assert_array_equal(win.mask, want.mask)
+
+
+def test_advance_failed_chain_leaves_doc_untouched():
+    """advance() validates the whole chain before mutating: a bad chain
+    must not leave the doc half-advanced (snapshot/live divergence)."""
+    store = JobStore()
+    store.create(Document(id="j", app_name="a", strategy="canary",
+                          start_time="", end_time=""))
+    store.claim_open_jobs("w")
+    before = store.get("j").modified_at
+    with pytest.raises(J.InvalidTransition):
+        store.advance("j", J.PREPROCESS_COMPLETED, J.COMPLETED_HEALTH)
+    doc = store.get("j")
+    assert doc.status == J.PREPROCESS_INPROGRESS  # unchanged
+    assert doc.modified_at == before
