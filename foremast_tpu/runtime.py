@@ -23,8 +23,11 @@ Env surface (union of the reference services'):
   CYCLE_SECONDS          engine cycle cadence (brain poll loop)
   HTTP_MAX_INFLIGHT      HTTP admission gate: in-flight handler ceiling,
                          excess connections shed with 503 (default 128)
+  GRPC_WORKERS           gRPC worker threads (default 8)
   GRPC_MAX_CONCURRENT    gRPC admission gate: maximum_concurrent_rpcs,
-                         excess rejected RESOURCE_EXHAUSTED (default 256)
+                         excess rejected RESOURCE_EXHAUSTED (default
+                         4x GRPC_WORKERS, keeping the accepted queue
+                         shallow enough to finish within deadlines)
   WAVEFRONT_PROXY        host[:port] of a Wavefront proxy to mirror the
                          verdict series to (custom.iks.foremast.*)
 """
@@ -80,24 +83,30 @@ class Runtime:
     # -- lifecycle --
     def start(self, host: str = "0.0.0.0", port: int = 8099,
               cycle_seconds: float = 10.0, worker: str = "worker-0",
-              grpc_port: int | None = None):
+              grpc_port: int | None = None,
+              http_max_inflight: int | None = None,
+              grpc_workers: int | None = None,
+              grpc_max_concurrent: int | None = None):
         """Start the HTTP (and optional gRPC) servers and the engine worker
         loop (background). grpc_port=0 binds an ephemeral port (see
-        grpc_bound_port); None disables the gRPC front."""
-        self._server = make_server(
-            self.service, host, port,
-            max_in_flight=int(os.environ.get("HTTP_MAX_INFLIGHT", "128")),
-        )
+        grpc_bound_port); None disables the gRPC front. The admission-gate
+        knobs default to the service layer's own defaults when None (env
+        parsing lives in main(), like every other runtime knob)."""
+        http_kw = {} if http_max_inflight is None else {
+            "max_in_flight": http_max_inflight}
+        self._server = make_server(self.service, host, port, **http_kw)
         t_http = threading.Thread(target=self._server.serve_forever, daemon=True)
         t_http.start()
         if grpc_port is not None:
             from .service.grpc_api import serve_grpc_background
 
+            grpc_kw = {}
+            if grpc_workers is not None:
+                grpc_kw["max_workers"] = grpc_workers
+            if grpc_max_concurrent is not None:
+                grpc_kw["max_concurrent_rpcs"] = grpc_max_concurrent
             self._grpc_server, self.grpc_bound_port = serve_grpc_background(
-                self.service, host=host, port=grpc_port,
-                max_concurrent_rpcs=int(
-                    os.environ.get("GRPC_MAX_CONCURRENT", "256")
-                ),
+                self.service, host=host, port=grpc_port, **grpc_kw
             )
         t_eng = threading.Thread(
             target=self._worker_loop, args=(cycle_seconds, worker), daemon=True
@@ -188,13 +197,23 @@ def main():
     port = int(os.environ.get("PORT", "8099"))
     grpc_port = int(os.environ.get("GRPC_PORT", "0")) or None
     cycle = float(os.environ.get("CYCLE_SECONDS", "10"))
+
+    def _env_opt_int(name: str) -> int | None:
+        raw = os.environ.get(name, "")
+        return int(raw) if raw else None
+
     print(
         f"[foremast-tpu] serving :{port}"
         + (f" grpc :{grpc_port}" if grpc_port else "")
         + f", cycle={cycle}s",
         flush=True,
     )
-    rt.run_forever(port=port, cycle_seconds=cycle, grpc_port=grpc_port)
+    rt.run_forever(
+        port=port, cycle_seconds=cycle, grpc_port=grpc_port,
+        http_max_inflight=_env_opt_int("HTTP_MAX_INFLIGHT"),
+        grpc_workers=_env_opt_int("GRPC_WORKERS"),
+        grpc_max_concurrent=_env_opt_int("GRPC_MAX_CONCURRENT"),
+    )
 
 
 if __name__ == "__main__":

@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -448,6 +449,21 @@ class BoundedThreadingHTTPServer(ThreadingHTTPServer):
             self.shed_count += 1
             try:
                 request.sendall(self._SHED)
+                # lingering close: drain the unread request (line, headers,
+                # body already in our receive buffer) before closing —
+                # close() with unread data RSTs the connection and the
+                # client sees ECONNRESET instead of the 503. This runs on
+                # the ACCEPTOR thread, so it is bounded by wall-clock
+                # (50 ms total), not just bytes — a 1-byte-per-15 ms
+                # trickler must not pin the accept loop.
+                request.settimeout(0.02)
+                deadline = time.monotonic() + 0.05
+                drained = 0
+                while drained < 262_144 and time.monotonic() < deadline:
+                    chunk = request.recv(65_536)
+                    if not chunk:
+                        break
+                    drained += len(chunk)
             except OSError:
                 pass
             self.shutdown_request(request)

@@ -273,14 +273,19 @@ def make_grpc_server(
     host: str = "0.0.0.0",
     port: int = 8100,
     max_workers: int = 8,
-    max_concurrent_rpcs: int | None = 256,
+    max_concurrent_rpcs: int | None = None,
 ) -> tuple[grpc.Server, int]:
     """Build (unstarted) gRPC server; returns (server, bound_port).
 
     max_concurrent_rpcs is the admission gate (same role as the HTTP
-    facade's BoundedThreadingHTTPServer): past it, grpc rejects new RPCs
-    with RESOURCE_EXHAUSTED immediately instead of queueing them behind
-    the worker pool — callers get explicit backpressure, not timeouts."""
+    facade's BoundedThreadingHTTPServer): up to the gate, max_workers
+    RPCs run and the rest queue briefly behind the pool; PAST the gate,
+    grpc rejects new RPCs RESOURCE_EXHAUSTED immediately — explicit
+    backpressure instead of deadline timeouts. Default None sizes it at
+    4x the worker pool, so the accepted queue stays shallow enough that
+    queued RPCs still complete within typical caller deadlines."""
+    if max_concurrent_rpcs is None:
+        max_concurrent_rpcs = max_workers * 4
 
     def create(request):
         status, payload = service.create(create_request_to_dict(request))
@@ -377,7 +382,7 @@ def make_grpc_server(
 
 def serve_grpc_background(
     service: ForemastService, host: str = "127.0.0.1", port: int = 0,
-    max_workers: int = 8, max_concurrent_rpcs: int | None = 256,
+    max_workers: int = 8, max_concurrent_rpcs: int | None = None,
 ) -> tuple[grpc.Server, int]:
     """Start a gRPC server on a background thread; port=0 picks a free one."""
     server, bound = make_grpc_server(
