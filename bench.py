@@ -17,6 +17,11 @@ override BENCH_RUNS); compile time reported separately; min/max/std
 included so round-over-round drift in the headline is characterized
 instead of mysterious.
 
+Additionally the UNPRORATED claim is measured outright: the entire
+100k-pair fleet batch on the ONE available chip, same run count and p99
+protocol (p99_s_100k_single_chip). If that is < 1 s, the v5e-8 claim is
+beaten on an eighth of the claimed hardware, no pro-rating needed.
+
 Prints exactly one JSON line.
 """
 from __future__ import annotations
@@ -30,9 +35,13 @@ import time
 import numpy as np
 
 TARGET_PAIRS_PER_SEC_PER_CHIP = 100_000 / 8.0  # north star pro-rated per chip
-B_TOTAL = 100_000
+# BENCH_PAIRS_TOTAL exists for CPU smoke-tests of the bench itself; the
+# recorded artifact always uses the real 100k claim shape, and the JSON
+# self-describes the batch via "pairs_total" so an overridden run can
+# never masquerade as a real one.
+B_TOTAL = int(os.environ.get("BENCH_PAIRS_TOTAL", "100000"))
 N_CHIPS = 8
-B_CHIP = B_TOTAL // N_CHIPS  # 12,500: one chip's shard of the 100k fleet
+B_CHIP = max(B_TOTAL // N_CHIPS, 1)  # 12,500: one chip's shard of 100k
 
 
 def _cycle_bench() -> dict:
@@ -66,14 +75,13 @@ def _cycle_bench() -> dict:
     return extra
 
 
-def main() -> None:
-    cycle_extra = _cycle_bench()
-
+def _measure(B: int, T: int, n_runs: int) -> dict:
+    """Time score_pairs at batch B: p50/p99/min/max/std over n_runs, plus
+    compile time for this batch shape."""
     import jax
 
     from foremast_tpu.parallel.fleet import score_pairs
 
-    B, T = B_CHIP, 128
     rng = np.random.default_rng(0)
     baseline = rng.normal(10.0, 2.0, (B, T)).astype(np.float32)
     current = rng.normal(10.0, 2.0, (B, T)).astype(np.float32)
@@ -100,16 +108,47 @@ def main() -> None:
     run()  # compile + first execute
     compile_s = time.perf_counter() - t0
 
-    n_runs = int(os.environ.get("BENCH_RUNS", "150"))
     times = []
     for _ in range(n_runs):
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
     ts = np.sort(np.asarray(times))
-    p50 = float(np.median(ts))
-    p99 = float(np.percentile(ts, 99))
-    pairs_per_sec = B / p50
+    return {
+        "p50": float(np.median(ts)),
+        "p99": float(np.percentile(ts, 99)),
+        "min": float(ts[0]),
+        "max": float(ts[-1]),
+        "std": float(np.std(ts)),
+        "compile_s": compile_s,
+        "runs": n_runs,
+    }
+
+
+def main() -> None:
+    cycle_extra = _cycle_bench()
+
+    import jax
+
+    T = 128
+    n_runs = int(os.environ.get("BENCH_RUNS", "150"))
+    shard = _measure(B_CHIP, T, n_runs)
+    # the stronger statement: the ENTIRE 100k fleet batch on ONE chip —
+    # no pro-rating, no fleet needed. Same run count (same p99 protocol);
+    # guarded so an 8x-batch OOM can never destroy the headline in hand.
+    try:
+        whole = _measure(B_TOTAL, T, n_runs)
+        whole_fields = {
+            "p99_s_100k_single_chip": round(whole["p99"], 6),
+            "p50_s_100k_single_chip": round(whole["p50"], 6),
+            "single_chip_runs": whole["runs"],
+            "compile_s_100k": round(whole["compile_s"], 3),
+        }
+    except Exception as e:  # noqa: BLE001 - headline must still print
+        whole_fields = {"single_chip_error": f"{type(e).__name__}: {e}"}
+
+    p50, p99 = shard["p50"], shard["p99"]
+    pairs_per_sec = B_CHIP / p50
     print(json.dumps({
         "metric": "canary_pairs_scored_per_sec_per_chip",
         "value": round(pairs_per_sec, 1),
@@ -120,12 +159,16 @@ def main() -> None:
         # (pro-rated; the O(k*8) top-k reduction is excluded — see docstring)
         "p99_s_at_100k": round(p99, 6),
         "p50_s_at_100k": round(p50, 6),
-        "min_s": round(float(ts[0]), 6),
-        "max_s": round(float(ts[-1]), 6),
-        "std_s": round(float(np.std(ts)), 6),
-        "runs": n_runs,
-        "batch_per_chip": B,
-        "compile_s": round(compile_s, 3),
+        "min_s": round(shard["min"], 6),
+        "max_s": round(shard["max"], 6),
+        "std_s": round(shard["std"], 6),
+        "runs": shard["runs"],
+        "batch_per_chip": B_CHIP,
+        "pairs_total": B_TOTAL,
+        "compile_s": round(shard["compile_s"], 3),
+        # the whole 100k batch on ONE chip (unprorated: beats the 8-chip
+        # claim outright if < 1 s)
+        **whole_fields,
         "backend": jax.default_backend(),
         **cycle_extra,
     }))

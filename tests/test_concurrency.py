@@ -16,6 +16,7 @@ assert the invariants that matter:
 from __future__ import annotations
 
 import threading
+import time
 
 from foremast_tpu.engine import Document, JobStore, MetricQueries
 from foremast_tpu.engine import jobs as J
@@ -191,3 +192,71 @@ def test_fakekube_watchers_hear_every_upsert():
     _spawn(4, upserter)
     assert len(seen) == 200
     assert len({n for _, n in seen}) == 200
+
+
+def test_snapshot_never_torn_under_churn(tmp_path):
+    """A reader loading the snapshot at ANY moment during heavy mutation +
+    concurrent flushes must see valid JSON whose docs all decode — the
+    atomic-rename + sequence-ordered background flusher contract."""
+    import json as _json
+    import os as _os
+
+    snap = str(tmp_path / "snap.json")
+    store = JobStore(snapshot_path=snap)
+    stop = threading.Event()
+    errors = []
+
+    def churner(t):
+        try:
+            i = 0
+            while not stop.is_set():
+                store.create(Document(id=f"n{t}-{i}", app_name=f"a{t}",
+                                      strategy="canary", start_time="",
+                                      end_time=""))
+                for doc in store.claim_open_jobs(f"w{t}", limit=4):
+                    store.advance(doc.id, J.PREPROCESS_COMPLETED,
+                                  J.POSTPROCESS_INPROGRESS, worker=f"w{t}")
+                    store.transition(doc.id, J.COMPLETED_HEALTH, worker=f"w{t}")
+                store.put_state(f"k{t}", {"i": i})
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def flusher():
+        try:
+            while not stop.is_set():
+                store.flush()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            seen = 0
+            while not stop.is_set():
+                if not _os.path.exists(snap):
+                    continue
+                with open(snap) as f:
+                    data = _json.load(f)  # must NEVER be torn/partial
+                for d in data["jobs"]:
+                    Document.from_json(d)
+                seen += 1
+            assert seen > 0
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = ([threading.Thread(target=churner, args=(i,)) for i in range(3)]
+               + [threading.Thread(target=flusher) for _ in range(2)]
+               + [threading.Thread(target=reader) for _ in range(2)])
+    for t in threads:
+        t.start()
+    time.sleep(2.5)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"deadlocked threads: {hung}"  # the failure class here
+    assert not errors, errors[:3]
+    store.close()
+    # post-close snapshot reflects a consistent final state
+    final = JobStore(snapshot_path=snap)
+    assert final.get_state("k0") is not None
