@@ -97,8 +97,13 @@ def build_operator_loop(args, kube=None):
 
 
 def cmd_operator(args) -> int:
+    import signal
+
     loop, desc = build_operator_loop(args)
     tick = float(os.environ.get("TICK_SECONDS", "10"))
+    # pod termination finishes the current tick instead of cutting a
+    # remediation in half (SIGTERM -> graceful loop exit)
+    signal.signal(signal.SIGTERM, lambda *_: loop.request_stop())
     print(f"[foremast-tpu] operator: {desc} tick={tick}s", flush=True)
     loop.run_forever(interval=tick)
     return 0

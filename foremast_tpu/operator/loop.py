@@ -33,6 +33,7 @@ class OperatorLoop:
         self._hpa_snapshot: dict[tuple, dict] = {}
         self._monitor_phases: dict[tuple, str] = {}
         self._primed = False
+        self._stop_requested = False  # signal-handler seam (request_stop)
 
     def tick(self, now: float | None = None) -> dict:
         """One full reconcile pass. Returns the status sweep's touches."""
@@ -107,11 +108,20 @@ class OperatorLoop:
                 self.monitors.on_update(prev, m)
             self._monitor_phases[key] = m.status.phase
 
+    def request_stop(self):
+        """Signal-safe: make run_forever return after the current tick
+        (SIGTERM handler seam — pod termination should not cut a tick in
+        half mid-remediation). Plain attribute write only — no Event/lock
+        a mid-wait signal could deadlock on."""
+        self._stop_requested = True
+
     def run_forever(self, interval: float = 10.0):
-        while True:
+        while not self._stop_requested:
             t0 = time.time()
             try:
                 self.tick()
             except Exception as e:  # noqa: BLE001 - operator must survive
                 print(f"[foremast-tpu operator] tick error: {e}", flush=True)
-            time.sleep(max(0.0, interval - (time.time() - t0)))
+            while (not self._stop_requested
+                   and time.time() - t0 < interval):
+                time.sleep(min(0.2, interval))

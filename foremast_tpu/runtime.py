@@ -75,6 +75,8 @@ class Runtime:
         )
         self.wavefront_sink = wavefront_sink
         self._stop = threading.Event()
+        self._stop_requested = False  # signal-handler seam (request_stop)
+        self._stopped = False
         self._threads: list[threading.Thread] = []
         self._server = None
         self._grpc_server = None
@@ -127,7 +129,20 @@ class Runtime:
                 print(f"[foremast-tpu] cycle error: {e}", flush=True)
             self._stop.wait(max(0.0, cycle_seconds - (time.time() - t0)))
 
+    def request_stop(self):
+        """Signal-safe: ask run_forever to exit and shut down cleanly
+        (installed as the SIGTERM handler by main() — K8s pod termination
+        must flush the snapshot, not just die). A plain attribute write
+        ONLY: Event.set() takes the event's condition lock, and a handler
+        that lands while the main thread holds it (inside Event.wait's
+        acquire/release bookkeeping) deadlocks the very shutdown it
+        requests."""
+        self._stop_requested = True
+
     def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
         self._stop.set()
         if self._server is not None:
             self._server.shutdown()
@@ -138,10 +153,13 @@ class Runtime:
     def run_forever(self, **kw):
         self.start(**kw)
         try:
-            while True:
-                time.sleep(3600)
+            # short signal-safe poll (sleep is interrupted by signals; the
+            # handler only flips a bool, so there is no lock to deadlock on)
+            while not (self._stop_requested or self._stop.is_set()):
+                time.sleep(0.5)
         except KeyboardInterrupt:
-            self.stop()
+            pass
+        self.stop()
 
 
 def _env_seconds(name: str, default: float) -> float:
@@ -202,6 +220,11 @@ def main():
         raw = os.environ.get(name, "")
         return int(raw) if raw else None
 
+    import signal
+
+    # K8s terminates pods with SIGTERM: exit the wait loop and run the
+    # full stop() path (final snapshot flush) instead of dying mid-write
+    signal.signal(signal.SIGTERM, lambda *_: rt.request_stop())
     print(
         f"[foremast-tpu] serving :{port}"
         + (f" grpc :{grpc_port}" if grpc_port else "")

@@ -76,6 +76,7 @@ class TriggerService:
     # structured in-memory mirror of the TSV rows:
     # {"ts", "app", "job_id", "metric", "reason", "row"}
     anomalies: list = field(default_factory=list)
+    _stop_requested: bool = field(default=False, repr=False)
 
     # ------------------------------------------------------------- requests
     def build_request(self, app: str, metric_map: dict, now: float) -> dict:
@@ -225,18 +226,28 @@ class TriggerService:
         return report
 
     # ------------------------------------------------------------- lifecycle
+    def request_stop(self):
+        """Signal-safe stop seam (the reference trigger handles SIGTERM:
+        foremast-trigger/cmd/manager/main.go); run_forever returns after
+        the current poll so the anomaly TSV is never cut mid-record.
+        Plain attribute write only — no Event/lock a mid-wait signal could
+        deadlock on."""
+        self._stop_requested = True
+
     def run_forever(self, requests: list[tuple[str, dict]],
                     poll_seconds: float = 10.0, report_seconds: float = 86400.0):
         self.start(requests)
         self.summary_report(requests)
         last_report = time.time()
-        while True:
+        while not self._stop_requested:
             t0 = time.time()
             self.poll_once()
             if time.time() - last_report >= report_seconds:
                 self.summary_report(requests)
                 last_report = time.time()
-            time.sleep(max(0.0, poll_seconds - (time.time() - t0)))
+            while (not self._stop_requested
+                   and time.time() - t0 < poll_seconds):
+                time.sleep(min(0.2, poll_seconds))
 
 
 def main():
@@ -249,6 +260,9 @@ def main():
         wavefront_endpoint=os.environ.get("WAVEFRONT_ENDPOINT", ""),
         volume_path=os.environ.get("VOLUME_PATH", "."),
     )
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: svc.request_stop())
     svc.run_forever(parse_requests_file(requests_file))
 
 

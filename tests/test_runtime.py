@@ -329,3 +329,88 @@ def test_runtime_serves_grpc_when_enabled():
             assert c.status(resp["jobId"])["appName"] == "rt-grpc"
     finally:
         rt.stop()
+
+
+def test_runtime_run_forever_exits_on_request_stop(tmp_path):
+    """request_stop() (the SIGTERM seam) makes run_forever return and run
+    the full stop() path — final snapshot flush included."""
+    import threading
+
+    from foremast_tpu.engine.jobs import Document, JobStore
+
+    snap = str(tmp_path / "snap.json")
+    rt = Runtime(data_source=FixtureDataSource({}), cache=False,
+                 snapshot_path=snap)
+    t = threading.Thread(
+        target=rt.run_forever,
+        kwargs=dict(host="127.0.0.1", port=0, cycle_seconds=60),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.time() + 10
+    while rt._server is None and time.time() < deadline:
+        time.sleep(0.02)
+    rt.store.create(Document(id="j", app_name="a", strategy="canary",
+                             start_time="", end_time=""))
+    rt.request_stop()
+    t.join(15)
+    assert not t.is_alive()
+    assert JobStore(snapshot_path=snap).get("j") is not None  # flushed
+    rt.stop()  # idempotent
+
+
+def _run_daemon(target, *args, **kwargs):
+    """Run a daemon loop in a thread, capturing exceptions: a loop that
+    crashes must FAIL the graceful-stop assertion, not pass vacuously."""
+    import threading
+
+    errors = []
+
+    def wrapped():
+        try:
+            target(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=wrapped, daemon=True)
+    t.start()
+    return t, errors
+
+
+def test_operator_loop_run_forever_exits_on_request_stop():
+    from foremast_tpu.cli import build_operator_loop
+    from foremast_tpu.operator.kube import FakeKube
+
+    class A:
+        analyst = ""
+        analyst_transport = ""
+
+    loop, _ = build_operator_loop(A(), kube=FakeKube())
+    t, errors = _run_daemon(loop.run_forever, interval=0.05)
+    time.sleep(0.2)  # a few ticks
+    loop.request_stop()
+    t.join(5)
+    assert not t.is_alive() and not errors, errors
+
+
+def test_trigger_run_forever_exits_on_request_stop(tmp_path):
+    from foremast_tpu.trigger.trigger import TriggerService
+
+    class _Status:
+        phase = "Running"
+        reason = ""
+
+    class NullAnalyst:
+        def start_analyzing(self, req):
+            return "jid"
+
+        def get_status(self, job_id):
+            return _Status()
+
+    svc = TriggerService(analyst=NullAnalyst(), volume_path=str(tmp_path))
+    t, errors = _run_daemon(
+        svc.run_forever, [("app", {"error5xx": "q"})], poll_seconds=0.05)
+    time.sleep(0.2)
+    svc.request_stop()
+    t.join(5)
+    assert not t.is_alive() and not errors, errors
