@@ -300,3 +300,133 @@ def test_iter_records_rescans_on_rotation_race(tmp_path, monkeypatch):
     monkeypatch.setattr(builtins, "open", racing_open)
     got = {r["id"] for r in a.search(app="demo")}
     assert got == {"j1", "j2"}
+
+
+# ------------------------------------------------- EsArchive over real wire
+class _FakeEs:
+    """In-process ES stand-in: real HTTP, dict store, the four endpoints
+    EsArchive speaks (same wire-seam pattern as tests/fake_apiserver.py —
+    the reference's store was a real ES, elasticsearchstore.go)."""
+
+    def __init__(self):
+        import http.server
+        import threading as _th
+
+        self.docs: dict[str, dict] = {}
+        self.hpalogs: list[dict] = []
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                import json as _j
+
+                return _j.loads(self.rfile.read(n) or b"{}")
+
+            def _send(self, code, payload):
+                import json as _j
+
+                raw = _j.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_PUT(self):
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["documents", "_doc"]:
+                    outer.docs[parts[2]] = self._body()
+                    return self._send(200, {"result": "created"})
+                self._send(404, {})
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["hpalogs", "_doc"]:
+                    outer.hpalogs.append(self._body())
+                    return self._send(201, {"result": "created"})
+                if parts[:2] == ["documents", "_search"]:
+                    q = self._body()
+                    hits = outer._search(q)
+                    return self._send(200, {"hits": {"hits": [
+                        {"_source": h} for h in hits]}})
+                self._send(404, {})
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["documents", "_doc"]:
+                    doc = outer.docs.get(parts[2])
+                    if doc is None:
+                        return self._send(404, {"found": False})
+                    return self._send(200, {"found": True, "_source": doc})
+                self._send(404, {})
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        _th.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def _search(self, q):
+        out = list(self.docs.values())
+        must = q.get("query", {}).get("bool", {}).get("must", [])
+        for clause in must:
+            if "term" in clause:
+                [(field, v)] = clause["term"].items()
+                field = field.removesuffix(".keyword")
+                out = [d for d in out if d.get(field) == v]
+            elif "terms" in clause:
+                [(field, vs)] = clause["terms"].items()
+                field = field.removesuffix(".keyword")
+                out = [d for d in out if d.get(field) in vs]
+        out.sort(key=lambda d: -d.get("modified_at", 0))
+        return out[: q.get("size", 10)]
+
+    def close(self):
+        self.server.shutdown()
+
+
+def test_es_archive_over_real_wire():
+    es = _FakeEs()
+    try:
+        a = EsArchive(f"http://127.0.0.1:{es.port}")
+        assert a.index_job({"id": "j1", "app_name": "demo",
+                            "status": "completed_health", "modified_at": 2.0})
+        assert a.index_job({"id": "j2", "app_name": "demo",
+                            "status": "abort", "modified_at": 5.0})
+        assert a.index_hpalog({"job_id": "j1", "hpascore": 60.0})
+        assert a.get("j1")["app_name"] == "demo"
+        assert a.get("missing") is None  # 404 -> None, never raises
+        res = a.search(app="demo")
+        assert [r["id"] for r in res] == ["j2", "j1"]  # modified_at desc
+        res = a.search(app="demo", status="completed_health")
+        assert [r["id"] for r in res] == ["j1"]
+        assert es.hpalogs == [{"job_id": "j1", "hpascore": 60.0}]
+    finally:
+        es.close()
+
+
+def test_jobstore_archives_terminal_to_es_and_gc_prunes():
+    """Full loop over the wire: terminal transition -> ES write-behind;
+    gc() prunes from RAM only after ES confirmed (archived_at)."""
+    import time as _t
+
+    es = _FakeEs()
+    try:
+        a = EsArchive(f"http://127.0.0.1:{es.port}")
+        store = JobStore(archive=a)
+        store.create(Document(id="j", app_name="x", strategy="canary",
+                              start_time="", end_time=""))
+        store.claim_open_jobs("w")
+        store.advance("j", J.PREPROCESS_COMPLETED, J.POSTPROCESS_INPROGRESS)
+        store.transition("j", J.COMPLETED_HEALTH)
+        assert es.docs["j"]["status"] == J.COMPLETED_HEALTH
+        assert store.get("j").archived_at > 0
+        store.get("j").modified_at = _t.time() - 7200
+        assert store.gc(max_age_seconds=3600) == 1
+        assert store.get("j") is None
+        # ...but still searchable through the store via the archive
+        assert store.search(app="x")[0]["id"] == "j"
+    finally:
+        es.close()
