@@ -44,6 +44,31 @@ N_CHIPS = 8
 B_CHIP = max(B_TOTAL // N_CHIPS, 1)  # 12,500: one chip's shard of 100k
 
 
+def _run_json_child(cmd: list, timeout_s: float, env: dict | None = None,
+                    cwd: str | None = None):
+    """Run a child that prints one JSON line; returns (record, error).
+
+    Shared by the cycle and device legs: a failing child must yield a
+    DIAGNOSABLE error string (stderr tail included — subprocess errors
+    alone say only 'non-zero exit status'), never a hang or a lost cause.
+    """
+    try:
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            env=env, check=True, cwd=cwd,
+        )
+        return json.loads(out.stdout.strip().splitlines()[-1]), None
+    except Exception as e:  # noqa: BLE001 - callers degrade, never crash
+        stderr = getattr(e, "stderr", None) or ""
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        tail = stderr.strip().splitlines()[-3:]
+        msg = f"{type(e).__name__}: {e}"
+        if tail:
+            msg += " | stderr: " + " / ".join(tail)
+        return None, msg
+
+
 def _cycle_bench() -> dict:
     """Host-path numbers: a 10k-job cycle through analyzer.run_cycle with
     the native parser on vs off (foremast_tpu/bench_cycle.py). One
@@ -57,17 +82,16 @@ def _cycle_bench() -> dict:
         env["JAX_PLATFORMS"] = "cpu"
         env["FOREMAST_NATIVE"] = flag
         env.setdefault("BENCH_CYCLE_JOBS", "10000")
-        try:
-            out = subprocess.run(
-                [sys.executable, "-m", "foremast_tpu.bench_cycle"],
-                capture_output=True, text=True, timeout=900, env=env,
-                check=True, cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-            rec = json.loads(out.stdout.strip().splitlines()[-1])
+        rec, err = _run_json_child(
+            [sys.executable, "-m", "foremast_tpu.bench_cycle"],
+            timeout_s=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if rec is not None:
             extra[f"cycle_jobs_per_sec_{key}"] = rec["value"]
             extra[f"cycle_preprocess_s_{key}"] = rec["preprocess_s_per_cycle"]
-        except Exception as e:  # noqa: BLE001 - the headline must still print
-            extra[f"cycle_error_{key}"] = f"{type(e).__name__}: {e}"
+        else:
+            extra[f"cycle_error_{key}"] = err
     nat = extra.get("cycle_preprocess_s_native")
     py = extra.get("cycle_preprocess_s_python")
     if nat and py:
@@ -125,9 +149,8 @@ def _measure(B: int, T: int, n_runs: int) -> dict:
     }
 
 
-def main() -> None:
-    cycle_extra = _cycle_bench()
-
+def _device_fields() -> dict:
+    """The on-device measurements (runs inside the --device-only child)."""
     import jax
 
     T = 128
@@ -149,10 +172,8 @@ def main() -> None:
 
     p50, p99 = shard["p50"], shard["p99"]
     pairs_per_sec = B_CHIP / p50
-    print(json.dumps({
-        "metric": "canary_pairs_scored_per_sec_per_chip",
+    return {
         "value": round(pairs_per_sec, 1),
-        "unit": "pairs/s/chip",
         "vs_baseline": round(pairs_per_sec / TARGET_PAIRS_PER_SEC_PER_CHIP, 3),
         # the claim, measured in its own shape: time for one chip's 12,500-pair
         # shard of the 100k fleet batch == fleet time to 100k on v5e-8
@@ -170,6 +191,35 @@ def main() -> None:
         # claim outright if < 1 s)
         **whole_fields,
         "backend": jax.default_backend(),
+    }
+
+
+def main() -> None:
+    if "--device-only" in sys.argv:
+        print(json.dumps(_device_fields()))
+        return
+
+    # parse the deadline FIRST: a malformed env var must not throw away a
+    # 15-minute cycle bench later, outside the degrade path
+    try:
+        timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "1200"))
+    except ValueError:
+        timeout_s = 1200.0
+    cycle_extra = _cycle_bench()
+    # The device leg runs in a CHILD with a hard deadline: a wedged TPU
+    # tunnel (a killed grant-holder can hang jax.devices() indefinitely)
+    # must degrade to a JSON line carrying the host-path numbers + an
+    # error field — never a silent hang that records nothing.
+    device, err = _run_json_child(
+        [sys.executable, os.path.abspath(__file__), "--device-only"],
+        timeout_s=timeout_s,
+    )
+    if device is None:
+        device = {"value": 0.0, "vs_baseline": 0.0, "device_error": err}
+    print(json.dumps({
+        "metric": "canary_pairs_scored_per_sec_per_chip",
+        "unit": "pairs/s/chip",
+        **device,
         **cycle_extra,
     }))
 

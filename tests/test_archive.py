@@ -385,6 +385,7 @@ class _FakeEs:
 
     def close(self):
         self.server.shutdown()
+        self.server.server_close()  # release the listening fd, not just the loop
 
 
 def test_es_archive_over_real_wire():
