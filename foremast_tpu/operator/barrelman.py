@@ -266,9 +266,19 @@ class Barrelman:
 
     def monitor_continuously(self, monitor: DeploymentMonitor,
                              now: float | None = None):
+        # MODE gate lives HERE, not at call sites, so every dispatch path
+        # (MonitorController re-arm, HpaController upsert, the status
+        # sweep) enforces the same invariant: an hpa_only operator never
+        # starts health jobs, a healthy_monitoring_only one never starts
+        # HPA scoring. (The reference declared hasHPA() but never called
+        # it — Barrelman.go:74 is dead code there; we close the gap.)
+        if not self.monitors_health():
+            return None
         return self._monitor_perpetual(monitor, STRATEGY_CONTINUOUS, now)
 
     def monitor_hpa(self, monitor: DeploymentMonitor, now: float | None = None):
+        if not self.monitors_hpa():
+            return None
         return self._monitor_perpetual(monitor, STRATEGY_HPA, now)
 
     def _monitor_perpetual(self, monitor: DeploymentMonitor, strategy: str,

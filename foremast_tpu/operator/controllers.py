@@ -43,6 +43,7 @@ from .types import (
     MonitorSpec,
     MonitorStatus,
     STRATEGY_CANARY,
+    STRATEGY_HPA,
     STRATEGY_ROLLING_UPDATE,
 )
 
@@ -164,9 +165,16 @@ class DeploymentController:
                 return  # this update IS the rollback we asked for
         if old["metadata"].get("annotations", {}).get(ROLLBACK_ANNOTATION):
             return
-        strategy = (
-            STRATEGY_CANARY if name.endswith(CANARY_SUFFIX) else STRATEGY_ROLLING_UPDATE
-        )
+        # MODE selects the default analysis strategy for a rollout
+        # (DeploymentController.go:259-264): health-monitoring deploys get a
+        # rollingUpdate analysis; an hpa_only operator dispatches an hpa
+        # job instead. A canary-suffixed name overrides either.
+        if name.endswith(CANARY_SUFFIX):
+            strategy = STRATEGY_CANARY
+        elif self.barrelman.monitors_health():
+            strategy = STRATEGY_ROLLING_UPDATE
+        else:
+            strategy = STRATEGY_HPA
         self.barrelman.monitor_new_deployment(
             ns,
             name[: -len(CANARY_SUFFIX)] if strategy == STRATEGY_CANARY else app,
@@ -200,7 +208,9 @@ class MonitorController:
                     "DeploymentMonitor", new.namespace, new.name,
                     "RemediationFailed", err,
                 )
-        # re-arm perpetual monitors on spec change (:104-113, 146-155)
+        # re-arm perpetual monitors on spec change (:104-113, 146-155);
+        # MODE gating happens inside monitor_continuously/monitor_hpa
+        # (MonitorController.go:101-105 semantics, centralized)
         if old is not None:
             if new.spec.continuous and not old.spec.continuous:
                 self.barrelman.monitor_continuously(new)

@@ -711,3 +711,69 @@ def test_http_analyst_against_live_service_both_endpoint_forms():
     finally:
         server.shutdown()
         server.server_close()
+
+
+# ----------------------------------------------------------------- MODE gating
+def test_mode_hpa_only_dispatches_hpa_strategy_on_rollout():
+    """MODE selects the rollout analysis strategy (DeploymentController.go:
+    259-264): an hpa_only operator dispatches an hpa job for an image
+    change, not a rollingUpdate analysis; canary suffix still overrides."""
+    from foremast_tpu.operator.barrelman import MODE_HPA_ONLY
+
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    dc = DeploymentController(kube, Barrelman(kube, analyst, mode=MODE_HPA_ONLY))
+    dc.on_update(_deployment("demo", image="app:v1", revision=1),
+                 _deployment("demo", image="app:v2", revision=2))
+    assert analyst.requests[-1]["strategy"] == "hpa"
+
+
+def test_mode_default_dispatches_rolling_update_on_rollout():
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    dc = DeploymentController(kube, Barrelman(kube, analyst))
+    dc.on_update(_deployment("demo", image="app:v1", revision=1),
+                 _deployment("demo", image="app:v2", revision=2))
+    assert analyst.requests[-1]["strategy"] == "rollingUpdate"
+
+
+def test_mode_hpa_only_suppresses_continuous_rearm():
+    """Continuous re-arm is healthy-monitoring behavior; an hpa_only
+    operator must not start health jobs on a continuous flip
+    (MonitorController.go:101-105)."""
+    from foremast_tpu.operator.barrelman import MODE_HPA_ONLY
+
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    for mode, expected in ((MODE_HPA_ONLY, 0), ("hpa_and_healthy_monitoring", 1)):
+        analyst.requests.clear()
+        mc = MonitorController(kube, Barrelman(kube, analyst, mode=mode))
+        old = DeploymentMonitor(name="demo", namespace="default",
+                                spec=MonitorSpec(continuous=False))
+        new = DeploymentMonitor(name="demo", namespace="default",
+                                spec=MonitorSpec(continuous=True))
+        mc.on_update(old, new)
+        assert len(analyst.requests) == expected, mode
+        if expected:
+            assert analyst.requests[0]["strategy"] == "continuous"
+
+
+def test_mode_healthy_only_suppresses_hpa_dispatch_everywhere():
+    """Centralized gate: a healthy_monitoring_only operator never starts
+    HPA scoring, whichever path asks (template re-arm or HPA upsert)."""
+    from foremast_tpu.operator.barrelman import MODE_HEALTHY_ONLY
+
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    b = Barrelman(kube, analyst, mode=MODE_HEALTHY_ONLY)
+    mc = MonitorController(kube, b)
+    old = DeploymentMonitor(name="demo", namespace="default", spec=MonitorSpec())
+    new = DeploymentMonitor(name="demo", namespace="default",
+                            spec=MonitorSpec(hpa_score_template="cpu_bound"))
+    mc.on_update(old, new)
+    assert b.monitor_hpa(new) is None
+    assert all(r["strategy"] != "hpa" for r in analyst.requests)
