@@ -312,14 +312,23 @@ class HpaController:
         return self.kube.get_monitor(ns, target) if target else None
 
     def on_upsert(self, old: dict | None, new: dict):
-        """Stamp the score template + HpaScoreEnabled; alert on scaling."""
-        if self.barrelman.hpa_strategy == "hpa_exists":
-            monitor = self._monitor_for(new)
+        """Stamp the score template + HpaScoreEnabled; alert on scaling.
+
+        HPA_STRATEGY semantics (HpaController.go:210-218): `hpa_exists`
+        and `anyway` both stamp the default template on the target's
+        monitor; any OTHER strategy value actively CLEARS the template,
+        disabling scoring for apps whose HPAs appear."""
+        monitor = self._monitor_for(new)
+        if self.barrelman.hpa_strategy in ("hpa_exists", "anyway"):
             if monitor is not None and not monitor.spec.hpa_score_template:
                 monitor.spec.hpa_score_template = DEFAULT_HPA_TEMPLATE
                 monitor.status.hpa_score_enabled = True
                 self.kube.upsert_monitor(monitor)
                 self.barrelman.monitor_hpa(monitor)
+        elif monitor is not None and monitor.spec.hpa_score_template:
+            monitor.spec.hpa_score_template = ""
+            monitor.status.hpa_score_enabled = False  # both, like on_delete
+            self.kube.upsert_monitor(monitor)
         if old is None:
             return
         old_desired = old.get("status", {}).get("desiredReplicas", 0)
@@ -333,6 +342,9 @@ class HpaController:
             for m in metrics
         ):
             return
+        # re-fetch, deliberately: the stamp branch above may have called
+        # monitor_hpa(), which upserts a REBUILT monitor — the local object
+        # would be stale for the hpa_logs the letter renders from
         monitor = self._monitor_for(new)
         if monitor is None:
             return

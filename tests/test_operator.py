@@ -777,3 +777,26 @@ def test_mode_healthy_only_suppresses_hpa_dispatch_everywhere():
     mc.on_update(old, new)
     assert b.monitor_hpa(new) is None
     assert all(r["strategy"] != "hpa" for r in analyst.requests)
+
+
+def test_hpa_strategy_anyway_stamps_and_other_clears():
+    """HPA_STRATEGY parity (HpaController.go:210-218): `anyway` stamps
+    like `hpa_exists`; any other value clears an existing template."""
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    analyst = ScriptedAnalyst()
+    hpa = {"metadata": {"name": "demo-hpa", "namespace": "default"},
+           "spec": {"scaleTargetRef": {"name": "demo"}}}
+
+    kube.upsert_monitor(DeploymentMonitor(name="demo", namespace="default"))
+    HpaController(kube, Barrelman(kube, analyst, hpa_strategy="anyway")) \
+        .on_upsert(None, hpa)
+    m = kube.get_monitor("default", "demo")
+    assert m.spec.hpa_score_template  # stamped
+    assert m.status.hpa_score_enabled
+
+    HpaController(kube, Barrelman(kube, analyst, hpa_strategy="disabled")) \
+        .on_upsert(None, hpa)
+    m = kube.get_monitor("default", "demo")
+    assert m.spec.hpa_score_template == ""
+    assert m.status.hpa_score_enabled is False  # both reset, like on_delete
