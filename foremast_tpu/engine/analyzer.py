@@ -266,6 +266,52 @@ class Analyzer:
                     bad[job_id] = f"{type(e).__name__}: {e}"
             return results, bad
 
+    # ladder continues past the default chunk so a LARGE configured
+    # score_batch still pads small fleets to the nearest rung, never to
+    # the full chunk (10k rows must not pad to a 1M-row launch)
+    _BATCH_BUCKETS = (16, 64, 256, 1024, 4096, 16384, 65536)
+
+    def _bucket_rows(self, n: int) -> int:
+        """Smallest batch rung >= n, capped at the configured chunk."""
+        C = max(16, self.config.score_batch)
+        for b in self._BATCH_BUCKETS:
+            if b >= C:
+                break
+            if n <= b:
+                return b
+        return C
+
+    def _score_chunks(self, fn, arrays: list) -> dict:
+        """Row-chunk packed (B, ...) arrays into FIXED batch buckets, call
+        fn per chunk, and concatenate the output dicts.
+
+        XLA specializes every jitted program on the batch dimension, so
+        launching the raw fleet size compiles a fresh program whenever the
+        claim count changes — and CPU compile time itself grows with B
+        (measured ~33 s at B=10k vs ~133 s at B=50k). Fixed batch rungs
+        amortize to ONE compiled program per (rung, T bucket) for the life
+        of the process and bound peak memory at any fleet size. Partial
+        chunks (small fleets AND the tail of a big one) pad up to the
+        smallest rung that fits — never to the full chunk — with edge
+        padding (repeat of the last row — always semantically valid
+        inputs); padded rows are trimmed on merge.
+        """
+        B = arrays[0].shape[0]
+        C = self._bucket_rows(B)
+        outs = []
+        for i in range(0, B, C):
+            sl = [a[i:i + C] for a in arrays]
+            n = sl[0].shape[0]
+            target = self._bucket_rows(n)
+            if n < target:
+                sl = [np.pad(a, ((0, target - n),) + ((0, 0),) * (a.ndim - 1),
+                             mode="edge") for a in sl]
+            out = fn(*sl)
+            outs.append({k: np.asarray(v)[:n] for k, v in out.items()})
+        if len(outs) == 1:
+            return outs[0]
+        return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+
     def _score_pairs(self, items: list[_PairItem]):
         """Batch all pairwise items (bucketed by window length)."""
         results = {}
@@ -280,7 +326,7 @@ class Analyzer:
             bv, bm = pack_windows([it.baseline for it in group], pad_to=T)
             cv, cm = pack_windows([it.current for it in group], pad_to=T)
             B = len(group)
-            out = fl.score_pairs(
+            out = self._score_chunks(fl.score_pairs, [
                 bv, bm, cv, cm,
                 np.full(B, cfg.pairwise_threshold, np.float32),
                 np.full(B, cfg.enabled_tests(), np.int32),
@@ -305,12 +351,12 @@ class Analyzer:
                     ),
                     (B, 1),
                 ),
-            )
-            unhealthy = np.asarray(out["unhealthy"])
-            min_p = np.asarray(out["min_p"])
-            pw = np.asarray(out["pairwise_unhealthy"])
-            band = np.asarray(out["band_unhealthy"])
-            band_count = np.asarray(out["band_count"])
+            ])
+            unhealthy = out["unhealthy"]
+            min_p = out["min_p"]
+            pw = out["pairwise_unhealthy"]
+            band = out["band_unhealthy"]
+            band_count = out["band_count"]
             for i, it in enumerate(group):
                 results[(it.job_id, it.metric, "pair")] = {
                     "unhealthy": bool(unhealthy[i]),
@@ -381,20 +427,27 @@ class Analyzer:
                 regions[i, n_h : vals.shape[0]] = True
             xv, xm = pack_windows(concats, pad_to=T)
             data_steps = max(w.values.shape[0] for w in concats)
-            preds, hist_mask = self._predict(xv, xm, regions, data_steps)
-            sigma = np.asarray(fc.residual_sigma(xv, preds, hist_mask, ~regions))
-            out = fc.band_anomalies(
-                xv, xm, regions, preds, sigma,
+
+            def band_fn(xv_c, xm_c, reg_c, thr_c, bnd_c, mlb_c,
+                        _steps=data_steps):
+                preds, hist_mask = self._predict(xv_c, xm_c, reg_c, _steps)
+                sigma = np.asarray(
+                    fc.residual_sigma(xv_c, preds, hist_mask, ~reg_c))
+                return fc.band_anomalies(
+                    xv_c, xm_c, reg_c, preds, sigma, thr_c, bnd_c, mlb_c)
+
+            out = self._score_chunks(band_fn, [
+                xv, xm, regions,
                 np.asarray([it.policy.threshold for it in group], np.float32),
                 np.asarray([it.policy.bound for it in group], np.int32),
                 np.asarray([it.policy.min_lower_bound for it in group], np.float32),
-            )
-            counts = np.asarray(out["count"])
-            firsts = np.asarray(out["first_index"])
-            uppers = np.asarray(out["upper"])
-            lowers = np.asarray(out["lower"])
-            flags = np.asarray(out["flags"])
-            checked = np.asarray(out["checked"])
+            ])
+            counts = out["count"]
+            firsts = out["first_index"]
+            uppers = out["upper"]
+            lowers = out["lower"]
+            flags = out["flags"]
+            checked = out["checked"]
             for i, it in enumerate(group):
                 n_h = trimmed_n_h[id(it)]
                 anomalous_idx = np.nonzero(flags[i])[0]
@@ -459,9 +512,9 @@ class Analyzer:
                 mlb2[i] = it.policies[1].min_lower_bound
                 bm1[i] = it.policies[0].bound
                 bm2[i] = it.policies[1].bound
-            out = bv.bivariate_normal_anomalies(
-                x1, m1, x2, m2, region, thr, mlb1, mlb2, bm1, bm2
-            )
+            out = self._score_chunks(bv.bivariate_normal_anomalies, [
+                x1, m1, x2, m2, region, thr, mlb1, mlb2, bm1, bm2,
+            ])
             counts = np.asarray(out["count"])
             firsts = np.asarray(out["first_index"])
             checked = np.asarray(out["checked"])
@@ -631,19 +684,23 @@ class Analyzer:
         tv, tm = pack_windows(list(tps_w), pad_to=T)
         sv, sm = pack_windows(list(sla_w), pad_to=T)
         reg = np.stack(list(regions))
-        hist_mask = tm & ~reg
-        B = tv.shape[0]
-        preds = np.asarray(
-            fc.ses_predictions(tv, hist_mask, np.full(B, 0.3, np.float32))
-        )
-        sigma = np.asarray(fc.residual_sigma(tv, preds, hist_mask, ~reg))
-        res = hpa_ops.hpa_scores(
-            tv, tm, reg, preds, sigma, sv, sm,
-            np.full(B, 1e9, np.float32),  # static SLA unset -> huge
-            np.full(B, hpa_ops.SLA_DYNAMIC, np.int32),
-            np.full(B, self.config.threshold, np.float32),
-            np.full(B, self.config.sla_headroom_safe, np.float32),
-        )
+
+        def hpa_fn(tv_c, tm_c, reg_c, sv_c, sm_c):
+            n = tv_c.shape[0]
+            hist_mask = tm_c & ~reg_c
+            preds = np.asarray(
+                fc.ses_predictions(tv_c, hist_mask, np.full(n, 0.3, np.float32))
+            )
+            sigma = np.asarray(fc.residual_sigma(tv_c, preds, hist_mask, ~reg_c))
+            return hpa_ops.hpa_scores(
+                tv_c, tm_c, reg_c, preds, sigma, sv_c, sm_c,
+                np.full(n, 1e9, np.float32),  # static SLA unset -> huge
+                np.full(n, hpa_ops.SLA_DYNAMIC, np.int32),
+                np.full(n, self.config.threshold, np.float32),
+                np.full(n, self.config.sla_headroom_safe, np.float32),
+            )
+
+        res = self._score_chunks(hpa_fn, [tv, tm, reg, sv, sm])
         for i, (job_id, tps_it, sla_it) in enumerate(rows):
             out[job_id] = {
                 "raw_score": float(res["score"][i]),

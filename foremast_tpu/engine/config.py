@@ -54,6 +54,12 @@ class EngineConfig:
     # fleet batch size, not a per-worker work-queue depth; at 100k-fleet
     # scale the default must not silently cap the cycle.
     max_claim_per_cycle: int = 100_000
+    # device-launch row chunk: the fleet-batched scorers (pairs, bands,
+    # bivariate, hpa) split their packed batches into fixed rungs so XLA
+    # compiles ONE program per (rung, T) bucket instead of re-specializing
+    # on every fleet size (analyzer._score_chunks; the LSTM path scores
+    # per job and has no fleet batch dimension to chunk)
+    score_batch: int = 8192
     # per-job window fetches run on a bounded thread pool
     # (FETCH_CONCURRENCY; 1 = serial). In production the fetch stage is
     # network-bound against the metric store, so overlap is the difference
@@ -174,6 +180,7 @@ def from_env(env=None) -> EngineConfig:
         max_stuck_seconds=_env_float(env, "MAX_STUCK_IN_SECONDS", 90.0),
         max_cache_size=_env_int(env, "MAX_CACHE_SIZE", 1024),
         max_claim_per_cycle=_env_int(env, "MAX_CLAIM_PER_CYCLE", 100_000),
+        score_batch=_env_int(env, "SCORE_BATCH", 8192),
         fetch_concurrency=_env_int(env, "FETCH_CONCURRENCY", 16),
         ma_window=_env_int(env, "MA_WINDOW", 30),
         long_window_steps=_env_int(env, "LONG_WINDOW_STEPS", 4096),
