@@ -191,6 +191,7 @@ class JobStore:
         self._write_lock = threading.Lock()
         self._flush_seq = 0  # bumped under _lock when a payload is cut
         self._written_seq = 0  # last seq that reached disk (under _write_lock)
+        self._flush_cost = 0.0  # last serialize+write seconds (adaptive cadence)
         self._flush_wake = threading.Event()
         self._flusher: threading.Thread | None = None
         self._closed = False
@@ -409,11 +410,12 @@ class JobStore:
         per cycle under the lock — and even debounced to 1 Hz, a synchronous
         flush makes some unlucky transition pay the whole serialize+write
         while every other worker blocks on the lock. Instead callers only
-        flip a bit; the flusher thread owns the 1 Hz cadence. Durability is
-        unchanged (snapshot ≤ ~1 s stale, exactly what the 90 s lease
-        takeover already tolerates), and run_cycle/stop() still call flush()
-        synchronously at cycle/shutdown boundaries. Always called under
-        self._lock, which is what makes the lazy thread start race-free."""
+        flip a bit; the flusher thread owns the cadence (~1 s for typical
+        stores, stretching with snapshot cost up to 30 s for 100k-job
+        fleets — _flush_interval; either way far inside the 90 s lease
+        takeover), and run_cycle/stop() still call flush() synchronously
+        at cycle/shutdown boundaries. Always called under self._lock,
+        which is what makes the lazy thread start race-free."""
         if not self._snapshot_path:
             return
         self._dirty = True
@@ -424,22 +426,34 @@ class JobStore:
             self._flusher.start()
         self._flush_wake.set()
 
+    def _flush_interval(self) -> float:
+        """Adaptive flusher cadence: 1 Hz while snapshots are cheap,
+        stretching to 5x the measured serialize+write cost (cap 30 s) for
+        huge fleets — a 100k-job store (~1.5 s per snapshot) must not pin
+        a core re-serializing at 1 Hz. Worst-case snapshot staleness is
+        therefore ~5x cost (<= 30 s), far inside the 90 s lease-takeover
+        tolerance; tiny stores keep the ~1 s bound."""
+        return min(30.0, max(1.0, 5.0 * self._flush_cost))
+
     def _flush_loop(self):
         while not self._closed:
             self._flush_wake.wait()
             if self._closed:
                 return
             self._flush_wake.clear()
-            # hold the 1 Hz cadence without holding any lock
-            delay = 1.0 - (time.time() - self._last_write)
-            if delay > 0:
-                time.sleep(delay)
+            # wait out the cadence in small closable slices: a plain
+            # sleep(30) would make close() miss its join timeout
+            deadline = self._last_write + self._flush_interval()
+            while not self._closed and time.time() < deadline:
+                time.sleep(min(0.2, max(0.0, deadline - time.time())))
+            if self._closed:
+                return
             try:
                 self.flush()
             except Exception as e:  # noqa: BLE001 - flusher must survive
                 # snapshot dir gone (teardown), disk trouble, or a
                 # non-JSON-safe state blob: stay alive — a dead flusher
-                # silently downgrades "≤1 s stale" to cycle-length staleness.
+                # silently downgrades bounded staleness to cycle-length gaps.
                 # The next synchronous flush() surfaces the error to a caller.
                 print(f"[foremast-tpu] snapshot flush failed: {e}", flush=True)
                 time.sleep(1.0)
@@ -462,6 +476,7 @@ class JobStore:
         with self._lock:
             if not self._dirty:
                 return
+            t0 = time.perf_counter()  # after acquire: cost excludes lock waits
             data = {
                 "jobs": [d.to_json() for d in self._jobs.values()],
                 "hpalogs": [asdict(l) for l in self._hpalogs],
@@ -469,20 +484,27 @@ class JobStore:
                 # outside it, and put_state() mutates this dict in place
                 "state": dict(self._state),
             }
+            cut_s = time.perf_counter() - t0
             self._dirty = False
             self._last_write = time.time()
             self._flush_seq += 1
             seq = self._flush_seq
         try:
+            t1 = time.perf_counter()
             payload = json.dumps(data)
+            dumps_s = time.perf_counter() - t1
             with self._write_lock:
                 if seq <= self._written_seq:
                     return  # a newer snapshot already reached disk
+                t2 = time.perf_counter()
                 tmp = self._snapshot_path + ".tmp"
                 with open(tmp, "w") as f:
                     f.write(payload)
                 os.replace(tmp, self._snapshot_path)
                 self._written_seq = seq
+                # serialize+write work only — lock-wait time must not
+                # inflate the adaptive cadence under contention
+                self._flush_cost = cut_s + dumps_s + (time.perf_counter() - t2)
         except BaseException:
             with self._lock:
                 self._dirty = True  # this payload never landed; don't lose it

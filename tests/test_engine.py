@@ -594,3 +594,20 @@ def test_e2e_fleet_crosses_chunk_rungs():
     bad_ids = {f"j{i:02d}" for i in range(70) if i % 7 == 3}
     flagged = {j for j, s in chunked.items() if s == J.COMPLETED_UNHEALTH}
     assert bad_ids <= flagged  # no false negatives (FPs are fixture noise)
+
+
+def test_flusher_cadence_adapts_to_snapshot_cost(tmp_path):
+    """The background flusher's interval stretches with the measured
+    serialize+write cost (5x, capped 30 s) so huge stores don't pin a
+    core re-serializing at 1 Hz, while small stores keep ~1 s cadence."""
+    store = JobStore(snapshot_path=str(tmp_path / "s.json"))
+    assert store._flush_cost == 0.0  # 1 Hz until measured
+    store.create(Document(id="j", app_name="a", strategy="canary",
+                          start_time="", end_time=""))
+    store.flush()
+    assert 0.0 < store._flush_cost < 1.0  # tiny store: stays at 1 Hz floor
+    # the PRODUCTION formula (floor 1 s, 5x cost, 30 s cap)
+    for cost, want in ((0.01, 1.0), (1.5, 7.5), (60.0, 30.0)):
+        store._flush_cost = cost
+        assert store._flush_interval() == want
+    store.close()
