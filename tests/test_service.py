@@ -157,3 +157,68 @@ def test_alert_endpoint_returns_hpalogs(stack):
     code, resp = _req("GET", f"{base_url}/alert/web/prod/hpa")
     assert code == 200
     assert resp["hpalogs"][0]["hpascore"] == 80.0
+
+
+# ------------------------------------------------------------- query proxy
+def test_query_proxy_forwards_with_cors_over_wire():
+    """GET /api/v1/<rest>?<qs> forwards to the configured metric store and
+    returns the body with CORS headers — the dashboard's data path
+    (reference QueryProxy, foremast-service/cmd/manager/main.go:277-297)."""
+    import http.server
+    import json as _json
+    import threading
+    import urllib.request
+
+    from foremast_tpu.engine.jobs import JobStore
+    from foremast_tpu.service.api import ForemastService, serve_background
+
+    seen = []
+
+    class Upstream(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            seen.append(self.path)
+            body = _json.dumps({"status": "success",
+                                "data": {"result": []}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    up = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Upstream)
+    threading.Thread(target=up.serve_forever, daemon=True).start()
+    try:
+        svc = ForemastService(
+            JobStore(),
+            query_endpoint=f"http://127.0.0.1:{up.server_address[1]}/api/v1/")
+        server = serve_background(svc, port=0)
+        port = server.server_address[1]
+        try:
+            r = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/query_range"
+                "?query=up&start=1&end=2&step=60", timeout=5)
+            assert r.status == 200
+            assert _json.loads(r.read())["status"] == "success"
+            # CORS for the dashboard's browser fetches
+            assert r.headers.get("Access-Control-Allow-Origin") == "*"
+            assert seen == ["/api/v1/query_range?query=up&start=1&end=2&step=60"]
+        finally:
+            server.shutdown()
+            server.server_close()
+    finally:
+        up.shutdown()
+        up.server_close()
+
+
+def test_query_proxy_unconfigured_and_unreachable():
+    from foremast_tpu.engine.jobs import JobStore
+    from foremast_tpu.service.api import ForemastService
+
+    svc = ForemastService(JobStore())  # no endpoint
+    status, payload = svc.query_proxy("query?x=1")
+    assert status == 502 and "no query endpoint" in payload["error"]
+    svc2 = ForemastService(JobStore(), query_endpoint="http://127.0.0.1:1/")
+    status, payload = svc2.query_proxy("query?x=1")
+    assert status == 502 and "query proxy failed" in payload["error"]
