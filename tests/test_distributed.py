@@ -63,22 +63,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_psum_over_coordinator():
+def _run_two_workers(worker_src: str, timeout: float, what: str) -> str:
+    """Launch two single-device CPU processes joined via a local
+    coordinator; return combined output (skips when the jax build lacks
+    cross-process CPU collectives, fails on any other error)."""
     port = _free_port()
     procs = []
     for rank in range(2):
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
-        # one CPU device per process: the world is 2 devices across 2 procs
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["NUM_PROCESSES"] = "2"
         env["PROCESS_ID"] = str(rank)
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", _WORKER],
+                [sys.executable, "-c", worker_src],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True, cwd=os.path.dirname(os.path.dirname(__file__)),
             )
@@ -86,18 +87,85 @@ def test_two_process_psum_over_coordinator():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail("DCN smoke workers timed out (coordinator handshake hung)")
+        pytest.fail(f"{what} workers timed out")
     combined = "\n\n".join(outs)
     if any(p.returncode != 0 for p in procs):
         lowered = combined.lower()
         if "unimplemented" in lowered or "not supported" in lowered:
             pytest.skip(f"cross-process CPU collectives unavailable: "
                         f"{combined[-500:]}")
-        pytest.fail(f"DCN smoke failed:\n{combined[-4000:]}")
+        pytest.fail(f"{what} failed:\n{combined[-4000:]}")
+    return combined
+
+
+@pytest.mark.slow
+def test_two_process_psum_over_coordinator():
+    combined = _run_two_workers(_WORKER, 180, "DCN smoke")
     # both ranks computed the same global reduction over DCN
     assert combined.count("PSUM_TOTAL 10.0") == 2, combined[-2000:]
+
+_SCORER_WORKER = r"""
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from foremast_tpu.parallel import distributed as D
+from foremast_tpu.parallel import fleet as fl
+from foremast_tpu.parallel.mesh import FLEET_AXIS
+
+assert D.initialize(), "initialize() must join the 2-process world"
+info = D.host_info()
+mesh = D.global_fleet_mesh()
+
+B, T = 4, 32
+rng = np.random.default_rng(0)
+base = rng.normal(10.0, 1.0, (B, T)).astype(np.float32)
+cur = base.copy()
+cur[1] += 100.0  # row 1 is catastrophically shifted
+cur[3] += 100.0  # row 3 too
+mask = np.ones((B, T), bool)
+
+def g(a):
+    # identical full array on every process; each contributes its slice
+    sl = D.process_batch_slice(a.shape[0], info)
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(FLEET_AXIS)), a[sl], a.shape
+    )
+
+cfg = {
+    "pvalue_threshold": np.full(B, 0.01, np.float32),
+    "test_mask": np.full(B, 0b1111, np.int32),
+    "combine": np.zeros(B, np.int32),
+    "ma_window": np.full(B, 10, np.int32),
+    "band_threshold": np.full(B, 3.0, np.float32),
+    "bound_mode": np.zeros(B, np.int32),
+    "min_lower_bound": np.zeros(B, np.float32),
+}
+run = fl.make_fleet_scorer(mesh, k=2)
+args = [g(a) for a in (base, mask, cur, mask)]
+gcfg = {k: g(v) for k, v in cfg.items()}
+out, total, top_v, top_idx = run(*args, gcfg)
+from jax.experimental import multihost_utils as mh
+flags = np.asarray(mh.process_allgather(out["unhealthy"], tiled=True))
+print("FLEET_FLAGS", "".join("U" if f else "h" for f in flags),
+      "TOTAL", total, "TOPIDX", sorted(int(i) for i in np.asarray(top_idx)[:2]),
+      flush=True)
+assert total == 2, total
+assert list(flags) == [False, True, False, True], flags
+"""
+
+
+@pytest.mark.slow
+def test_two_process_fleet_scorer_over_coordinator():
+    """The ACTUAL sharded fleet program (make_fleet_scorer: vmapped verdicts
+    + psum unhealthy-count + all-gathered top-k) across two OS processes —
+    the full multi-pod scoring path, shrunk to 2 CPU procs over DCN."""
+    combined = _run_two_workers(_SCORER_WORKER, 240, "fleet-scorer DCN")
+    # both ranks agree: rows 1 and 3 unhealthy, fleet total 2, top-k global
+    assert combined.count("FLEET_FLAGS hUhU TOTAL 2 TOPIDX [1, 3]") == 2, \
+        combined[-2000:]
