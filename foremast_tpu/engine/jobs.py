@@ -311,6 +311,19 @@ class JobStore:
         with self._lock:
             return [d for d in self._jobs.values() if d.status in statuses]
 
+    def status_counts(self) -> dict:
+        """{status: count} over the live store (self-metrics gauge)."""
+        counts: dict[str, int] = {}
+        with self._lock:
+            for d in self._jobs.values():
+                counts[d.status] = counts.get(d.status, 0) + 1
+        return counts
+
+    @property
+    def snapshot_flush_seconds(self) -> float:
+        """Last measured serialize+write cost (0 until the first flush)."""
+        return self._flush_cost
+
     # -- hpa logs --
     def add_hpalog(self, log: HpaLog, keep_last: int = 1000):
         with self._lock:

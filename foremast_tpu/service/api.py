@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..dataplane.exporter import VerdictExporter
+from ..utils.promtext import escape_label_value
 from ..dataplane.promql import (
     CONTINUOUS_STRATEGIES,
     END_PLACEHOLDER,
@@ -197,6 +198,8 @@ class ForemastService:
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.query_endpoint = query_endpoint  # metric-store base for the proxy
+        # set by make_server: () -> the HTTP admission gate's shed counter
+        self.http_shed_count = None
 
     # -- handlers, each returns (status, payload-dict | text) --
     def create(self, body: dict):
@@ -315,8 +318,27 @@ class ForemastService:
     def metrics(self):
         from ..utils.tracing import tracer
 
-        # verdict series + host-side span aggregates in one scrape
-        return 200, self.exporter.render() + tracer.render_metrics()
+        # verdict series + host-side span aggregates + engine self-gauges
+        # in one scrape (the reference brain likewise self-reported on its
+        # :8000 /metrics, foremast-brain.yaml:85-122)
+        lines = []
+        for status, n in sorted(self.store.status_counts().items()):
+            lines.append(
+                f'foremast_jobs{{status="{escape_label_value(status)}"}} {n}'
+            )
+        lines.append(
+            f"foremast_snapshot_flush_seconds "
+            f"{self.store.snapshot_flush_seconds}"
+        )
+        if self.store.archive is not None:
+            lines.append(
+                "foremast_archive_errors "
+                f"{getattr(self.store.archive, 'errors', 0)}"
+            )
+        if self.http_shed_count is not None:
+            lines.append(f"foremast_http_shed_total {self.http_shed_count()}")
+        self_gauges = "\n".join(lines) + "\n"
+        return 200, self.exporter.render() + tracer.render_metrics() + self_gauges
 
     def debug_traces(self, limit: int = 50):
         from ..utils.tracing import tracer
@@ -413,6 +435,9 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
 
     server = BoundedThreadingHTTPServer((host, port), Handler,
                                         max_in_flight=max_in_flight)
+    # self-metrics seam: lets GET /metrics report the admission gate's
+    # shed counter without the service owning a server reference
+    service.http_shed_count = lambda: server.shed_count
     return server
 
 

@@ -222,3 +222,39 @@ def test_query_proxy_unconfigured_and_unreachable():
     svc2 = ForemastService(JobStore(), query_endpoint="http://127.0.0.1:1/")
     status, payload = svc2.query_proxy("query?x=1")
     assert status == 502 and "query proxy failed" in payload["error"]
+
+
+def test_metrics_includes_engine_self_gauges():
+    """/metrics self-reports engine health alongside the verdict series:
+    job counts by status, snapshot flush cost, archive errors, and the
+    HTTP admission gate's shed counter (reference brain self-reported on
+    its :8000 /metrics likewise)."""
+    import urllib.request
+
+    from foremast_tpu.engine.archive import FileArchive
+    from foremast_tpu.engine.jobs import Document, JobStore
+    from foremast_tpu.service.api import ForemastService, serve_background
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store = JobStore(archive=FileArchive(tmp + "/a.jsonl"))
+        store.create(Document(id="a", app_name="x", strategy="canary",
+                              start_time="", end_time=""))
+        store.create(Document(id="b", app_name="x", strategy="canary",
+                              start_time="", end_time=""))
+        store.claim_open_jobs("w", limit=1)
+        svc = ForemastService(store)
+        server = serve_background(svc, port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.server_address[1]}/metrics",
+                timeout=5).read().decode()
+            assert 'foremast_jobs{status="initial"} 1' in body
+            assert 'foremast_jobs{status="preprocess_inprogress"} 1' in body
+            assert "foremast_snapshot_flush_seconds" in body
+            assert "foremast_archive_errors 0" in body
+            assert "foremast_http_shed_total 0" in body
+        finally:
+            server.shutdown()
+            server.server_close()
