@@ -358,7 +358,10 @@ def test_grafana_is_provisioned_with_foremast_dashboard():
     for series in ("foremastbrain:http_server_requests_errors_5xx_upper",
                    "foremastbrain:http_server_requests_latency_lower",
                    "foremastbrain:http_server_requests_errors_5xx_anomaly",
-                   "foremastbrain:namespace_app_per_pod:hpa_score"):
+                   "foremastbrain:namespace_app_per_pod:hpa_score",
+                   # engine self-gauges (service/api.py metrics())
+                   "foremast_jobs",
+                   "foremast_http_shed_total"):
         assert series in joined, series
     # version-change annotations join on kube_pod_labels, which
     # kube-state-metrics must allow-list
