@@ -1,0 +1,36 @@
+# Developer gates — counterpart of the reference's Makefile test target
+# (foremast-barrelman/Makefile:5-8: generate/fmt/vet + go test ./...).
+# CPU-pinned: never let a dev loop touch the TPU grant (bench owns that).
+
+PY ?= python
+CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+
+.PHONY: test test-fast native bench bench-smoke demo demo-hpa dryrun clean
+
+test:            ## full suite (CPU, 8 virtual devices via conftest)
+	$(PY) -m pytest tests/ -q
+
+test-fast:       ## fail-fast variant for inner loops
+	$(PY) -m pytest tests/ -x -q
+
+native:          ## (re)build the C++ data-plane extension
+	$(CPU_ENV) $(PY) -c "from foremast_tpu import native; assert native.available(), 'build failed'; print(native.lib_path())"
+
+bench:           ## the real benchmark (touches the TPU; one JSON line)
+	$(PY) bench.py
+
+bench-smoke:     ## bench plumbing check on CPU with tiny shapes
+	$(CPU_ENV) BENCH_PAIRS_TOTAL=4000 BENCH_RUNS=20 BENCH_CYCLE_JOBS=500 $(PY) bench.py
+
+demo:            ## hermetic rollback demo (no cluster)
+	$(CPU_ENV) $(PY) -m foremast_tpu demo
+
+demo-hpa:        ## hermetic autoscaling demo
+	$(CPU_ENV) $(PY) -m foremast_tpu demo --hpa
+
+dryrun:          ## multi-chip sharding dryrun on an 8-device virtual mesh
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+clean:
+	rm -rf .pytest_cache build foremast_tpu.egg-info
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
