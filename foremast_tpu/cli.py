@@ -113,7 +113,12 @@ def _toggle_continuous(args, value: bool) -> int:
     from .operator.kube import KubeError
 
     kube = _kube()
-    if kube.get_monitor(args.namespace, args.app) is None:
+    try:
+        monitor = kube.get_monitor(args.namespace, args.app)
+    except Exception as e:  # noqa: BLE001 - CLI boundary: no tracebacks
+        print(f"cannot reach the Kubernetes API: {e}", file=sys.stderr)
+        return 1
+    if monitor is None:
         print(f"no DeploymentMonitor {args.namespace}/{args.app}", file=sys.stderr)
         return 1
     try:
@@ -136,7 +141,14 @@ def cmd_unwatch(args) -> int:
 
 
 def cmd_status(args) -> int:
-    monitor = _kube().get_monitor(args.namespace, args.app)
+    try:
+        monitor = _kube().get_monitor(args.namespace, args.app)
+    except Exception as e:  # noqa: BLE001 - CLI boundary: no tracebacks
+        print(f"cannot reach the Kubernetes API: {e}\n"
+              "(status/watch/unwatch read the DeploymentMonitor CRD; run "
+              "them where kubectl works — job-level state is on the "
+              "runtime API at /v1/healthcheck/id/<jobId>)", file=sys.stderr)
+        return 1
     if monitor is None:
         print(f"no DeploymentMonitor {args.namespace}/{args.app}", file=sys.stderr)
         return 1

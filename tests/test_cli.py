@@ -151,3 +151,24 @@ def test_demo_hpa_scale_up_story():
     assert r["alert_letters"] == 1
     assert "scaled up from 2 to 4 pods" in r["letter_preview"]
     assert r["score_series_exported"] is True
+
+
+def test_crd_verbs_fail_cleanly_without_cluster(monkeypatch):
+    """status/watch against an unreachable apiserver print a one-line
+    error and exit 1 — never a raw urllib traceback (CLI boundary)."""
+    import subprocess
+    import sys
+
+    env = {"KUBERNETES_SERVICE_HOST": "127.0.0.1",
+           "KUBERNETES_SERVICE_PORT": "1",
+           "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"}
+    for verb in (["status", "demo"], ["watch", "demo"]):
+        out = subprocess.run(
+            [sys.executable, "-m", "foremast_tpu", *verb],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=__import__("os").path.dirname(
+                __import__("os").path.dirname(__file__)),
+        )
+        assert out.returncode == 1, (verb, out.stderr[-300:])
+        assert "cannot reach the Kubernetes API" in out.stderr, out.stderr[-300:]
+        assert "Traceback" not in out.stderr, out.stderr[-500:]
