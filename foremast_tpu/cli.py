@@ -109,18 +109,41 @@ def cmd_operator(args) -> int:
     return 0
 
 
+def _fetch_monitor(namespace: str, app: str):
+    """(kube, monitor, rc) for the CRD verbs — every failure is a one-line
+    diagnosis, never a traceback (CLI boundary). KubeError.status tells
+    transport problems (0: unreachable) apart from API refusals
+    (403: RBAC, etc.) so the user is pointed at the right fix."""
+    from .operator.kube import KubeError
+
+    try:
+        kube = _kube()
+        monitor = kube.get_monitor(namespace, app)
+    except KubeError as e:
+        if e.status == 0:
+            print(f"cannot reach the Kubernetes API: {e}\n"
+                  "(status/watch/unwatch read the DeploymentMonitor CRD; run "
+                  "them where kubectl works — job-level state is on the "
+                  "runtime API at /v1/healthcheck/id/<jobId>)", file=sys.stderr)
+        else:
+            print(f"Kubernetes API refused the request (HTTP {e.status}): "
+                  f"{e}", file=sys.stderr)
+        return None, None, 1
+    except Exception as e:  # noqa: BLE001 - client construction, bad CRDs...
+        print(f"cannot talk to the Kubernetes API: {e}", file=sys.stderr)
+        return None, None, 1
+    if monitor is None:
+        print(f"no DeploymentMonitor {namespace}/{app}", file=sys.stderr)
+        return kube, None, 1
+    return kube, monitor, 0
+
+
 def _toggle_continuous(args, value: bool) -> int:
     from .operator.kube import KubeError
 
-    kube = _kube()
-    try:
-        monitor = kube.get_monitor(args.namespace, args.app)
-    except Exception as e:  # noqa: BLE001 - CLI boundary: no tracebacks
-        print(f"cannot reach the Kubernetes API: {e}", file=sys.stderr)
-        return 1
-    if monitor is None:
-        print(f"no DeploymentMonitor {args.namespace}/{args.app}", file=sys.stderr)
-        return 1
+    kube, monitor, rc = _fetch_monitor(args.namespace, args.app)
+    if rc:
+        return rc
     try:
         # spec-only merge patch: must NOT round-trip a stale status copy
         kube.patch_monitor(args.namespace, args.app,
@@ -141,17 +164,9 @@ def cmd_unwatch(args) -> int:
 
 
 def cmd_status(args) -> int:
-    try:
-        monitor = _kube().get_monitor(args.namespace, args.app)
-    except Exception as e:  # noqa: BLE001 - CLI boundary: no tracebacks
-        print(f"cannot reach the Kubernetes API: {e}\n"
-              "(status/watch/unwatch read the DeploymentMonitor CRD; run "
-              "them where kubectl works — job-level state is on the "
-              "runtime API at /v1/healthcheck/id/<jobId>)", file=sys.stderr)
-        return 1
-    if monitor is None:
-        print(f"no DeploymentMonitor {args.namespace}/{args.app}", file=sys.stderr)
-        return 1
+    _, monitor, rc = _fetch_monitor(args.namespace, args.app)
+    if rc:
+        return rc
     s = monitor.status
     out = {
         "app": args.app,

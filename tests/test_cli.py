@@ -156,6 +156,7 @@ def test_demo_hpa_scale_up_story():
 def test_crd_verbs_fail_cleanly_without_cluster(monkeypatch):
     """status/watch against an unreachable apiserver print a one-line
     error and exit 1 — never a raw urllib traceback (CLI boundary)."""
+    import os
     import subprocess
     import sys
 
@@ -166,9 +167,25 @@ def test_crd_verbs_fail_cleanly_without_cluster(monkeypatch):
         out = subprocess.run(
             [sys.executable, "-m", "foremast_tpu", *verb],
             capture_output=True, text=True, timeout=120, env=env,
-            cwd=__import__("os").path.dirname(
-                __import__("os").path.dirname(__file__)),
+            cwd=os.path.dirname(os.path.dirname(__file__)),
         )
         assert out.returncode == 1, (verb, out.stderr[-300:])
         assert "cannot reach the Kubernetes API" in out.stderr, out.stderr[-300:]
         assert "Traceback" not in out.stderr, out.stderr[-500:]
+
+
+def test_fetch_monitor_diagnoses_rbac_vs_unreachable(monkeypatch, capsys):
+    """HTTP 403 is reported as an API refusal (RBAC), not unreachability."""
+    from foremast_tpu import cli
+    from foremast_tpu.operator.kube import KubeError
+
+    class Refusing:
+        def get_monitor(self, ns, app):
+            raise KubeError("GET ...: HTTP 403 forbidden", status=403)
+
+    monkeypatch.setattr(cli, "_kube", lambda: Refusing())
+    kube, monitor, rc = cli._fetch_monitor("ns", "app")
+    assert rc == 1 and monitor is None
+    err = capsys.readouterr().err
+    assert "refused the request (HTTP 403)" in err
+    assert "cannot reach" not in err
