@@ -89,13 +89,24 @@ def _cycle_bench() -> dict:
         )
         if rec is not None:
             extra[f"cycle_jobs_per_sec_{key}"] = rec["value"]
+            # the meaningful host-path number: cycle minus the CPU-pinned
+            # score stage (device-bound in production; the headline above
+            # measures it on the real chip). The raw cycle_jobs_per_sec_*
+            # stays for continuity but is score-dominated on CPU.
+            extra[f"cycle_host_jobs_per_sec_{key}"] = rec.get(
+                "host_jobs_per_sec", rec["value"])
             extra[f"cycle_preprocess_s_{key}"] = rec["preprocess_s_per_cycle"]
+            extra[f"cycle_score_s_{key}"] = rec.get("score_s_per_cycle", 0.0)
         else:
             extra[f"cycle_error_{key}"] = err
     nat = extra.get("cycle_preprocess_s_native")
     py = extra.get("cycle_preprocess_s_python")
     if nat and py:
         extra["cycle_native_preprocess_speedup"] = round(py / nat, 2)
+    nat_h = extra.get("cycle_host_jobs_per_sec_native")
+    py_h = extra.get("cycle_host_jobs_per_sec_python")
+    if nat_h and py_h:
+        extra["cycle_native_host_speedup"] = round(nat_h / py_h, 2)
     return extra
 
 

@@ -112,10 +112,29 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128) -> dict:
     per_cycle = lambda name: round(  # noqa: E731
         stats.get(name, {}).get("total_seconds", 0.0) / cycles, 4
     )
+    # Host-only throughput: the cycle minus the score stage. This bench is
+    # CPU-pinned (see module docstring), so the score stage here is CPU
+    # compute that on the production chip is ~0.1 ms per launch (bench.py's
+    # headline measures it on the real device) — at ~40 s/cycle on CPU it
+    # would otherwise swamp the host path and turn the native-vs-python
+    # parser comparison into machine-load noise. wall - score is exactly
+    # the part of the cycle this bench exists to measure:
+    # fetch -> parse -> resample -> pack -> verdict -> snapshot.
+    # Clock-domain caveat: tracer spans are time.time()-based while wall is
+    # perf_counter-based; a clock step during the run could push the
+    # subtraction non-positive. Omit the field then (bench.py falls back to
+    # the raw number) rather than record an absurd rate.
+    score_total = stats.get("engine.score", {}).get("total_seconds", 0.0)
+    host_wall = wall - score_total
+    host_fields = (
+        {"host_jobs_per_sec": round(n_jobs * cycles / host_wall, 1)}
+        if host_wall > 0 else {}
+    )
     return {
         "metric": "engine_cycle_jobs_per_sec",
         "value": round(n_jobs * cycles / wall, 1),
         "unit": "jobs/s",
+        **host_fields,
         "native": native.available(),
         "jobs": n_jobs,
         "cycles": cycles,
