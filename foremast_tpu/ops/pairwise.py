@@ -24,7 +24,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .ranks import rank_and_ties
+from .ranks import _sorted_rank_view, _tie_term, rank_and_ties, rank_sum_stats
 from .stats import chi2_sf, kolmogorov_sf, norm_sf
 
 __all__ = [
@@ -49,6 +49,15 @@ def _safe_div(a, b):
     return a / jnp.where(b == 0, 1.0, b)
 
 
+def _ks_pvalue(D, n1, n2):
+    """Two-sided KS p-value: asymptotic Kolmogorov distribution with the
+    Stephens small-sample correction (shared by the standalone and fused
+    paths so the constants cannot drift apart)."""
+    en = jnp.sqrt(_safe_div(n1 * n2, n1 + n2))
+    p = kolmogorov_sf((en + 0.12 + _safe_div(jnp.asarray(0.11, _F), en)) * D)
+    return jnp.where((n1 > 0) & (n2 > 0), p, 1.0)
+
+
 # ---------------------------------------------------------------------------
 # Mann-Whitney U  (scipy.stats.mannwhitneyu, method="asymptotic",
 #                  use_continuity=True, alternative="two-sided")
@@ -59,16 +68,21 @@ def mann_whitney_u(x, x_mask, y, y_mask):
     Returns (U1, pvalue): U1 is the U statistic of sample x (scipy's
     convention); pvalue uses the tie-corrected normal approximation with
     continuity correction.
+
+    The rank sum R1 comes from rank_sum_stats with an x-membership weight —
+    ranks are never materialized in input order (see ranks.py perf note).
     """
     Tx = x.shape[-1]
     comb = jnp.concatenate([x, y]).astype(_F)
     cmask = jnp.concatenate([x_mask, y_mask])
-    ranks, tie, _ = rank_and_ties(comb, cmask)
+    from_x = jnp.concatenate(
+        [jnp.ones((Tx,), _F), jnp.zeros((y.shape[-1],), _F)]
+    )
+    R1, tie, _ = rank_sum_stats(comb, cmask, from_x)
 
     n1 = jnp.sum(x_mask.astype(_F))
     n2 = jnp.sum(y_mask.astype(_F))
     N = n1 + n2
-    R1 = jnp.sum(ranks[:Tx])
     U1 = R1 - n1 * (n1 + 1.0) / 2.0
     U2 = n1 * n2 - U1
     U = jnp.maximum(U1, U2)
@@ -96,9 +110,7 @@ def wilcoxon_signed_rank(x, x_mask, y, y_mask):
     both = x_mask & y_mask
     d = jnp.where(both, x.astype(_F) - y.astype(_F), 0.0)
     nonzero = both & (d != 0.0)
-    ranks, tie, n = rank_and_ties(jnp.abs(d), nonzero)
-
-    r_plus = jnp.sum(jnp.where(d > 0.0, ranks, 0.0))
+    r_plus, tie, n = rank_sum_stats(jnp.abs(d), nonzero, (d > 0.0).astype(_F))
     total = n * (n + 1.0) / 2.0
     r_minus = total - r_plus
     W = jnp.minimum(r_plus, r_minus)
@@ -245,33 +257,44 @@ def ks_2samp(x, x_mask, y, y_mask):
     F2 = _safe_div(jnp.sum(le_y, axis=1), n2)
     diffs = jnp.where(pts_valid, jnp.abs(F1 - F2), 0.0)
     D = jnp.max(diffs)
-
-    en = jnp.sqrt(_safe_div(n1 * n2, n1 + n2))
-    p = kolmogorov_sf((en + 0.12 + _safe_div(jnp.asarray(0.11, _F), en)) * D)
-    p = jnp.where((n1 > 0) & (n2 > 0), p, 1.0)
-    return D, p
+    return D, _ks_pvalue(D, n1, n2)
 
 
 # ---------------------------------------------------------------------------
-# Fused two-sample family: one sort serves both rank tests.
+# Fused two-sample family: ONE sort serves both rank tests AND the KS
+# distance.
 # ---------------------------------------------------------------------------
 def two_sample_tests(x, x_mask, y, y_mask):
     """Mann-Whitney + 2-group Kruskal + Wilcoxon + KS on one window pair.
 
-    The combined sample is ranked ONCE and the Mann-Whitney U and
-    Kruskal-Wallis H (k=2) statistics are both derived from the shared rank
-    sums — the sort dominates the cost of the rank tests, and the standalone
-    functions would sort the identical data twice through HLO that XLA cannot
-    CSE. Returns {test: (stat, p)} identical to the standalone kernels.
+    The combined sample is sorted ONCE, carrying x-membership and validity
+    payloads (the rank_sum_stats design, ranks.py). From that single sorted
+    view come:
+      * the Mann-Whitney / Kruskal-Wallis rank sums (tie-averaged ranks via
+        cummax/cummin group bounds);
+      * the KS sup-distance: at each sorted valid point, #x <= value is the
+        cumulative x-count at the END of its tie group (the `<=` semantics
+        of the O(T^2) formulation, same cummin smear as the tie bounds) —
+        no (2T x T) comparison matrix, no gathers.
+    Only Wilcoxon needs its own (shorter) sort of |diffs|. Returns
+    {test: (stat, p)} identical to the standalone kernels.
     """
     Tx = x.shape[-1]
     comb = jnp.concatenate([x, y]).astype(_F)
     cmask = jnp.concatenate([x_mask, y_mask])
-    ranks, tie, N = rank_and_ties(comb, cmask)
+    from_x = jnp.concatenate(
+        [jnp.ones((Tx,), _F), jnp.zeros((y.shape[-1],), _F)]
+    )
+
+    w = from_x * cmask.astype(_F)  # valid member of x
+    view = _sorted_rank_view(comb, cmask, extras=(w,))
+    (sw,) = view.extras
+    R1 = jnp.sum(view.avg * sw)
+    tie = _tie_term(view)
+    N = view.n_valid
 
     n1 = jnp.sum(x_mask.astype(_F))
     n2 = jnp.sum(y_mask.astype(_F))
-    R1 = jnp.sum(ranks[:Tx])
     R2 = N * (N + 1.0) / 2.0 - R1
 
     # Mann-Whitney from shared ranks
@@ -293,8 +316,21 @@ def two_sample_tests(x, x_mask, y, y_mask):
     H = jnp.where(ok, H, 0.0)
     p_k = jnp.where(ok, chi2_sf(H, jnp.asarray(1.0, _F)), 1.0)
 
+    # KS from the same sorted view: cumulative per-sample counts at each tie
+    # group's end give #\{x <= value\} / #\{y <= value\} with `<=` semantics.
+    # (Tie groups split on validity, but the sentinel group contributes no
+    # valid counts, so group-end cumulatives are unaffected by the split.)
+    cx_inc = jnp.cumsum(sw)
+    cx_end = jax.lax.cummin(
+        jnp.where(view.group_end, cx_inc, jnp.inf), axis=0, reverse=True
+    )
+    cy_end = view.g1 - cx_end  # valid y count = valid count - valid x count
+    F1 = _safe_div(cx_end, n1)
+    F2 = _safe_div(cy_end, n2)
+    D = jnp.max(jnp.where(view.sv > 0.0, jnp.abs(F1 - F2), 0.0))
+    p_ks = _ks_pvalue(D, n1, n2)
+
     W, p_w = wilcoxon_signed_rank(x, x_mask, y, y_mask)
-    D, p_ks = ks_2samp(x, x_mask, y, y_mask)
     return {
         "mann_whitney": (U1, p_mw),
         "kruskal": (H, p_k),

@@ -2,20 +2,150 @@
 
 TPU constraints drive the design (see /opt/skills/guides/pallas_guide.md and
 SURVEY.md §7 "Hard parts"): no data-dependent shapes, so missing samples are
-handled by masks, never by filtering. Masked slots sort to the end (+inf key)
-and receive rank 0; valid slots receive scipy.rankdata-compatible average
-ranks. Tie correction terms (sum of t^3 - t over tie groups) are computed with
-segment sums over sorted tie-group ids, which XLA lowers to scatter-adds.
+handled by masks, never by filtering. Masked slots sort to the end (+inf key,
+a class secondary sort key) and receive rank 0; valid slots receive
+scipy.rankdata-compatible average ranks. Valid +inf values sort before the
+masked sentinels and never share a tie group with them; valid NaNs (where
+scipy.rankdata only propagates NaN) are DEFINED to rank highest, tied
+together — numpy's NaN-last sort order — also clear of the sentinels.
 
-All functions operate on one 1-D series and are vmapped by callers; everything
-is O(T log T) via a single sort.
+Performance note (measured on v5e, B=12.5k x T=256): the first design used
+segment_min/max/sum over tie-group ids plus a scatter un-sort — XLA lowers
+those to scatters, which serialize on TPU and made ranking ~78% of the whole
+fleet-scoring program (~215 ms of a ~400 ms launch). Gathers
+(take_along_axis) are nearly as bad (~29 ms each at this shape). The
+implementation below therefore works entirely in *sorted space*:
+
+  * ONE `lax.sort` carries the key plus whatever per-slot payloads the
+    statistic needs (validity, group membership, sign) — no gather is ever
+    needed to realign them;
+  * tie-group bounds come from `cummax`/`cummin` over group-boundary
+    markers (associative scans — TPU-friendly), not segment ops;
+  * rank *sums* (all the rank tests ever need) are computed as weighted
+    sums in sorted space. `rank_and_ties` still materializes per-slot ranks
+    in input order for the generic API, paying one argsort-based inverse
+    permutation + gather; the hot fleet path uses `rank_sum_stats` and
+    pays none.
+
+`_sorted_rank_view` is the single home of the sorted-space machinery;
+`rank_sum_stats`, `rank_and_ties`, and the fused two-sample family in
+ops/pairwise.py all build on it, so the tie-group semantics cannot drift
+between the standalone kernels and the fused path.
+
+All functions operate on one 1-D series and are vmapped by callers;
+everything is O(T log T) via a single sort.
 """
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["masked_rankdata", "rank_and_ties"]
+__all__ = ["masked_rankdata", "rank_and_ties", "rank_sum_stats"]
+
+_F = jnp.float32
+
+
+class SortedRankView(NamedTuple):
+    """Sorted-space view of one masked series (all arrays in sorted order).
+
+    sv:        validity (1.0 valid / 0.0 masked) at each sorted position.
+    extras:    the caller's payload arrays, co-sorted.
+    avg:       tie-averaged 1-based rank at each sorted position. Because
+               the sort is (key, class) with valid-before-masked and group
+               boundaries split on class, valid entries occupy positions
+               1..n_valid and avg matches scipy.rankdata among the valid
+               subset (masked positions carry garbage; zero them with sv
+               or the original mask).
+    t_valid:   valid-member count of each position's tie group.
+    g1:        inclusive cumulative valid count at each position's group
+               END (useful for <=-semantics ECDF counts, e.g. KS).
+    group_end: bool marker of tie-group ends.
+    n_valid:   scalar — total valid count.
+    """
+
+    sv: jnp.ndarray
+    extras: tuple
+    avg: jnp.ndarray
+    t_valid: jnp.ndarray
+    g1: jnp.ndarray
+    group_end: jnp.ndarray
+    n_valid: jnp.ndarray
+
+
+def _sorted_rank_view(values, mask, extras=()) -> SortedRankView:
+    """ONE stable sort by (masked key, class key) + tie-group machinery.
+
+    The primary key is the value with BOTH masked slots and valid NaNs
+    mapped to +inf; the secondary "class" key orders, within equal primary
+    keys, valid non-NaN (0) < valid NaN (1) < masked sentinel (2). This
+    yields scipy.rankdata's ordering of the valid subset, extended with a
+    defined NaN policy (scipy propagates NaN; here valid NaNs rank highest,
+    tied together — numpy's NaN-last sort order). A valid +inf ranks below
+    valid NaNs, and neither ever shares a tie group with the masked
+    sentinels (the scipy-divergence bug class). Mapping NaNs at the key
+    stage also keeps NaN out of the sort keys and the group-boundary
+    comparisons entirely. Group boundaries split on primary OR class
+    change. All group statistics come from
+    cummax/cummin/cumsum scans; no segment ops, no gathers.
+    """
+    T = values.shape[-1]
+    vf = values.astype(_F)
+    is_nan = jnp.isnan(vf)
+    keys = jnp.where(mask & ~is_nan, vf, jnp.inf)
+    cls = jnp.where(mask, jnp.where(is_nan, 1.0, 0.0), 2.0)
+    out = jax.lax.sort((keys, cls) + tuple(extras), dimension=-1, num_keys=2)
+    sk, scls, sextras = out[0], out[1], tuple(out[2:])
+    sv = (scls < 1.5).astype(_F)
+    pos = jnp.arange(1, T + 1, dtype=_F)
+    neq = (sk[1:] != sk[:-1]) | (scls[1:] != scls[:-1])
+    new_group = jnp.concatenate([jnp.ones((1,), bool), neq])
+    group_end = jnp.concatenate([neq, jnp.ones((1,), bool)])
+    first = jax.lax.cummax(jnp.where(new_group, pos, 0.0))
+    last = jax.lax.cummin(jnp.where(group_end, pos, jnp.inf), axis=0, reverse=True)
+    avg = (first + last) * 0.5
+    cv_inc = jnp.cumsum(sv)
+    cv_exc = cv_inc - sv
+    g0 = jax.lax.cummax(jnp.where(new_group, cv_exc, -jnp.inf))
+    g1 = jax.lax.cummin(jnp.where(group_end, cv_inc, jnp.inf), axis=0, reverse=True)
+    t_valid = g1 - g0
+    return SortedRankView(
+        sv=sv, extras=sextras, avg=avg, t_valid=t_valid, g1=g1,
+        group_end=group_end, n_valid=cv_inc[-1],
+    )
+
+
+def _tie_term(view: SortedRankView) -> jnp.ndarray:
+    """Sum over tie groups of t^3 - t, t counting valid members only
+    (every valid member contributes t^2 - 1 once)."""
+    return jnp.sum(view.sv * (view.t_valid * view.t_valid - 1.0))
+
+
+def rank_sum_stats(values: jnp.ndarray, mask: jnp.ndarray, weight: jnp.ndarray):
+    """Weighted rank sum without materializing ranks in input order.
+
+    Computes sum_i weight_i * rank_i over valid entries, where rank is the
+    1-based tie-averaged rank among valid entries (scipy.rankdata), plus the
+    tie-correction term and the valid count — the complete sufficient
+    statistics for Mann-Whitney / Wilcoxon / 2-group Kruskal-Wallis.
+
+    Args:
+      values: (T,) float array; entries where mask is False are ignored.
+      mask:   (T,) bool.
+      weight: (T,) per-slot weights (e.g. a membership indicator). Only
+              weights at valid slots contribute.
+
+    Returns:
+      wsum:     scalar — sum of weight * rank over valid entries.
+      tie_term: scalar — sum over tie groups of t^3 - t (valid members).
+      n_valid:  scalar float — number of valid entries.
+    """
+    w = weight.astype(_F) * mask.astype(_F)
+    view = _sorted_rank_view(values, mask, extras=(w,))
+    (sw,) = view.extras
+    wsum = jnp.sum(view.avg * sw)
+    return wsum, _tie_term(view), view.n_valid
 
 
 @jax.jit
@@ -29,37 +159,20 @@ def rank_and_ties(values: jnp.ndarray, mask: jnp.ndarray):
     Returns:
       ranks:    (T,) float32 — 1-based average ranks among valid entries,
                 0.0 for masked entries. Matches scipy.stats.rankdata on the
-                valid subset.
+                valid subset (including +inf values).
       tie_term: scalar — sum over tie groups (valid entries only) of t^3 - t,
                 the correction term used by Mann-Whitney / Kruskal / Wilcoxon.
       n_valid:  scalar float — number of valid entries.
     """
     T = values.shape[-1]
-    dtype = jnp.float32
-    vals = jnp.where(mask, values.astype(dtype), jnp.inf)
-    # Stable sort: masked (+inf) entries land at the end.
-    order = jnp.argsort(vals, stable=True)
-    sorted_vals = vals[order]
-    sorted_valid = mask[order]
-
-    pos = jnp.arange(1, T + 1, dtype=dtype)
-    new_group = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), sorted_vals[1:] != sorted_vals[:-1]]
-    )
-    gid = jnp.cumsum(new_group) - 1  # 0-based tie-group ids, ascending
-
-    first = jax.ops.segment_min(pos, gid, num_segments=T)
-    last = jax.ops.segment_max(pos, gid, num_segments=T)
-    avg = (first + last) * 0.5
-    ranks_sorted = avg[gid]
-
-    ranks = jnp.zeros(T, dtype=dtype).at[order].set(ranks_sorted)
-    ranks = jnp.where(mask, ranks, 0.0)
-
-    counts = jax.ops.segment_sum(sorted_valid.astype(dtype), gid, num_segments=T)
-    tie_term = jnp.sum(counts**3 - counts)
-    n_valid = jnp.sum(mask.astype(dtype))
-    return ranks, tie_term, n_valid
+    idx = jnp.arange(T, dtype=jnp.int32)
+    view = _sorted_rank_view(values, mask, extras=(idx,))
+    (si,) = view.extras
+    # un-sort via the inverse permutation (gather — cheaper than the scatter
+    # .at[order].set it replaces, and only this generic API pays it)
+    inv = jnp.argsort(si)
+    ranks = jnp.where(mask, view.avg[inv], 0.0)
+    return ranks, _tie_term(view), view.n_valid
 
 
 def masked_rankdata(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:

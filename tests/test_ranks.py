@@ -4,7 +4,7 @@ import pytest
 import scipy.stats as sps
 
 from foremast_tpu.ops import masked_rankdata
-from foremast_tpu.ops.ranks import rank_and_ties
+from foremast_tpu.ops.ranks import rank_and_ties, rank_sum_stats
 
 
 @pytest.mark.parametrize("seed", range(5))
@@ -39,3 +39,91 @@ def test_all_masked():
     assert float(n) == 0.0
     assert float(tie) == 0.0
     assert np.all(np.asarray(ranks) == 0.0)
+
+
+# --- rank_sum_stats: the sorted-space hot-path primitive ------------------
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("ties", [False, True])
+def test_rank_sum_stats_matches_rank_and_ties(seed, ties):
+    """wsum must equal the weighted sum of materialized ranks, and the tie
+    term / valid count must agree with the generic API, for arbitrary
+    weights and masks."""
+    rng = np.random.default_rng(seed)
+    T = 41
+    vals = rng.normal(size=T).astype(np.float32)
+    if ties:
+        vals = np.round(vals * 2) / 2
+    mask = rng.random(T) > 0.3
+    weight = rng.random(T).astype(np.float32)
+
+    ranks, tie_ref, n_ref = rank_and_ties(vals, mask)
+    wsum, tie, n = rank_sum_stats(vals, mask, weight)
+    expected = float(np.sum(np.asarray(ranks) * weight * mask))
+    np.testing.assert_allclose(float(wsum), expected, rtol=1e-5)
+    np.testing.assert_allclose(float(tie), float(tie_ref), rtol=1e-6)
+    assert float(n) == float(n_ref)
+
+
+def test_rank_sum_stats_all_masked():
+    wsum, tie, n = rank_sum_stats(
+        np.zeros(8, np.float32), np.zeros(8, bool), np.ones(8, np.float32)
+    )
+    assert float(wsum) == 0.0 and float(tie) == 0.0 and float(n) == 0.0
+
+
+def test_valid_inf_does_not_tie_with_sentinel():
+    """A valid +inf value must rank like scipy ranks it among the valid
+    subset — NOT tie-group with the +inf mask sentinels (rates can divide
+    to inf; the original segment-id implementation averaged the inf's rank
+    across masked slots and diverged from scipy)."""
+    vals = np.array([1.0, np.inf, 2.0, 0.0], np.float32)
+    mask = np.array([True, True, True, False])
+    ranks = np.asarray(masked_rankdata(vals, mask))
+    expected = sps.rankdata(vals[mask])  # [1, 3, 2]
+    np.testing.assert_allclose(ranks[mask], expected, rtol=1e-6)
+    assert ranks[~mask].sum() == 0.0
+
+    wsum, tie, n = rank_sum_stats(vals, mask, np.ones(4, np.float32))
+    np.testing.assert_allclose(float(wsum), expected.sum(), rtol=1e-6)
+    assert float(tie) == 0.0  # no real ties among the valid entries
+    assert float(n) == 3.0
+
+
+def test_valid_nan_ranks_highest_tied():
+    """Valid NaNs (0/0 rates) rank highest and tie together — numpy's
+    NaN-last sort order, the defined extension where scipy.rankdata only
+    propagates NaN. Above valid +inf, never grouped with the masked
+    sentinels, and NEVER position-inflated by masked-slot count (the bug
+    class: a NaN used to sort past the +inf sentinels and take a rank
+    counting masked slots)."""
+    vals = np.array([1.0, np.nan, np.inf, np.nan, 2.0, 9.0], np.float32)
+    mask = np.array([True, True, True, True, True, False])
+    ranks = np.asarray(masked_rankdata(vals, mask))
+    np.testing.assert_allclose(ranks[mask], [1.0, 4.5, 3.0, 4.5, 2.0], rtol=1e-6)
+    assert ranks[~mask].sum() == 0.0
+    _, tie, n = rank_and_ties(vals, mask)
+    assert float(tie) == 6.0  # the two NaNs tie: t=2 -> t^3 - t = 6
+    assert float(n) == 5.0
+
+
+def test_mann_whitney_with_valid_inf_matches_scipy():
+    """The fused path must agree with scipy when a sample contains +inf
+    (the review-found divergence: U=6.0/p=0.663 vs scipy's U=5.0/p=1.0)."""
+    from foremast_tpu.ops.pairwise import mann_whitney_u, two_sample_tests
+
+    x = np.array([1.0, np.inf, 2.0, 7.0], np.float32)
+    y = np.array([0.5, 3.0, 4.0, 7.0], np.float32)
+    xm = np.array([True, True, True, False])
+    ym = np.array([True, True, True, False])
+    ref = sps.mannwhitneyu(x[xm], y[ym], method="asymptotic")
+    U1, p = mann_whitney_u(x, xm, y, ym)
+    np.testing.assert_allclose(float(U1), ref.statistic, rtol=1e-6)
+    np.testing.assert_allclose(float(p), ref.pvalue, rtol=1e-5)
+    fused = two_sample_tests(x, xm, y, ym)
+    np.testing.assert_allclose(
+        float(fused["mann_whitney"][0]), ref.statistic, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(fused["mann_whitney"][1]), ref.pvalue, rtol=1e-5
+    )
