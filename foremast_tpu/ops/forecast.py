@@ -67,10 +67,28 @@ BOUND_LOWER = 2
 BOUND_BOTH = 3
 
 
+def _hold_last(vals, flags, reverse: bool = False):
+    """At each slot, the most recent `vals` entry whose flag was True
+    (looking left, or right when reverse=True); vals[0-ish] propagated as-is
+    where no flagged entry precedes. Gather-free: the classic "last
+    non-null" associative combiner in O(log T) depth — scatters/gathers
+    serialize on TPU, associative scans do not (see ops/ranks.py)."""
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av), af | bf
+
+    held, _ = lax.associative_scan(
+        combine, (vals, flags), axis=vals.ndim - 1, reverse=reverse
+    )
+    return held
+
+
 def _first_valid(x, mask):
     """Value at the first True of mask (0.0 if none)."""
-    idx = jnp.argmax(mask)
-    return jnp.where(jnp.any(mask), x[idx], 0.0)
+    held = _hold_last(x.astype(_F), mask, reverse=True)
+    return jnp.where(jnp.any(mask), held[..., 0], 0.0)
 
 
 def masked_mean_std(x, mask, axis=-1):
@@ -104,25 +122,31 @@ def _moving_average_1d(x, mask, window: int):
     xf = x.astype(_F)
     xm = jnp.where(mask, xf, 0.0)
     m = mask.astype(_F)
-    csum = jnp.concatenate([jnp.zeros(1, _F), jnp.cumsum(xm)])
-    ccnt = jnp.concatenate([jnp.zeros(1, _F), jnp.cumsum(m)])
     t = jnp.arange(T)
-    lo = jnp.maximum(t - window, 0)
-    s = csum[t] - csum[lo]
-    c = ccnt[t] - ccnt[lo]
+    # windowed sums as exclusive-cumsum differences. The lookback is a
+    # dynamic ROLL (two slices), never a per-element gather: csum[lo] with
+    # lo = max(t - window, 0) equals the exclusive cumsum shifted right by
+    # `window`, zeroed where the window still touches the series start.
+    ex_s = jnp.cumsum(xm) - xm
+    ex_c = jnp.cumsum(m) - m
+    in_range = t >= window
+    s = ex_s - jnp.where(in_range, jnp.roll(ex_s, window), 0.0)
+    c = ex_c - jnp.where(in_range, jnp.roll(ex_c, window), 0.0)
     ma = s / jnp.where(c == 0, 1.0, c)
     defined = c > 0
     # freeze-fill at the rolling mean evaluated just AFTER the last
     # observation, where the window still holds up to `window` trailing
     # points. (Freezing at the last slot whose window held ANY data would
     # re-anchor to the final sample alone: that window has slid to a
-    # single point.)
+    # single point.) h[t] carries ma[prev_idx+1] forward without a gather:
+    # it resets to ma[t] whenever slot t-1 was observed.
     idx = jnp.where(mask, t, -1)
     last_le = lax.cummax(idx)  # last valid index <= t
     prev_idx = jnp.concatenate([jnp.full((1,), -1), last_le[:-1]])
-    t0 = jnp.minimum(prev_idx + 1, T - 1)
+    reset = jnp.concatenate([jnp.ones((1,), bool), mask[:-1]])
+    h = _hold_last(ma, reset)
     first = _first_valid(x, mask)
-    filled = jnp.where(prev_idx >= 0, ma[t0], first)
+    filled = jnp.where(prev_idx >= 0, h, first)
     return jnp.where(defined, ma, filled)
 
 

@@ -180,3 +180,49 @@ def test_min_friedman_points_config_wired():
 
     assert verdict(8) is True   # 8/8 wins: exact p = 2*(1/2)^8 ~ 0.0078 < 0.05
     assert verdict(9) is False  # gated: not enough blocks -> cannot judge
+
+
+def test_verdict_program_lowers_without_scatters():
+    """Scatters serialize on TPU; the round-3 sorted-space redesign removed
+    every one from the fleet-scoring program (docs/benchmarks.md 'Kernel
+    optimization'). Pin it: a reintroduced segment op or .at[].set in any
+    sub-kernel shows up as a scatter in the lowered HLO."""
+    import jax
+
+    B, T = 8, 32
+    rng = np.random.default_rng(0)
+    args = (
+        rng.normal(10, 2, (B, T)).astype(np.float32),
+        rng.random((B, T)) > 0.05,
+        rng.normal(10, 2, (B, T)).astype(np.float32),
+        rng.random((B, T)) > 0.05,
+        np.full(B, 0.01, np.float32), np.full(B, 0b1111, np.int32),
+        np.zeros(B, np.int32), np.full(B, 10, np.int32),
+        np.full(B, 3.0, np.float32), np.zeros(B, np.int32),
+        np.zeros(B, np.float32),
+        np.tile(np.asarray([20, 20, 5], np.int32), (B, 1)),
+    )
+    hlo = jax.jit(jax.vmap(fl._pair_verdict)).lower(*args).as_text()
+    assert "scatter" not in hlo, "a scatter crept back into the verdict program"
+
+
+def test_moving_average_band_lowers_with_one_batched_gather_at_most():
+    """The MA band's per-element dynamic lookups (the old csum[lo], ma[t0],
+    x[idx] — 3-4 gathers of computed indices) were rewritten as rolls and
+    associative hold-last scans. The one remaining gather is the vmapped
+    dynamic roll itself: a batched contiguous row-shift (ma_window is
+    per-pair), a fundamentally cheaper access pattern. Pin the ceiling so
+    a reintroduced per-element index shows up as a count regression."""
+    import jax
+
+    from foremast_tpu.ops import forecast as fc
+
+    B, T = 8, 32
+    rng = np.random.default_rng(0)
+    x = rng.normal(10, 2, (B, T)).astype(np.float32)
+    m = rng.random((B, T)) > 0.3
+    w = np.full(B, 10, np.int32)
+    f = jax.jit(jax.vmap(fc._moving_average_1d))
+    hlo = f.lower(x, m, w).as_text()
+    assert "scatter" not in hlo
+    assert hlo.count('"stablehlo.gather"') <= 1, hlo.count('"stablehlo.gather"')
