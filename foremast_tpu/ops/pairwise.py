@@ -206,18 +206,33 @@ def sign_test_exact(x, y, pair_mask):
     correct small-sample replacement. Tied blocks are dropped (the standard
     conditional exact treatment).
 
-    Returns (n_untied, pvalue). pvalue = min(1, 2*P(X <= min(wins, losses)))
-    via the regularized incomplete beta: P(X <= k) = I_{1/2}(n-k, k+1).
+    Returns (n_untied, pvalue). pvalue = min(1, 2*P(X <= min(wins, losses))),
+    X ~ Binom(n, 1/2), computed as an explicit vectorized tail sum
+    sum_{k<=s} C(n,k) 2^-n via lgamma — the window length bounds n, so the
+    whole tail is a fixed-size masked reduction. (The regularized
+    incomplete beta gives the same value but lowers to a serialized
+    continued-fraction while_loop on TPU; the lgamma grid is pure
+    elementwise work.)
     """
+    T = x.shape[-1]
     xv = x.astype(_F)
     yv = y.astype(_F)
     pos = jnp.sum(((yv > xv) & pair_mask).astype(_F))
     neg = jnp.sum(((yv < xv) & pair_mask).astype(_F))
     n = pos + neg
     s = jnp.minimum(pos, neg)
-    # n - s >= n/2 > 0 whenever n > 0; clamp keeps betainc's a>0 domain
-    # satisfied on the n=0 branch that jnp.where discards.
-    cdf = jax.scipy.special.betainc(jnp.maximum(n - s, 0.5), s + 1.0, 0.5)
+    k = jnp.arange(T + 1, dtype=_F)
+    in_tail = (k <= s) & (k <= n)
+    # lgamma needs positive args; masked lanes use clamped operands and are
+    # zeroed after exp
+    nk = jnp.maximum(n - k + 1.0, 1.0)
+    log_pmf = (
+        jax.lax.lgamma(n + 1.0)
+        - jax.lax.lgamma(k + 1.0)
+        - jax.lax.lgamma(nk)
+        - n * jnp.log(jnp.asarray(2.0, _F))
+    )
+    cdf = jnp.sum(jnp.where(in_tail, jnp.exp(log_pmf), 0.0))
     p = jnp.clip(2.0 * cdf, 0.0, 1.0)
     return n, jnp.where(n > 0, p, 1.0)
 

@@ -225,4 +225,8 @@ def test_moving_average_band_lowers_with_one_batched_gather_at_most():
     f = jax.jit(jax.vmap(fc._moving_average_1d))
     hlo = f.lower(x, m, w).as_text()
     assert "scatter" not in hlo
-    assert hlo.count('"stablehlo.gather"') <= 1, hlo.count('"stablehlo.gather"')
+    # quote-insensitive: the StableHLO printer may emit the op in quoted
+    # generic or pretty form; counting the bare name survives both, so the
+    # pin cannot vacuously pass on printer-format drift
+    n_gather = hlo.count("stablehlo.gather")
+    assert 1 <= n_gather <= 2, n_gather  # the batched roll, possibly quoted+typed
