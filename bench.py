@@ -10,7 +10,8 @@ This bench therefore runs the per-chip shard — B=12,500 pairs, T=128
 (~2h of 60s-step points, wider than the reference's 10-min canary
 window) — on the one available chip and pro-rates explicitly: the wall
 time of one chip's shard IS the fleet's time to 100k, up to the top-k
-reduction, which is measured separately on the 8-device dryrun mesh.
+reduction, which is validated (compiled + executed, not timed — no
+multi-chip hardware here) on the 8-device dryrun mesh.
 
 Protocol (VERDICT r02 #2): p99 over >=100 timed runs (default 150,
 override BENCH_RUNS); compile time reported separately; min/max/std
