@@ -114,8 +114,8 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128) -> dict:
     )
     # Host-only throughput: the cycle minus the score stage. This bench is
     # CPU-pinned (see module docstring), so the score stage here is CPU
-    # compute that on the production chip is ~0.1 ms per launch (bench.py's
-    # headline measures it on the real device) — at ~40 s/cycle on CPU it
+    # compute that the production chip runs far faster (bench.py's headline
+    # measures it on the real device with forced completion) — on CPU it
     # would otherwise swamp the host path and turn the native-vs-python
     # parser comparison into machine-load noise. wall - score is exactly
     # the part of the cycle this bench exists to measure:
