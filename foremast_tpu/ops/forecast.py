@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .ranks import _cummax
+
 __all__ = [
     "ALGO_MOVING_AVERAGE",
     "ALGO_SES",
@@ -141,7 +143,7 @@ def _moving_average_1d(x, mask, window: int):
     # single point.) h[t] carries ma[prev_idx+1] forward without a gather:
     # it resets to ma[t] whenever slot t-1 was observed.
     idx = jnp.where(mask, t, -1)
-    last_le = lax.cummax(idx)  # last valid index <= t
+    last_le = _cummax(idx)  # last valid index <= t
     prev_idx = jnp.concatenate([jnp.full((1,), -1), last_le[:-1]])
     reset = jnp.concatenate([jnp.ones((1,), bool), mask[:-1]])
     h = _hold_last(ma, reset)

@@ -24,7 +24,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .ranks import _sorted_rank_view, _tie_term, rank_and_ties, rank_sum_stats
+from .ranks import (
+    _cummin_rev,
+    _sorted_rank_view,
+    _tie_term,
+    rank_and_ties,
+    rank_sum_stats,
+)
 from .stats import chi2_sf, kolmogorov_sf, norm_sf
 
 __all__ = [
@@ -336,9 +342,7 @@ def two_sample_tests(x, x_mask, y, y_mask):
     # (Tie groups split on validity, but the sentinel group contributes no
     # valid counts, so group-end cumulatives are unaffected by the split.)
     cx_inc = jnp.cumsum(sw)
-    cx_end = jax.lax.cummin(
-        jnp.where(view.group_end, cx_inc, jnp.inf), axis=0, reverse=True
-    )
+    cx_end = _cummin_rev(jnp.where(view.group_end, cx_inc, jnp.inf))
     cy_end = view.g1 - cx_end  # valid y count = valid count - valid x count
     F1 = _safe_div(cx_end, n1)
     F2 = _safe_div(cy_end, n2)

@@ -47,6 +47,19 @@ __all__ = ["masked_rankdata", "rank_and_ties", "rank_sum_stats"]
 _F = jnp.float32
 
 
+def _cummax(x):
+    """Inclusive running max. lax.associative_scan lowers ~4-7x faster than
+    lax.cummax's reduce-window form on XLA:CPU and no worse on TPU."""
+    return jax.lax.associative_scan(jnp.maximum, x, axis=x.ndim - 1)
+
+
+def _cummin_rev(x):
+    """Inclusive running min from the right (same rationale as _cummax)."""
+    return jax.lax.associative_scan(
+        jnp.minimum, x, axis=x.ndim - 1, reverse=True
+    )
+
+
 class SortedRankView(NamedTuple):
     """Sorted-space view of one masked series (all arrays in sorted order).
 
@@ -102,13 +115,13 @@ def _sorted_rank_view(values, mask, extras=()) -> SortedRankView:
     neq = (sk[1:] != sk[:-1]) | (scls[1:] != scls[:-1])
     new_group = jnp.concatenate([jnp.ones((1,), bool), neq])
     group_end = jnp.concatenate([neq, jnp.ones((1,), bool)])
-    first = jax.lax.cummax(jnp.where(new_group, pos, 0.0))
-    last = jax.lax.cummin(jnp.where(group_end, pos, jnp.inf), axis=0, reverse=True)
+    first = _cummax(jnp.where(new_group, pos, 0.0))
+    last = _cummin_rev(jnp.where(group_end, pos, jnp.inf))
     avg = (first + last) * 0.5
     cv_inc = jnp.cumsum(sv)
     cv_exc = cv_inc - sv
-    g0 = jax.lax.cummax(jnp.where(new_group, cv_exc, -jnp.inf))
-    g1 = jax.lax.cummin(jnp.where(group_end, cv_inc, jnp.inf), axis=0, reverse=True)
+    g0 = _cummax(jnp.where(new_group, cv_exc, -jnp.inf))
+    g1 = _cummin_rev(jnp.where(group_end, cv_inc, jnp.inf))
     t_valid = g1 - g0
     return SortedRankView(
         sv=sv, extras=sextras, avg=avg, t_valid=t_valid, g1=g1,
