@@ -91,8 +91,16 @@ def test_cycle_bench_small_fleet_is_steady():
     rec = bench_cycle.run(n_jobs=24, cycles=2, window_steps=64)
     assert rec["value"] > 0
     # the host-only decomposition excludes the (device-bound) score stage,
-    # so it can never be slower than the raw cycle number
-    assert rec["host_jobs_per_sec"] >= rec["value"]
+    # so it can never be slower than the raw cycle number. The key is
+    # deliberately absent when the monotonic clock fails to advance
+    # (bench_cycle omits it rather than divide by zero) — fail with that
+    # explanation instead of an opaque KeyError.
+    host_jps = rec.get("host_jobs_per_sec")
+    assert host_jps is not None, (
+        "host_jobs_per_sec missing from bench record: host wall-clock did "
+        f"not advance during the run (clock anomaly). record={rec}"
+    )
+    assert host_jps >= rec["value"]
     # identical baseline/current series must stay healthy and requeue:
     # a shrinking fleet would skew every jobs/s number the driver records
     assert rec["unhealthy_or_terminal"] == 0

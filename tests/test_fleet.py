@@ -228,5 +228,8 @@ def test_moving_average_band_lowers_with_one_batched_gather_at_most():
     # quote-insensitive: the StableHLO printer may emit the op in quoted
     # generic or pretty form; counting the bare name survives both, so the
     # pin cannot vacuously pass on printer-format drift
+    # upper bound only: the regression this pin guards is gather growth
+    # (per-element indexing reintroduced); an XLA improvement lowering the
+    # batched roll without any gather should pass, not fail
     n_gather = hlo.count("stablehlo.gather")
-    assert 1 <= n_gather <= 2, n_gather  # the batched roll, possibly quoted+typed
+    assert n_gather <= 2, n_gather  # the batched roll, possibly quoted+typed
