@@ -11,15 +11,19 @@ it in the north-star kernel set).
 Design: every test is written against ONE pair of fixed-length masked windows
 and vmapped over the batch axis by the public `*_batch` wrappers, so a single
 jit-compiled program scores a whole fleet of (baseline, current) pairs. The
-asymptotic (normal / chi-square approximation) branch is implemented — it is
-the only branch that makes sense at fleet batch sizes, and it matches
-scipy's `method="asymptotic"` results, which the parity tests assert.
+rank tests use the asymptotic (normal / chi-square approximation) branch and
+match scipy's `method="asymptotic"` results; KS and the paired sign test use
+EXACT finite-n nulls (batchable scan forms — the lattice-path DP and the
+binomial tail) in the sample-count regimes the engine scores, matching
+scipy's exact modes. The parity tests assert both.
 
 All statistics are computed in float32; windows in this domain are short
 (10-min..30-min at 60 s step), far inside float32's exact-integer range for
 rank sums.
 """
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -55,12 +59,89 @@ def _safe_div(a, b):
     return a / jnp.where(b == 0, 1.0, b)
 
 
-def _ks_pvalue(D, n1, n2):
-    """Two-sided KS p-value: asymptotic Kolmogorov distribution with the
-    Stephens small-sample correction (shared by the standalone and fused
-    paths so the constants cannot drift apart)."""
-    en = jnp.sqrt(_safe_div(n1 * n2, n1 + n2))
-    p = kolmogorov_sf((en + 0.12 + _safe_div(jnp.asarray(0.11, _F), en)) * D)
+# Pairs whose DYNAMIC valid counts both fit this bound get the exact
+# finite-n KS null (the DP grid covers sample counts, not buffer length, so
+# a sparsely-masked long bucket still gets exactness); larger samples use
+# the Stephens-corrected asymptotic, where its drift is far below verdict
+# relevance. The DP is O(K^2) work per pair at grid bound K.
+KS_EXACT_MAX_T = int(os.environ.get("FOREMAST_KS_EXACT_MAX_T", "256"))
+
+
+def _ks_exact_sf(t, n1, n2, Ti: int, Tj: int):
+    """Exact conditional two-sample KS survival probability P(D >= t/(n1*n2)).
+
+    Under the null, every interleaving of the two samples is equally likely:
+    a uniformly random monotone lattice path from (0,0) to (n1,n2), where
+    step direction records which sample the next order statistic came from.
+    D < d iff the path stays strictly inside the band |i/n1 - j/n2| < d, so
+
+        p = 1 - (#paths inside) / C(n1+n2, n1).
+
+    The count DP overflows instantly (C(256,128) ~ 1e75); dividing through
+    by C(i+j, i) turns it into a probability DP with bounded values:
+
+        B[i][j] = inside(i,j) * (B[i-1][j] * i/(i+j) + B[i][j-1] * j/(i+j))
+
+    with B[0][0] = inside(0,0) and p = 1 - B[n1][n2] — the same quantity
+    scipy's ks_2samp(method="exact") evaluates (its _compute_prob_inside
+    path), here in a form XLA batches. The grid is swept along
+    ANTI-DIAGONALS d = i+j: both parents of a cell on diagonal d live on
+    diagonal d-1 (B[i-1][j] one shift over, B[i][j-1] in place), so each
+    `lax.scan` step is pure elementwise work plus one static shift — no
+    within-step recurrence, no gathers/scatters, O(T^2) total (per the TPU
+    lowering rule that scans are fast and scatters serialize).
+
+    `t` is the INTEGER sup statistic max|cx*n2 - cy*n1| (exact in float32 up
+    to 2^24), so the in/out band test `|i*n2 - j*n1| < t` compares integers
+    at t-0.5 — no float-rounding flip at the boundary, where scipy derives
+    the same integer via gcd arithmetic. n1/n2 are dynamic; the diagonal
+    vector is indexed by i over the static grid bound Ti (callers clamp it
+    to the sample-count bound, which may be far below the buffer length for
+    sparse masks), and B[n1][n2] (on diagonal n1+n2) is read out with
+    masked sums (no dynamic slicing). The result is only meaningful when
+    n1 <= Ti and n2 <= Tj — the caller selects Stephens otherwise. Cells
+    with j > n2 hold junk but are harmless: the recurrence only ever moves
+    j upward, so they never feed a cell a path to (n1, n2) visits."""
+    i = jnp.arange(Ti + 1, dtype=_F)
+    isel = (i == n1).astype(_F)
+    diag0 = jnp.where(i == 0.0, (t > 0.5).astype(_F), 0.0)  # B[0][0]
+
+    def step(diag, d):
+        jd = d - i
+        inside = (jd >= 0.0) & (jnp.abs(i * n2 - jd * n1) < t - 0.5)
+        up = jnp.concatenate([jnp.zeros((1,), _F), diag[:-1]])  # B[i-1][j]
+        diag_new = inside.astype(_F) * (up * i + diag * jd) / d
+        return diag_new, jnp.sum(diag_new * isel)
+
+    ds = jnp.arange(1, Ti + Tj + 1, dtype=_F)
+    _, picks = jax.lax.scan(step, diag0, ds)
+    # B[n1][n2] sits on diagonal n1+n2; n1=n2=0 (all-masked) is caught by
+    # the caller's validity guard, so missing d=0 here is harmless.
+    inside_prob = jnp.sum(picks * (ds == n1 + n2).astype(_F))
+    return jnp.clip(1.0 - inside_prob, 0.0, 1.0)
+
+
+def _ks_pvalue(t, n1, n2, Ti: int, Tj: int):
+    """Two-sided KS p-value from the integer sup statistic t (see above).
+
+    Exact finite-n null whenever BOTH dynamic valid counts fit the
+    KS_EXACT_MAX_T grid bound — matching scipy's auto mode, which selects
+    exact by sample count, so a sparsely-masked long bucket is exact too —
+    else the Stephens-corrected asymptotic. The DP grid is clamped to
+    min(T, KS_EXACT_MAX_T) per side: it must cover sample counts, not
+    buffer length. Shared by the standalone and fused paths so the
+    semantics cannot drift apart."""
+    Ki, Kj = min(Ti, KS_EXACT_MAX_T), min(Tj, KS_EXACT_MAX_T)
+    p_exact = _ks_exact_sf(t, n1, n2, Ki, Kj)
+    if Ti <= KS_EXACT_MAX_T and Tj <= KS_EXACT_MAX_T:
+        p = p_exact  # n <= T <= K: exact always applies, skip Stephens
+    else:
+        D = _safe_div(t, n1 * n2)
+        en = jnp.sqrt(_safe_div(n1 * n2, n1 + n2))
+        p_asym = kolmogorov_sf(
+            (en + 0.12 + _safe_div(jnp.asarray(0.11, _F), en)) * D
+        )
+        p = jnp.where((n1 <= Ki) & (n2 <= Kj), p_exact, p_asym)
     return jnp.where((n1 > 0) & (n2 > 0), p, 1.0)
 
 
@@ -244,7 +325,8 @@ def sign_test_exact(x, y, pair_mask):
 
 
 # ---------------------------------------------------------------------------
-# Two-sample Kolmogorov-Smirnov  (scipy.stats.ks_2samp, method="asymp")
+# Two-sample Kolmogorov-Smirnov  (scipy.stats.ks_2samp: exact finite-n null
+# for samples fitting the KS_EXACT_MAX_T grid, method="asymp" beyond)
 # ---------------------------------------------------------------------------
 def ks_2samp(x, x_mask, y, y_mask):
     """Two-sided two-sample KS on masked windows.
@@ -252,13 +334,13 @@ def ks_2samp(x, x_mask, y, y_mask):
     D is the sup-norm distance between the two masked empirical CDFs,
     evaluated at every valid sample point (O(T^2) comparisons — windows in
     this domain are tens of points, so this stays tiny and fuses well).
+    The sup is carried as the integer statistic t = max|cx*n2 - cy*n1|
+    (cx, cy = <=-counts), exact in float32, with D = t/(n1*n2).
 
-    p-value from the asymptotic Kolmogorov distribution with the Stephens
-    small-sample correction ((en + 0.12 + 0.11/en) * D). scipy >= 1.5 instead
-    evaluates the finite-n Kolmogorov distribution via an exact Durbin-matrix
-    recursion, which is inherently sequential and unbatchable; Stephens tracks
-    it within ~0.024 absolute at the window sizes this engine scores (measured
-    in tests/test_pairwise_parity.py).
+    p-value: exact finite-n null via the lattice-path DP for window buckets
+    up to KS_EXACT_MAX_T per side — matching scipy.ks_2samp's auto/exact
+    mode at these sizes — else the Stephens-corrected asymptotic (see
+    _ks_pvalue / _ks_exact_sf).
     """
     xv = x.astype(_F)
     yv = y.astype(_F)
@@ -270,15 +352,15 @@ def ks_2samp(x, x_mask, y, y_mask):
     pts = jnp.concatenate([xv, yv])
     pts_valid = jnp.concatenate([x_mask, y_mask])
 
-    # F(p) = (#valid sample <= p) / n  — masked samples never count, masked
-    # evaluation points never contribute to the sup.
+    # cx(p) = #valid x <= p — masked samples never count, masked evaluation
+    # points never contribute to the sup.
     le_x = (xv[None, :] <= pts[:, None]).astype(_F) * xm[None, :]
     le_y = (yv[None, :] <= pts[:, None]).astype(_F) * ym[None, :]
-    F1 = _safe_div(jnp.sum(le_x, axis=1), n1)
-    F2 = _safe_div(jnp.sum(le_y, axis=1), n2)
-    diffs = jnp.where(pts_valid, jnp.abs(F1 - F2), 0.0)
-    D = jnp.max(diffs)
-    return D, _ks_pvalue(D, n1, n2)
+    cx = jnp.sum(le_x, axis=1)
+    cy = jnp.sum(le_y, axis=1)
+    t = jnp.max(jnp.where(pts_valid, jnp.abs(cx * n2 - cy * n1), 0.0))
+    D = _safe_div(t, n1 * n2)
+    return D, _ks_pvalue(t, n1, n2, x.shape[-1], y.shape[-1])
 
 
 # ---------------------------------------------------------------------------
@@ -341,13 +423,15 @@ def two_sample_tests(x, x_mask, y, y_mask):
     # group's end give #\{x <= value\} / #\{y <= value\} with `<=` semantics.
     # (Tie groups split on validity, but the sentinel group contributes no
     # valid counts, so group-end cumulatives are unaffected by the split.)
+    # The sup is the exact integer statistic t = max|cx*n2 - cy*n1|.
     cx_inc = jnp.cumsum(sw)
     cx_end = _cummin_rev(jnp.where(view.group_end, cx_inc, jnp.inf))
     cy_end = view.g1 - cx_end  # valid y count = valid count - valid x count
-    F1 = _safe_div(cx_end, n1)
-    F2 = _safe_div(cy_end, n2)
-    D = jnp.max(jnp.where(view.sv > 0.0, jnp.abs(F1 - F2), 0.0))
-    p_ks = _ks_pvalue(D, n1, n2)
+    t_ks = jnp.max(
+        jnp.where(view.sv > 0.0, jnp.abs(cx_end * n2 - cy_end * n1), 0.0)
+    )
+    D = _safe_div(t_ks, n1 * n2)
+    p_ks = _ks_pvalue(t_ks, n1, n2, Tx, y.shape[-1])
 
     W, p_w = wilcoxon_signed_rank(x, x_mask, y, y_mask)
     return {
