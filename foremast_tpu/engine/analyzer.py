@@ -312,6 +312,36 @@ class Analyzer:
             return outs[0]
         return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
 
+    def _score_period_partitions(self, band_fn, args, xv, xm, regions) -> dict:
+        """Run a band scorer, partitioned by detected seasonal period.
+
+        The HW/seasonal-trend scan needs a STATIC period (the season buffer
+        length is a compiled shape), so per-series detected periods cannot
+        ride one launch. Candidate sets are tiny (operational cycles), so
+        the fleet splits into at most a handful of sub-batches — each still
+        chunked into the fixed rungs — and outputs merge back in original
+        order. No-period algorithms and auto-off fall through to one call.
+        """
+        chosen = self._detect_periods(xv, xm, regions)
+        if chosen is None:
+            return self._score_chunks(band_fn, args)
+        out: dict | None = None
+        B = xv.shape[0]
+        for p in np.unique(chosen):
+            idx = np.nonzero(chosen == p)[0]
+            sub = self._score_chunks(
+                lambda *a, _p=int(p): band_fn(*a, _period=_p),
+                [a[idx] for a in args],
+            )
+            if out is None:
+                out = {
+                    k: np.empty((B,) + v.shape[1:], v.dtype)
+                    for k, v in sub.items()
+                }
+            for k, v in sub.items():
+                out[k][idx] = v
+        return out
+
     def _score_pairs(self, items: list[_PairItem]):
         """Batch all pairwise items (bucketed by window length)."""
         results = {}
@@ -367,12 +397,48 @@ class Analyzer:
                 }
         return results
 
-    def _predict(self, xv, xm, region, data_steps: int | None = None):
+    def _needs_period(self) -> bool:
+        return self.config.algorithm.startswith(
+            ("holt_winters", "seasonal_trend", "prophet")
+        )
+
+    def _detect_periods(self, xv, xm, region) -> "np.ndarray | None":
+        """Per-series seasonal period for the band batch (auto-detection).
+
+        Returns an int array of chosen periods, or None when the configured
+        algorithm has no period or auto-detection is off. The fallback for
+        unsupported/aperiodic series is the static HW_PERIOD, clamped the
+        same way the static path clamps it."""
+        cfg = self.config
+        cands = tuple(p for p in cfg.hw_period_candidates if p >= 2)
+        # an empty candidate set (operator set HW_PERIOD_CANDIDATES="") is
+        # an explicit "static period only" — same as auto off
+        if not (self._needs_period() and cfg.hw_period_auto and cands):
+            return None
+        T = xv.shape[1]
+        fallback = min(cfg.hw_period, max(T // 2, 2))
+
+        def detect_fn(xv_c, xm_c, reg_c):
+            period, _ = fc.detect_period(
+                xv_c, xm_c & ~reg_c, cands,
+                np.int32(fallback), np.float32(cfg.hw_min_seasonal_acf),
+            )
+            return {"period": period}
+
+        # through the fixed batch rungs like every scorer: one compiled
+        # detection program per (rung, T bucket), bounded launch memory
+        return self._score_chunks(detect_fn, [xv, xm, region])["period"]
+
+    def _predict(self, xv, xm, region, data_steps: int | None = None,
+                 period_override: int | None = None):
         """Forecaster dispatch on config.algorithm (history-only fit).
 
         `data_steps` is the UNPADDED series length: the long-window gate
         must see real data size, not the bucket the batch was padded to,
-        or padding alone would flip the kernel choice.
+        or padding alone would flip the kernel choice. `period_override`
+        carries a detected seasonal period (already support-gated against
+        the series length by detect_period); without it the static
+        HW_PERIOD config is clamped to the window.
         """
         algo = self.config.algorithm
         hist_mask = xm & ~region
@@ -391,12 +457,14 @@ class Analyzer:
                 xv, hist_mask, np.full(B, 0.5, np.float32), np.full(B, 0.1, np.float32)
             )
         elif algo.startswith("holt_winters"):
-            period = min(self.config.hw_period, max(xv.shape[1] // 2, 2))
+            period = (period_override if period_override is not None
+                      else min(self.config.hw_period, max(xv.shape[1] // 2, 2)))
             fitm = hist_mask.copy()
             fitm[:, : 2 * period] = False
             _, preds = fc.fit_holt_winters(xv, hist_mask, fitm, period)
         elif algo.startswith("seasonal_trend") or algo.startswith("prophet"):
-            period = min(self.config.hw_period, max(xv.shape[1] // 2, 2))
+            period = (period_override if period_override is not None
+                      else min(self.config.hw_period, max(xv.shape[1] // 2, 2)))
             _, preds = fc.fit_seasonal_trend(
                 xv, hist_mask, hist_mask, period, self.config.st_order
             )
@@ -429,19 +497,21 @@ class Analyzer:
             data_steps = max(w.values.shape[0] for w in concats)
 
             def band_fn(xv_c, xm_c, reg_c, thr_c, bnd_c, mlb_c,
-                        _steps=data_steps):
-                preds, hist_mask = self._predict(xv_c, xm_c, reg_c, _steps)
+                        _steps=data_steps, _period=None):
+                preds, hist_mask = self._predict(
+                    xv_c, xm_c, reg_c, _steps, period_override=_period)
                 sigma = np.asarray(
                     fc.residual_sigma(xv_c, preds, hist_mask, ~reg_c))
                 return fc.band_anomalies(
                     xv_c, xm_c, reg_c, preds, sigma, thr_c, bnd_c, mlb_c)
 
-            out = self._score_chunks(band_fn, [
+            args = [
                 xv, xm, regions,
                 np.asarray([it.policy.threshold for it in group], np.float32),
                 np.asarray([it.policy.bound for it in group], np.int32),
                 np.asarray([it.policy.min_lower_bound for it in group], np.float32),
-            ])
+            ]
+            out = self._score_period_partitions(band_fn, args, xv, xm, regions)
             counts = out["count"]
             firsts = out["first_index"]
             uppers = out["upper"]

@@ -71,6 +71,14 @@ class EngineConfig:
     # always stays sequential (f32 drift — see seqscan.py docstring)
     long_window_steps: int = 4096  # LONG_WINDOW_STEPS
     hw_period: int = 1440  # Holt-Winters / seasonal-trend period (steps; 1 day at 60s)
+    # seasonality auto-detection (ops/forecast.py:detect_period): when on,
+    # each band job's history votes among the candidate periods by masked
+    # detrended autocorrelation; hw_period is only the fallback for series
+    # with no supported/confident candidate. Candidates are operational
+    # cycles in steps at 60 s: hour / shift / day.
+    hw_period_auto: bool = True  # HW_PERIOD_AUTO
+    hw_period_candidates: tuple = (60, 480, 720, 1440)  # HW_PERIOD_CANDIDATES
+    hw_min_seasonal_acf: float = 0.2  # HW_MIN_SEASONAL_ACF
     st_order: int = 3  # seasonal-trend (prophet) Fourier order
     # LSTM-autoencoder multivariate mode (3+ metrics; faq.md:8-10)
     lstm_window: int = 32  # subwindow length (steps) per training sample
@@ -185,6 +193,13 @@ def from_env(env=None) -> EngineConfig:
         ma_window=_env_int(env, "MA_WINDOW", 30),
         long_window_steps=_env_int(env, "LONG_WINDOW_STEPS", 4096),
         hw_period=_env_int(env, "HW_PERIOD", 1440),
+        hw_period_auto=env.get("HW_PERIOD_AUTO", "1").strip().lower()
+        not in ("0", "false", "no", "off", ""),
+        hw_period_candidates=tuple(
+            int(p) for p in env.get("HW_PERIOD_CANDIDATES", "60,480,720,1440").split(",")
+            if p.strip()
+        ),
+        hw_min_seasonal_acf=_env_float(env, "HW_MIN_SEASONAL_ACF", 0.2),
         st_order=_env_int(env, "ST_ORDER", 3),
         lstm_window=_env_int(env, "LSTM_WINDOW", 32),
         lstm_epochs=_env_int(env, "LSTM_EPOCHS", 30),

@@ -47,6 +47,7 @@ __all__ = [
     "ses_predictions",
     "des_predictions",
     "holt_winters_predictions",
+    "detect_period",
     "fit_holt_winters",
     "fit_seasonal_trend",
     "residual_sigma",
@@ -215,6 +216,93 @@ des_predictions = jax.jit(jax.vmap(_des_1d, in_axes=(0, 0, 0, 0)))
 holt_winters_predictions = jax.jit(
     jax.vmap(_hw_1d, in_axes=(0, 0, None, 0, 0, 0)), static_argnames=("period",)
 )
+
+
+# ---------------------------------------------------------------------------
+# Seasonality detection: which candidate period (if any) does the history
+# actually exhibit?
+# ---------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("candidates",))
+def detect_period(x, mask, candidates: tuple, fallback, min_acf):
+    """Batched seasonal-period estimation over masked history.
+
+    The reference models TPS "seasonality+trend" for HPA scoring
+    (docs/dynamic_autoscaling.md:28-44) and SURVEY §7 lists Holt-Winters
+    seasonality detection as a hard part; a static HW_PERIOD silently
+    mis-bands any service whose cycle is not the configured default (a
+    shift-pattern service on a daily default, an hourly batch job, ...).
+
+    Method, shaped for one jitted program over the whole fleet:
+      1. remove a masked linear trend per series (closed form — trend
+         inflates autocorrelation at every lag and would drown the
+         comparison between candidates);
+      2. masked autocorrelation at each CANDIDATE lag only (static tuple,
+         so each lag is a static slice — no FFT, no dynamic shapes; the
+         fleet's periods are operational ones: hour / shift / day / week);
+      3. a candidate only counts when the history holds >= 2 full cycles
+         of overlap support (pair count >= lag), else its score is -inf;
+      4. the FIRST candidate within a small margin of the best score wins
+         — every multiple of the true period scores just as high (lag 2p
+         realigns a p-cycle exactly), so list candidates fundamental-first
+         (ascending) and the margin rule resolves the harmonic alias
+         toward the shortest supported cycle;
+      5. fall back to `fallback` when even the best autocorrelation is
+         below `min_acf` (aperiodic series keep the configured default
+         rather than chasing noise).
+
+    Args:
+      x, mask:    (B, T) values + validity (history region only — pass the
+                  historical mask, not the full-window mask).
+      candidates: static tuple of candidate periods in steps (each >= 2),
+                  in preference order — ascending, so the fundamental
+                  beats its harmonics.
+      fallback:   (scalar or (B,)) period used when no candidate is
+                  supported/confident.
+      min_acf:    scalar — minimum autocorrelation to accept a candidate.
+
+    Returns (period (B,) int32, scores (B, C) float32).
+    """
+    B, T = x.shape
+    m = mask.astype(_F)
+    t = jnp.arange(T, dtype=_F)
+    n = jnp.maximum(jnp.sum(m, -1), 1.0)
+    st = jnp.sum(m * t, -1)
+    stt = jnp.sum(m * t * t, -1)
+    xf = jnp.where(mask, x.astype(_F), 0.0)
+    sy = jnp.sum(xf, -1)
+    sty = jnp.sum(t * xf, -1)
+    det = n * stt - st * st
+    slope = jnp.where(det > 0, (n * sty - st * sy) / jnp.where(det == 0, 1.0, det), 0.0)
+    icept = (sy - slope * st) / n
+    d = jnp.where(mask, xf - icept[:, None] - slope[:, None] * t[None, :], 0.0)
+
+    scores = []
+    for p in candidates:
+        if not (2 <= p < T):
+            scores.append(jnp.full((B,), -jnp.inf, _F))
+            continue
+        w = m[:, p:] * m[:, :-p]
+        lead, lag = d[:, p:], d[:, :-p]
+        num = jnp.sum(w * lead * lag, -1)
+        den = jnp.sqrt(
+            jnp.sum(w * lead * lead, -1) * jnp.sum(w * lag * lag, -1)
+        )
+        r = num / jnp.where(den == 0, 1.0, den)
+        supported = jnp.sum(w, -1) >= float(p)  # >= 2 full cycles of span
+        scores.append(jnp.where(supported & (den > 0), r, -jnp.inf))
+    S = jnp.stack(scores, axis=-1)  # (B, C)
+    best_score = jnp.max(S, axis=-1, keepdims=True)
+    # harmonic-alias resolution: first candidate within the margin wins
+    # (argmax over booleans returns the first True)
+    eligible = S >= jnp.maximum(best_score - 0.05, min_acf)
+    pick = jnp.argmax(eligible, axis=-1)
+    cand = jnp.asarray(candidates, jnp.int32)
+    period = jnp.where(
+        jnp.any(eligible, axis=-1),
+        cand[pick],
+        jnp.broadcast_to(jnp.asarray(fallback, jnp.int32), (B,)),
+    )
+    return period, S
 
 
 # ---------------------------------------------------------------------------

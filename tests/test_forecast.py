@@ -279,3 +279,50 @@ def test_seasonal_trend_sparse_series_stays_finite():
     mask[0, 5] = True
     _, preds = fc.fit_seasonal_trend(x, mask, mask, 16)
     assert np.all(np.isfinite(np.asarray(preds)))
+
+
+# ------------------------------------------------------- seasonality detection
+def test_detect_period_recovers_true_period_with_trend_and_gaps():
+    """Masked, trending, noisy series: detection votes the true cycle from
+    the candidate set (SURVEY §7 hard part: HW seasonality detection)."""
+    B, T = 6, 512
+    rng = np.random.default_rng(0)
+    t = np.arange(T)
+    periods = [24, 24, 96, 96, 24, 96]
+    x = np.stack([
+        5.0 + 0.01 * t + 2.0 * np.sin(2 * np.pi * t / p)
+        + rng.normal(0, 0.2, T)
+        for p in periods
+    ]).astype(np.float32)
+    mask = rng.random((B, T)) > 0.15  # real fetches have gaps
+    chosen, scores = fc.detect_period(
+        x, mask, (24, 96, 384), np.int32(1440), np.float32(0.2)
+    )
+    assert np.asarray(chosen).tolist() == periods
+    assert np.all(np.asarray(scores)[np.arange(B), [0, 0, 1, 1, 0, 1]] > 0.8)
+
+
+def test_detect_period_aperiodic_falls_back():
+    B, T = 3, 256
+    rng = np.random.default_rng(1)
+    x = rng.normal(10, 1, (B, T)).astype(np.float32)
+    mask = np.ones((B, T), bool)
+    chosen, _ = fc.detect_period(
+        x, mask, (24, 96), np.int32(777), np.float32(0.2)
+    )
+    assert np.all(np.asarray(chosen) == 777)
+
+
+def test_detect_period_unsupported_candidates_fall_back():
+    """A candidate longer than half the (valid) history has no 2-cycle
+    support and must not be chosen, however strong the noise ACF."""
+    T = 100
+    t = np.arange(T)
+    x = (np.sin(2 * np.pi * t / 80) + 1.0).astype(np.float32)[None]
+    mask = np.ones((1, T), bool)
+    chosen, scores = fc.detect_period(
+        x, mask, (80, 120), np.int32(55), np.float32(0.2)
+    )
+    # lag 80 leaves only 20 overlap pairs (< 80): unsupported; 120 >= T
+    assert np.asarray(scores).max() == -np.inf
+    assert int(np.asarray(chosen)[0]) == 55
