@@ -89,18 +89,25 @@ def _cycle_bench() -> dict:
     subprocess per variant (FOREMAST_NATIVE latches at first load),
     CPU-pinned so they never contend for the parent's TPU grant — the
     host path is what these measure; the device bound is the headline."""
-    extra: dict = {}
-    for flag, key in (("1", "native"), ("0", "python")):
+    def run_child(native_flag: str, mix: bool):
+        """One CPU-pinned bench_cycle child (FOREMAST_NATIVE latches at
+        first load, so every variant needs its own process; the axon pool
+        address is stripped so a wedged tunnel can't hang a CPU run)."""
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
-        env["FOREMAST_NATIVE"] = flag
+        env["FOREMAST_NATIVE"] = native_flag
+        env["BENCH_CYCLE_MIX"] = "1" if mix else "0"
         env.setdefault("BENCH_CYCLE_JOBS", "10000")
-        rec, err = _run_json_child(
+        return _run_json_child(
             [sys.executable, "-m", "foremast_tpu.bench_cycle"],
             timeout_s=900, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
+
+    extra: dict = {}
+    for flag, key in (("1", "native"), ("0", "python")):
+        rec, err = run_child(flag, mix=False)
         if rec is not None:
             extra[f"cycle_jobs_per_sec_{key}"] = rec["value"]
             # the meaningful host-path number: cycle minus the CPU-pinned
@@ -124,6 +131,21 @@ def _cycle_bench() -> dict:
     py_h = extra.get("cycle_host_jobs_per_sec_python")
     if nat_h and py_h:
         extra["cycle_native_host_speedup"] = round(nat_h / py_h, 2)
+    # third leg: the MIXED model-family fleet (pair+band+bivariate+LSTM+HPA,
+    # native parser) — per-family score decomposition and the bounded
+    # LSTM train-on-miss cost (VERDICT r3 #3). The pure-pair legs above
+    # stay as the round-over-round continuity numbers.
+    rec, err = run_child("1", mix=True)
+    if rec is not None:
+        extra["cycle_mixed_jobs_per_sec"] = rec["value"]
+        if "host_jobs_per_sec" in rec:
+            extra["cycle_mixed_host_jobs_per_sec"] = rec["host_jobs_per_sec"]
+        extra["cycle_mixed_family_jobs"] = rec.get("family_jobs")
+        extra["cycle_mixed_family_score_s"] = rec.get("family_score_s_per_cycle")
+        extra["cycle_mixed_lstm_train_s"] = rec.get("lstm_train_s_per_cycle")
+        extra["cycle_mixed_lstm_trains"] = rec.get("lstm_trains_per_cycle")
+    else:
+        extra["cycle_mixed_error"] = err
     return extra
 
 

@@ -46,7 +46,13 @@ def _prom_body(ts0: int, values, step: int = 60) -> bytes:
     ).encode()
 
 
-def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128) -> dict:
+def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
+        mix: bool = False) -> dict:
+    """mix=False: a pure pair-job fleet (round-over-round continuity with
+    the r1-r3 artifacts). mix=True: a realistic model-family mix — 60%
+    pair, 20% band, 10% bivariate, 5% 3-metric LSTM-AE, 5% HPA — with the
+    score stage decomposed per family from the engine's tracer spans and
+    the (budgeted) LSTM train-on-miss cost reported separately."""
     import numpy as np
 
     from .dataplane.fetch import RawFixtureDataSource
@@ -59,38 +65,100 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128) -> dict:
 
     t_end = int(time.time()) // 60 * 60
     ts0 = t_end - window_steps * 60
+    hist_steps = 4 * window_steps
+    ts0_hist = t_end - (hist_steps + window_steps) * 60
     rng = np.random.default_rng(7)
     # 64 distinct series shapes; baseline and current of one job share a
     # body (identical samples -> provably healthy -> the fleet requeues
-    # intact every cycle, keeping jobs/s denominators comparable)
+    # intact every cycle, keeping jobs/s denominators comparable). Band/
+    # bi/LSTM/HPA jobs use "latency"-policy metrics (wide 10-sigma band)
+    # with history drawn from the same distribution as current: healthy.
     bodies = [
         _prom_body(ts0, 10.0 + rng.normal(0.0, 2.0, window_steps))
         for _ in range(64)
     ]
+    hist_bodies = [
+        _prom_body(ts0_hist, 10.0 + rng.normal(0.0, 2.0, hist_steps))
+        for _ in range(16)
+    ]
 
     def resolver(url: str) -> bytes:
         i = int(url.rsplit("job=", 1)[1].split("&", 1)[0])
+        if "w=hist" in url:
+            return hist_bodies[i % len(hist_bodies)]
         return bodies[i % len(bodies)]
 
     source = RawFixtureDataSource(resolver=resolver)
-    docs = []
-    for i in range(n_jobs):
-        docs.append(
-            J.Document(
-                id=f"bench-{i}",
-                app_name=f"app-{i % 128}",
-                namespace="bench",
-                strategy="canary",
-                start_time=to_rfc3339(t_end - 3600),
-                end_time=to_rfc3339(t_end + 86_400),
-                metrics={
-                    "http_errors_5xx": J.MetricQueries(
-                        current=f"http://prom/q?job={i}&w=cur",
-                        baseline=f"http://prom/q?job={i}&w=base",
-                    )
-                },
-            )
+
+    def pair_doc(i):
+        return J.Document(
+            id=f"bench-{i}", app_name=f"app-{i % 128}", namespace="bench",
+            strategy="canary",
+            start_time=to_rfc3339(t_end - 3600),
+            end_time=to_rfc3339(t_end + 86_400),
+            metrics={"http_errors_5xx": J.MetricQueries(
+                current=f"http://prom/q?job={i}&w=cur",
+                baseline=f"http://prom/q?job={i}&w=base",
+            )},
         )
+
+    def _mq(i, m):
+        return J.MetricQueries(
+            current=f"http://prom/q?job={i}&m={m}&w=cur",
+            historical=f"http://prom/q?job={i}&m={m}&w=hist",
+        )
+
+    def band_doc(i):
+        d = pair_doc(i)
+        d.metrics = {"latency": _mq(i, "lat")}
+        return d
+
+    def bi_doc(i):
+        d = pair_doc(i)
+        d.metrics = {"latency": _mq(i, "lat"), "cpu": _mq(i + 1, "cpu")}
+        return d
+
+    def lstm_doc(i):
+        d = pair_doc(i)
+        # a bounded set of app identities so the AE cache warms across
+        # cycles under the LSTM_MAX_TRAIN_PER_CYCLE budget
+        d.app_name = f"lstm-app-{i % 32}"
+        d.metrics = {
+            m: _mq(i + k, m) for k, m in enumerate(("latency", "cpu", "tps"))
+        }
+        return d
+
+    def hpa_doc(i):
+        d = pair_doc(i)
+        d.strategy = "hpa"
+        tps = _mq(i, "tps")
+        lat = _mq(i + 1, "lat")
+        lat.priority, lat.is_increase = 1, True
+        d.metrics = {"tps": tps, "latency": lat}
+        return d
+
+    docs = []
+    fam_counts = {}
+    if mix:
+        makers = (("pair", pair_doc, 0.60), ("band", band_doc, 0.20),
+                  ("bivariate", bi_doc, 0.10), ("lstm", lstm_doc, 0.05),
+                  ("hpa", hpa_doc, 0.05))
+        remaining = n_jobs
+        for fam, mk, frac in makers:
+            if fam == "hpa":  # absorb rounding: total is exactly n_jobs
+                n = remaining
+            else:  # min-1 per family, but never overrun tiny fleets
+                n = min(max(int(n_jobs * frac), 1), remaining)
+            remaining -= n
+            fam_counts[fam] = n
+            base = len(docs)
+            for k in range(n):
+                d = mk(base + k)
+                d.id = f"bench-{fam}-{k}"
+                docs.append(d)
+    else:
+        fam_counts["pair"] = n_jobs
+        docs = [pair_doc(i) for i in range(n_jobs)]
 
     with tempfile.TemporaryDirectory() as tmp:
         store = J.JobStore(snapshot_path=os.path.join(tmp, "jobs.json"))
@@ -130,11 +198,26 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128) -> dict:
         {"host_jobs_per_sec": round(n_jobs * cycles / host_wall, 1)}
         if host_wall > 0 else {}
     )
+    mix_fields = {}
+    if mix:
+        mix_fields["family_jobs"] = fam_counts
+        mix_fields["family_score_s_per_cycle"] = {
+            fam: per_cycle(f"engine.score.{fam}")
+            for fam in ("pair", "band", "bivariate", "lstm", "hpa")
+        }
+        # the bounded train-on-miss figure (VERDICT r3 #3): per-cycle AE
+        # training seconds and count, capped by LSTM_MAX_TRAIN_PER_CYCLE
+        tr = stats.get("engine.lstm_train", {})
+        mix_fields["lstm_train_s_per_cycle"] = round(
+            tr.get("total_seconds", 0.0) / cycles, 4)
+        mix_fields["lstm_trains_per_cycle"] = round(
+            tr.get("count", 0) / cycles, 2)
     return {
         "metric": "engine_cycle_jobs_per_sec",
         "value": round(n_jobs * cycles / wall, 1),
         "unit": "jobs/s",
         **host_fields,
+        **mix_fields,
         "native": native.available(),
         "jobs": n_jobs,
         "cycles": cycles,
@@ -147,9 +230,12 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128) -> dict:
 
 
 def main() -> None:
+    from .engine.config import _env_bool
+
     n = int(os.environ.get("BENCH_CYCLE_JOBS", "10000"))
     cycles = int(os.environ.get("BENCH_CYCLE_REPS", "2"))
-    print(json.dumps(run(n, cycles)))
+    mix = _env_bool(os.environ, "BENCH_CYCLE_MIX", False)
+    print(json.dumps(run(n, cycles, mix=mix)))
 
 
 if __name__ == "__main__":

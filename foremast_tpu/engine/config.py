@@ -86,6 +86,16 @@ class EngineConfig:
     lstm_hidden: int = 32
     lstm_latent: int = 16
     lstm_threshold: float = 3.0  # recon-error z-score gate
+    # train-on-miss budget per cycle: a cold multi-metric fleet must warm
+    # up across cycles instead of blowing one cycle's budget on unbounded
+    # AE training (jobs beyond the budget stay in progress and train on a
+    # later cycle). <= 0 removes the cap.
+    lstm_max_train_per_cycle: int = 8  # LSTM_MAX_TRAIN_PER_CYCLE
+    # reference model dispatch by metric count (design.md:53-88): 2-metric
+    # jobs -> bivariate normal, 3+ -> LSTM-AE, regardless of ML_ALGORITHM
+    # (which names the univariate forecaster). False = route multivariate
+    # families only when ML_ALGORITHM names them explicitly.
+    multimetric_auto: bool = True  # ML_MULTIMETRIC_AUTO
     # band verdict gate: a window is unhealthy when
     # count >= max(band_min_points, band_violation_fraction * checked).
     # A single k-sigma excursion in a 30-point window is expected Gaussian
@@ -154,6 +164,15 @@ def _env_int(env, key, default):
         return default
 
 
+def _env_bool(env, key, default):
+    """One definition of env truthiness for every boolean knob (operators
+    write 0/1, true/false, yes/no, on/off in any case)."""
+    raw = env.get(key)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
 def from_env(env=None) -> EngineConfig:
     """Build an EngineConfig from the ML_* env-var family."""
     env = dict(os.environ) if env is None else env
@@ -193,8 +212,7 @@ def from_env(env=None) -> EngineConfig:
         ma_window=_env_int(env, "MA_WINDOW", 30),
         long_window_steps=_env_int(env, "LONG_WINDOW_STEPS", 4096),
         hw_period=_env_int(env, "HW_PERIOD", 1440),
-        hw_period_auto=env.get("HW_PERIOD_AUTO", "1").strip().lower()
-        not in ("0", "false", "no", "off", ""),
+        hw_period_auto=_env_bool(env, "HW_PERIOD_AUTO", True),
         hw_period_candidates=tuple(
             int(p) for p in env.get("HW_PERIOD_CANDIDATES", "60,480,720,1440").split(",")
             if p.strip()
@@ -206,6 +224,8 @@ def from_env(env=None) -> EngineConfig:
         lstm_hidden=_env_int(env, "LSTM_HIDDEN", 32),
         lstm_latent=_env_int(env, "LSTM_LATENT", 16),
         lstm_threshold=_env_float(env, "LSTM_THRESHOLD", 3.0),
+        lstm_max_train_per_cycle=_env_int(env, "LSTM_MAX_TRAIN_PER_CYCLE", 8),
+        multimetric_auto=_env_bool(env, "ML_MULTIMETRIC_AUTO", True),
         sla_headroom_safe=_env_float(env, "SLA_HEADROOM_SAFE", 0.7),
         policies=policies,
     )

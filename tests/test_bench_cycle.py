@@ -106,3 +106,18 @@ def test_cycle_bench_small_fleet_is_steady():
     assert rec["unhealthy_or_terminal"] == 0
     assert rec["fetches_per_cycle"] == 48  # baseline+current per job
     assert rec["jobs"] == 24 and rec["cycles"] == 2
+
+
+def test_cycle_bench_mixed_fleet_reports_family_decomposition():
+    rec = bench_cycle.run(n_jobs=40, cycles=1, window_steps=64, mix=True)
+    assert rec["value"] > 0
+    fams = rec["family_jobs"]
+    assert set(fams) == {"pair", "band", "bivariate", "lstm", "hpa"}
+    assert sum(fams.values()) == 40
+    costs = rec["family_score_s_per_cycle"]
+    assert set(costs) == set(fams)
+    # every family actually ran work (pair/band/bi/hpa measurable; lstm
+    # may be fully cache-warm in the timed cycle, so only require the
+    # train accounting fields to exist)
+    assert costs["pair"] > 0 and costs["band"] > 0
+    assert "lstm_train_s_per_cycle" in rec and "lstm_trains_per_cycle" in rec

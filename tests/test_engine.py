@@ -654,3 +654,42 @@ def test_hw_wrong_static_period_condemns_healthy_seasonal_service():
         analyzer = Analyzer(cfg, FixtureDataSource(fixtures), store)
         out = analyzer.run_cycle(now=1_000_000.0)
         assert out["hwj"] == expected, (auto, out)
+
+
+def test_lstm_train_budget_amortizes_across_cycles():
+    """A cold multi-metric fleet warms up under LSTM_MAX_TRAIN_PER_CYCLE
+    instead of training every model in one cycle; capped-out jobs stay
+    in progress (requeued) and train later."""
+    fixtures = {}
+    docs = []
+    for j in range(3):
+        rng = np.random.default_rng(20 + j)
+        n_h, n_c = 128, 16
+        for i, name in enumerate(("latency", "cpu", "tps")):
+            w_h = rng.normal(10, 1, n_h)
+            w_c = rng.normal(10, 1, n_c)
+            fixtures[f"h{j}{i}"] = ((np.arange(n_h) * STEP).tolist(),
+                                    w_h.tolist())
+            fixtures[f"c{j}{i}"] = (((n_h + np.arange(n_c)) * STEP).tolist(),
+                                    w_c.tolist())
+        docs.append(Document(
+            id=f"m{j}", app_name=f"app{j}", namespace="d", strategy="canary",
+            start_time=to_rfc3339(0), end_time=to_rfc3339(1e9),
+            metrics={name: MetricQueries(current=f"c{j}{i}",
+                                         historical=f"h{j}{i}")
+                     for i, name in enumerate(("latency", "cpu", "tps"))},
+        ))
+    store = JobStore()
+    for d in docs:
+        store.create(d)
+    cfg = EngineConfig(algorithm="lstm_autoencoder", lstm_window=16,
+                       lstm_epochs=3, lstm_hidden=8, lstm_latent=4,
+                       lstm_max_train_per_cycle=1, policies={},
+                       lstm_threshold=1e9)  # budget is under test, not detection
+    analyzer = Analyzer(cfg, FixtureDataSource(fixtures), store)
+    for cycle, expected_models in ((1, 1), (2, 2), (3, 3)):
+        out = analyzer.run_cycle(now=100.0)
+        assert len(analyzer._lstm_cache) == expected_models, (cycle, out)
+        # nothing terminal: capped-out jobs requeue, trained ones are
+        # healthy within the window and requeue too
+        assert all(s == J.INITIAL for s in out.values()), out
