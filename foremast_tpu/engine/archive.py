@@ -26,6 +26,11 @@ import os
 import threading
 import urllib.request
 
+try:
+    import fcntl
+except ImportError:  # Windows: no flock; single-process archives only
+    fcntl = None
+
 __all__ = ["FileArchive", "EsArchive"]
 
 
@@ -48,35 +53,122 @@ def _match(rec: dict, app, namespace, status, strategy) -> bool:
 
 
 class FileArchive:
-    """Append-only JSONL archive with one-generation rotation."""
+    """Append-only JSONL archive with compacting rotation.
 
-    def __init__(self, path: str, max_bytes: int = 64 * 1024 * 1024):
+    MULTI-PROCESS SAFE on POSIX: the cross-replica failover deployment
+    shares one archive path between runtimes (docs/operations.md), so
+    every file MUTATION holds an fcntl flock on a sidecar `.lock` file
+    (readers stay lock-free — see _iter_records), and each record lands
+    as ONE O_APPEND os.write, so concurrent appends can never interleave
+    into torn lines. Without fcntl (Windows) a per-process lock is all
+    there is: share an archive only via ES there.
+
+    Rotation COMPACTS instead of discarding: when the active file
+    exceeds max_bytes, both generations merge into `.1` keeping the
+    latest record per job id, the latest state blob per key, and the
+    newest `keep_hpalogs` hpalogs. Terminal verdicts therefore survive
+    any amount of open-job mirror churn (gc() trusts the archive to hold
+    them), and steady-state size tracks the job count, not the write
+    rate.
+    """
+
+    def __init__(self, path: str, max_bytes: int = 64 * 1024 * 1024,
+                 keep_hpalogs: int = 1000):
         self.path = path
         self.max_bytes = max_bytes
+        self.keep_hpalogs = keep_hpalogs
         self._lock = threading.Lock()
         # times a lock-free scan exhausted its rescans and fell back to a
         # locked scan (sustained-rotation churn); exposed for observability
         self.locked_scan_fallbacks = 0
+        self.compactions = 0
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
 
+    # -- cross-process mutation lock --
+    def _flock(self):
+        """Context manager holding the cross-process mutation lock (plus
+        the in-process lock: flock is per-fd, threads share the process)."""
+        outer = self
+
+        class _Lock:
+            def __enter__(self):
+                outer._lock.acquire()
+                self._fd = None
+                if fcntl is not None:
+                    try:
+                        self._fd = os.open(outer.path + ".lock",
+                                           os.O_CREAT | os.O_RDWR, 0o644)
+                        fcntl.flock(self._fd, fcntl.LOCK_EX)
+                    except OSError:
+                        if self._fd is not None:
+                            os.close(self._fd)
+                            self._fd = None
+
+            def __exit__(self, *exc):
+                if self._fd is not None:
+                    try:
+                        fcntl.flock(self._fd, fcntl.LOCK_UN)
+                    finally:
+                        os.close(self._fd)
+                outer._lock.release()
+
+        return _Lock()
+
     # -- writing --
     def _append(self, rec: dict) -> bool:
-        line = json.dumps(rec, separators=(",", ":")) + "\n"
-        with self._lock:
+        line = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
+        with self._flock():
             try:
                 if (os.path.exists(self.path)
                         and os.path.getsize(self.path) + len(line) > self.max_bytes):
-                    os.replace(self.path, self.path + ".1")
+                    self._compact_locked()
             except OSError:
                 pass
             try:
-                with open(self.path, "a") as f:
-                    f.write(line)
+                fd = os.open(self.path,
+                             os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+                try:
+                    os.write(fd, line)  # one write(2): interleave-atomic
+                finally:
+                    os.close(fd)
             except OSError:
                 return False  # disk full/unwritable: caller keeps RAM copy
         return True
+
+    def _compact_locked(self):
+        """Merge both generations into `.1`, last-write-wins (caller holds
+        the mutation lock, so no concurrent append can slip between the
+        copy and the truncation)."""
+        docs: dict[str, dict] = {}
+        states: dict[str, dict] = {}
+        hpalogs: list[dict] = []
+        for rec in self._scan_once():
+            t = rec.get("_type")
+            if t == "document":
+                cur = docs.get(rec.get("id", ""))
+                if cur is None or (rec.get("modified_at", 0.0)
+                                   >= cur.get("modified_at", 0.0)):
+                    docs[rec.get("id", "")] = rec
+            elif t == "state":
+                cur = states.get(rec.get("key", ""))
+                if cur is None or (rec.get("updated_at", 0.0)
+                                   >= cur.get("updated_at", 0.0)):
+                    states[rec.get("key", "")] = rec
+            elif t == "hpalog":
+                hpalogs.append(rec)
+        hpalogs.sort(key=lambda r: r.get("timestamp", 0.0))
+        hpalogs = hpalogs[-self.keep_hpalogs:]
+        tmp = self.path + ".1.tmp"
+        with open(tmp, "w") as f:
+            for rec in (*docs.values(), *states.values(), *hpalogs):
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        os.replace(tmp, self.path + ".1")
+        # truncate the active file (its records now live compacted in .1)
+        fd = os.open(self.path, os.O_WRONLY | os.O_TRUNC | os.O_CREAT, 0o644)
+        os.close(fd)
+        self.compactions += 1
 
     def index_job(self, doc: dict) -> bool:
         return self._append({"_type": "document", **doc})
@@ -85,36 +177,39 @@ class FileArchive:
         return self._append({"_type": "hpalog", **log})
 
     def get(self, job_id: str) -> dict | None:
-        """Latest archived record for one job id."""
+        """Latest (by modified_at) archived record for one job id."""
         out = None
         for rec in self._iter_records():
             if rec.get("_type") == "document" and rec.get("id") == job_id:
-                out = rec  # later lines overwrite earlier
+                if out is None or (rec.get("modified_at", 0.0)
+                                   >= out.get("modified_at", 0.0)):
+                    out = rec
         return out
 
     # -- reading --
     def _iter_records(self):
-        # Lock-free streaming scan: rotation swaps files with atomic
-        # os.replace and a torn tail line from a concurrent append fails
-        # JSON decode and is skipped, so readers don't take the write lock
-        # (holding it here blocked index_job for the whole scan — up to two
-        # 64 MB generations per /search call). A rotation *during* the scan
-        # could make a whole generation invisible (the current file becomes
-        # ".1" after we already read the old ".1"), so detect it by inode
-        # change and rescan; consumers are last-write-wins per id, so
-        # re-delivered records are harmless. On Windows the rotation itself
-        # can fail (os.replace on a reader-held file) — it is simply retried
-        # by the next append once reads quiesce. If churn outlasts the
-        # rescans, one final scan runs UNDER the write lock (rotation
-        # cannot race it), so a /search never silently returns a partial
-        # view; the fallback is counted for observability.
+        # Lock-free streaming scan: a torn tail line from a concurrent
+        # append fails JSON decode and is skipped, so readers don't take
+        # the mutation lock (holding it here blocked index_job for the
+        # whole scan — up to two 64 MB generations per /search call). A
+        # compaction *during* the scan could hide records mid-move (new
+        # ".1" written after we read the old one, active file truncated
+        # after we read it), so detect it — ".1" inode change or active
+        # file shrink — and rescan; consumers are last-write-wins per
+        # id/key, so re-delivered records are harmless. If churn outlasts
+        # the rescans, one final scan runs UNDER the mutation lock
+        # (compaction cannot race it), so a /search never silently
+        # returns a partial view; the fallback is counted for
+        # observability.
         for _attempt in range(3):
-            ino_before = self._current_inode()
+            sig_before = self._mutation_sig()
             yield from self._scan_once()
-            if self._current_inode() == ino_before:
+            sig_after = self._mutation_sig()
+            if (sig_after[0] == sig_before[0]
+                    and sig_after[1] >= sig_before[1]):
                 return
         self.locked_scan_fallbacks += 1
-        with self._lock:
+        with self._flock():
             yield from self._scan_once()
 
     def _scan_once(self):
@@ -130,35 +225,78 @@ class FileArchive:
                     except json.JSONDecodeError:
                         continue  # torn tail write after a crash
 
-    def _current_inode(self):
+    def _mutation_sig(self):
+        """(inode of .1, size of active file): compaction replaces .1
+        (new inode) and truncates the active file (size shrink) — either
+        tells a lock-free reader its scan may have missed moving records."""
         try:
-            return os.stat(self.path).st_ino
+            ino1 = os.stat(self.path + ".1").st_ino
         except OSError:
-            return None
+            ino1 = None
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            size = 0
+        return (ino1, size)
 
     def search(self, app=None, namespace=None, status=None, strategy=None,
                limit: int = 50) -> list[dict]:
-        """Newest-last-write-wins per job id, newest first, capped."""
+        """Latest record per job id (by its own modified_at), newest
+        first, capped.
+
+        Dedupe happens BEFORE filtering, so a status filter sees only each
+        job's LATEST archived state — the same semantics as ES, where a PUT
+        per id overwrites and a search can never surface a superseded
+        state. (Filtering first would resurrect a completed job's earlier
+        open-status record — fatal for cross-replica adoption, which asks
+        the archive for open jobs.)"""
         by_id: dict[str, dict] = {}
         for rec in self._iter_records():
             if rec.get("_type") != "document":
                 continue
-            if not _match(rec, app, namespace, status, strategy):
-                continue
-            by_id[rec.get("id", "")] = rec  # later lines overwrite earlier
-        out = list(by_id.values())
+            cur = by_id.get(rec.get("id", ""))
+            # newest by the record's OWN stamp, not append order: with
+            # multiple writers, a wedged peer can append a stale open
+            # record after another replica's terminal one
+            if cur is None or (rec.get("modified_at", 0.0)
+                               >= cur.get("modified_at", 0.0)):
+                by_id[rec.get("id", "")] = rec
+        out = [
+            rec for rec in by_id.values()
+            if _match(rec, app, namespace, status, strategy)
+        ]
         out.sort(key=lambda r: r.get("modified_at", 0.0), reverse=True)
         return out[:limit]
 
+    # -- engine state blobs (breath cooldowns): last-writer-wins by stamp --
+    def index_state(self, key: str, value, updated_at: float) -> bool:
+        return self._append({"_type": "state", "key": key, "value": value,
+                             "updated_at": updated_at})
+
+    def get_state(self, key: str):
+        """Latest (value, updated_at) for an engine state blob, or None."""
+        best = None
+        for rec in self._iter_records():
+            if rec.get("_type") != "state" or rec.get("key") != key:
+                continue
+            if best is None or rec.get("updated_at", 0.0) >= best[1]:
+                best = (rec.get("value"), rec.get("updated_at", 0.0))
+        return best
+
 
 class EsArchive:
-    """Write-behind into ES-compatible REST indices (documents/hpalogs)."""
+    """Write-behind into ES-compatible REST indices (documents/hpalogs).
+
+    Engine state blobs go to a third index (`enginestate`) so they can
+    never pollute a documents search."""
 
     def __init__(self, endpoint: str, documents_index: str = "documents",
-                 hpalogs_index: str = "hpalogs", timeout: float = 5.0):
+                 hpalogs_index: str = "hpalogs",
+                 state_index: str = "enginestate", timeout: float = 5.0):
         self.endpoint = endpoint.rstrip("/")
         self.documents_index = documents_index
         self.hpalogs_index = hpalogs_index
+        self.state_index = state_index
         self.timeout = timeout
         self.errors = 0  # observability: archive is best-effort
 
@@ -194,6 +332,26 @@ class EsArchive:
             self.errors += 1
             return None
         return res.get("_source")
+
+    def index_state(self, key: str, value, updated_at: float) -> bool:
+        try:
+            self._req("PUT", f"/{self.state_index}/_doc/{key}",
+                      {"key": key, "value": value, "updated_at": updated_at})
+            return True
+        except Exception:  # noqa: BLE001
+            self.errors += 1
+            return False
+
+    def get_state(self, key: str):
+        try:
+            res = self._req("GET", f"/{self.state_index}/_doc/{key}")
+        except Exception:  # noqa: BLE001
+            self.errors += 1
+            return None
+        src = res.get("_source")
+        if not src:
+            return None
+        return (src.get("value"), src.get("updated_at", 0.0))
 
     def search(self, app=None, namespace=None, status=None, strategy=None,
                limit: int = 50) -> list[dict]:

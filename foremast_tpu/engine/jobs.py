@@ -115,7 +115,12 @@ class Document:
     modified_at: float = field(default_factory=time.time)
     lease_holder: str = ""
     lease_at: float = 0.0
-    archived_at: float = 0.0  # >0 once the archive confirmed the write
+    # archive freshness mark: the modified_at value of the last doc version
+    # the archive CONFIRMED holding. archived_at >= modified_at means the
+    # archive is up to date with this doc (used by gc() and the open-job
+    # mirror; the mark is the cut version's own stamp, never time.time(),
+    # so a concurrent modification can't make a stale record look fresh).
+    archived_at: float = 0.0
 
     def to_json(self) -> dict:
         # hand-rolled (not dataclasses.asdict, which recurses + deepcopies):
@@ -176,13 +181,22 @@ class JobStore:
     role in the reference; it never pruned, but it also wasn't RAM).
     """
 
-    def __init__(self, snapshot_path: str | None = None, archive=None):
+    def __init__(self, snapshot_path: str | None = None, archive=None,
+                 mirror_open: bool = True):
         self._lock = threading.RLock()
         self._jobs: dict[str, Document] = {}
         self._hpalogs: list[HpaLog] = []
         self._state: dict = {}  # engine-owned durable blobs (breath timers)
+        self._state_updated: dict = {}  # key -> local update stamp
+        self._state_archived: dict = {}  # key -> last stamp archived
         self._snapshot_path = snapshot_path
         self.archive = archive
+        # cross-replica failover (reference: ES as the shared lease medium,
+        # docs/guides/design.md:37-43): mirror OPEN jobs + engine state to
+        # the archive on the flush cadence so a replacement runtime can
+        # adopt a crashed peer's in-flight work (adopt_stale_from_archive)
+        self.mirror_open = mirror_open and archive is not None
+        self.adopted_total = 0  # observability: jobs adopted from peers
         self._dirty = False
         self._last_write = 0.0
         # background flusher: serialization/IO happen off the callers'
@@ -231,6 +245,7 @@ class JobStore:
                 doc.lease_holder = worker
                 doc.lease_at = doc.modified_at
             self._persist()
+            cut_modified = doc.modified_at
             archive_rec = (
                 doc.to_json()
                 if self.archive is not None and new_status in TERMINAL_STATUSES
@@ -240,7 +255,7 @@ class JobStore:
         # stall claim/create/status for every other worker and API thread.
         # Terminal docs never transition again, so the record is stable.
         if archive_rec is not None and self.archive.index_job(archive_rec):
-            doc.archived_at = time.time()
+            doc.archived_at = cut_modified
         return doc
 
     def claim_open_jobs(self, worker: str, limit: int = 1024,
@@ -339,14 +354,30 @@ class JobStore:
         """Persist a JSON-safe engine blob through the snapshot. The engine
         writes these at cycle boundaries (run_cycle ends with flush()), so
         restart-sensitive scoring state — HPA breath cooldowns — rides the
-        same durability path as the jobs themselves."""
+        same durability path as the jobs themselves (and, with an archive,
+        the cross-replica mirror: a replacement runtime inherits armed
+        breath timers through get_state's archive fallback)."""
         with self._lock:
             self._state[key] = value
+            self._state_updated[key] = time.time()
             self._persist()
 
     def get_state(self, key: str, default=None):
         with self._lock:
-            return self._state.get(key, default)
+            if key in self._state:
+                return self._state[key]
+        # fresh replacement runtime: fall back to the peer-mirrored blob
+        if self.archive is not None and hasattr(self.archive, "get_state"):
+            rec = self.archive.get_state(key)
+            if rec is not None:
+                value, stamp = rec
+                with self._lock:
+                    if key not in self._state:  # don't clobber a local write
+                        self._state[key] = value
+                        self._state_updated[key] = stamp
+                        self._state_archived[key] = stamp
+                    return self._state[key]
+        return default
 
     def gc(self, max_age_seconds: float = 24 * 3600.0,
            now: float | None = None) -> int:
@@ -369,10 +400,14 @@ class JobStore:
             ]
         dropped = 0
         for doc in candidates:  # archive I/O outside the lock
-            if doc.archived_at <= 0:
+            if doc.archived_at < doc.modified_at:
+                # the archive's record (if any) predates this version —
+                # e.g. an open-state mirror written before the terminal
+                # transition whose own archive write failed
+                cut_modified = doc.modified_at
                 if not self.archive.index_job(doc.to_json()):
                     continue  # archive unavailable: keep the job in RAM
-                doc.archived_at = time.time()
+                doc.archived_at = cut_modified
             with self._lock:
                 if self._jobs.get(doc.id) is doc:  # not re-created meanwhile
                     del self._jobs[doc.id]
@@ -483,9 +518,18 @@ class JobStore:
         shared .tmp path single-writer, and the sequence check drops a flush
         that lost the race to a newer one — os.replace()ing an older
         snapshot over a newer one would be a durability regression.
-        """
-        if not self._snapshot_path:
-            return
+
+        The archive mirror runs on every flush call regardless of snapshot
+        state: archive-dirtiness is tracked per doc (archived_at <
+        modified_at), not by the snapshot dirty bit, so capped or failed
+        mirror writes retry at the next cycle boundary even on snapshotless
+        stores."""
+        if self._snapshot_path:
+            self._try_snapshot()
+        self._mirror_to_archive()
+
+    def _try_snapshot(self) -> None:
+        """Write the snapshot if dirty."""
         with self._lock:
             if not self._dirty:
                 return
@@ -522,6 +566,94 @@ class JobStore:
             with self._lock:
                 self._dirty = True  # this payload never landed; don't lose it
             raise
+
+    _MIRROR_BATCH = 512  # open-doc archive writes per flush (bounds latency)
+
+    def _mirror_to_archive(self):
+        """Mirror changed OPEN jobs + engine state to the archive.
+
+        Runs on the flush cadence (bounded staleness like the snapshot,
+        both far inside the lease-takeover window), best-effort (a dead
+        archive must never fail a flush), and capped per flush; unwritten
+        docs stay archive-dirty (archived_at < modified_at) and go next
+        flush. This is the write half of cross-replica failover — the read
+        half is adopt_stale_from_archive()."""
+        if not self.mirror_open:
+            return
+        with self._lock:
+            cut = [
+                (doc, doc.to_json(), doc.modified_at)
+                for doc in self._jobs.values()
+                if doc.status in OPEN_STATUSES
+                and doc.archived_at < doc.modified_at
+            ][: self._MIRROR_BATCH]
+            state_cut = [
+                (k, self._state[k], self._state_updated.get(k, 0.0))
+                for k in self._state
+                if self._state_updated.get(k, 0.0)
+                > self._state_archived.get(k, 0.0)
+            ]
+        for doc, rec, cut_modified in cut:  # archive I/O outside the lock
+            if self.archive.index_job(rec):
+                # the cut version's own stamp: a doc modified mid-write
+                # keeps archived_at < modified_at and re-mirrors next flush
+                doc.archived_at = max(doc.archived_at, cut_modified)
+            else:
+                break  # archive down: retry the rest next flush
+        if hasattr(self.archive, "index_state"):
+            for key, value, stamp in state_cut:
+                if self.archive.index_state(key, value, stamp):
+                    with self._lock:
+                        self._state_archived[key] = max(
+                            self._state_archived.get(key, 0.0), stamp)
+
+    def adopt_stale_from_archive(self, worker: str = "",
+                                 max_stuck_seconds: float = 90.0,
+                                 limit: int = 1024,
+                                 now: float | None = None) -> int:
+        """Adopt open jobs a crashed/partitioned peer left in the archive.
+
+        The reference's failover medium is ES: any brain replica re-claims
+        jobs stuck past MAX_STUCK_IN_SECONDS (docs/guides/design.md:37-43,
+        elasticsearchstore.go:155 ByStatus "used by backend python model").
+        Here the shared archive plays that role: open-job records mirrored
+        by peers (see _mirror_to_archive) whose lease stamp has gone stale
+        are pulled into the local store; the normal claim_open_jobs lease
+        steal then reprocesses them. Like the reference, takeover is
+        optimistic — a live-but-slow peer's job can be double-scored;
+        verdict writes are last-write-wins per id, so that is harmless.
+
+        Returns the number of jobs adopted."""
+        if self.archive is None:
+            return 0
+        now = time.time() if now is None else now
+        adopted = 0
+        for rec in self.archive.search(status=list(OPEN_STATUSES),
+                                       limit=limit):
+            rec = {k: v for k, v in rec.items() if k != "_type"}
+            try:
+                doc = Document.from_json(rec)
+            except (TypeError, ValueError):
+                continue  # malformed/foreign record: not adoptable
+            if now - max(doc.lease_at, doc.modified_at) <= max_stuck_seconds:
+                continue  # the owner is (or was recently) alive
+            with self._lock:
+                cur = self._jobs.get(doc.id)
+                if cur is not None and (
+                    cur.status in OPEN_STATUSES
+                    or cur.modified_at >= doc.modified_at
+                ):
+                    continue  # we hold it, or our copy is newer
+                doc.archived_at = doc.modified_at  # archive holds this version
+                if worker:
+                    # record who adopted it; lease_at stays STALE so the
+                    # next claim_open_jobs steal proceeds normally
+                    doc.lease_holder = worker
+                self._jobs[doc.id] = doc
+                self.adopted_total += 1
+                adopted += 1
+                self._persist()
+        return adopted
 
     def close(self):
         """Final flush + stop the background flusher (idempotent)."""

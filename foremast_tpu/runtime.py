@@ -16,6 +16,9 @@ Env surface (union of the reference services'):
   ARCHIVE_PATH           JSONL write-behind archive of terminal jobs/hpalogs
   ES_ENDPOINT            ES-compatible archive instead (reference indices
                          documents/hpalogs); takes precedence over ARCHIVE_PATH
+  ARCHIVE_ADOPT_INTERVAL seconds between scans of the shared archive for a
+                         crashed peer's stale open jobs (cross-replica
+                         failover, reference design.md:37-43; 0 disables)
   JOB_RETENTION_SECONDS  prune archived terminal jobs from RAM after this
   PORT                   HTTP port (reference :8099)
   GRPC_PORT              gRPC dispatch port (0/unset disables; 8100 in the
@@ -58,6 +61,7 @@ class Runtime:
         wavefront_sink=None,
         archive=None,
         job_retention_seconds: float = 24 * 3600.0,
+        adopt_interval_seconds: float = 30.0,
     ):
         self.config = config or from_env()
         source = data_source or PrometheusDataSource()
@@ -66,6 +70,11 @@ class Runtime:
         self.source = source
         self.store = JobStore(snapshot_path=snapshot_path, archive=archive)
         self.job_retention_seconds = job_retention_seconds
+        # cross-replica failover cadence: how often to scan the shared
+        # archive for a crashed peer's stale open jobs (0 disables; the
+        # archive scan is not free, so it is NOT every cycle)
+        self.adopt_interval_seconds = adopt_interval_seconds
+        self._last_adopt = 0.0
         self.exporter = VerdictExporter()
         self.analyzer = Analyzer(
             self.config, self.source, self.store, exporter=self.exporter
@@ -121,6 +130,17 @@ class Runtime:
         while not self._stop.is_set():
             t0 = time.time()
             try:
+                if (self.adopt_interval_seconds > 0
+                        and self.store.archive is not None
+                        and t0 - self._last_adopt >= self.adopt_interval_seconds):
+                    self._last_adopt = t0
+                    n = self.store.adopt_stale_from_archive(
+                        worker=worker,
+                        max_stuck_seconds=self.config.max_stuck_seconds,
+                    )
+                    if n:
+                        print(f"[foremast-tpu] adopted {n} stale job(s) "
+                              f"from the archive", flush=True)
                 self.analyzer.run_cycle(worker=worker)
                 if self.wavefront_sink is not None:
                     self.wavefront_sink.flush()
@@ -203,6 +223,7 @@ def main():
         query_endpoint=os.environ.get("QUERY_SERVICE_ENDPOINT", ""),
         archive=archive,
         job_retention_seconds=_env_seconds("JOB_RETENTION_SECONDS", 24 * 3600.0),
+        adopt_interval_seconds=_env_seconds("ARCHIVE_ADOPT_INTERVAL", 30.0),
     )
     proxy = os.environ.get("WAVEFRONT_PROXY", "")
     if proxy:

@@ -335,6 +335,9 @@ class ForemastService:
                 "foremast_archive_errors "
                 f"{getattr(self.store.archive, 'errors', 0)}"
             )
+            lines.append(
+                f"foremast_jobs_adopted_total {self.store.adopted_total}"
+            )
         if self.http_shed_count is not None:
             lines.append(f"foremast_http_shed_total {self.http_shed_count()}")
         self_gauges = "\n".join(lines) + "\n"

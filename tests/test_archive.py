@@ -72,9 +72,10 @@ def test_sustained_rotation_falls_back_to_locked_scan(tmp_path):
     a = FileArchive(str(tmp_path / "arch.jsonl"))
     a.index_job({"id": "x", "app_name": "a", "namespace": "d",
                  "status": "completed_health", "modified_at": 1.0})
-    # simulate an inode changing under every scan attempt
-    inodes = iter(range(100))
-    a._current_inode = lambda: next(inodes)
+    # simulate a compaction landing under every scan attempt (the ".1"
+    # generation's inode keeps changing)
+    sigs = iter((i, 0) for i in range(100))
+    a._mutation_sig = lambda: next(sigs)
     res = a.search()
     assert [r["id"] for r in res] == ["x"], "fallback scan must be complete"
     assert a.locked_scan_fallbacks == 1
@@ -160,8 +161,10 @@ def test_gc_keeps_jobs_when_archive_write_fails(tmp_path):
 
     store = JobStore(archive=DownArchive())
     _doc(1, TERMINAL_CHAIN, store)
-    store.get("j1").modified_at = 0.0
-    store.get("j1").archived_at = 0.0  # pretend the write-behind failed too
+    # aged out, and the archive holds NO version of this doc (freshness
+    # mark predates the last modification = write-behind failed)
+    store.get("j1").modified_at = 5.0
+    store.get("j1").archived_at = 0.0
     assert store.gc(max_age_seconds=1, now=1e9) == 0
     assert store.get("j1") is not None  # never dropped without a record
 

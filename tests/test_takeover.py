@@ -1,0 +1,269 @@
+"""Cross-replica failover through the shared archive.
+
+The reference's brain replicas are shared-nothing EXCEPT for ES: any
+replica re-claims jobs stuck past MAX_STUCK_IN_SECONDS from the shared
+store (docs/guides/design.md:37-43; elasticsearchstore.go:155 ByStatus
+"used by backend python model"). Here the pluggable archive plays ES's
+role: open jobs + lease stamps mirror to it on the flush cadence, and
+`JobStore.adopt_stale_from_archive` lets a replacement runtime pull a
+crashed peer's in-flight work. The flagship test below is the verdict's
+acceptance shape: kill -9 one runtime mid-job, a peer completes it
+within the stuck window — two real OS processes, one shared archive.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.archive import FileArchive
+from foremast_tpu.engine.jobs import Document, JobStore, MetricQueries
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+
+def _doc(job_id="j1", status_time=0.0):
+    return Document(
+        id=job_id, app_name="a", namespace="d", strategy="canary",
+        start_time=to_rfc3339(0), end_time=to_rfc3339(status_time),
+        metrics={"error5xx": MetricQueries(current="cu", baseline="bu")},
+    )
+
+
+# ------------------------------------------------------- archive semantics
+def test_file_archive_search_sees_only_latest_state(tmp_path):
+    """Status filters must see each job's LATEST record (ES overwrite
+    semantics) — filtering before dedupe would resurrect a completed
+    job's earlier open-status record and re-adopt finished work."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    ar.index_job({"id": "x", "status": J.INITIAL, "modified_at": 1.0})
+    ar.index_job({"id": "x", "status": J.COMPLETED_HEALTH, "modified_at": 2.0})
+    assert ar.search(status=list(J.OPEN_STATUSES)) == []
+    got = ar.search(status=J.COMPLETED_HEALTH)
+    assert len(got) == 1 and got[0]["modified_at"] == 2.0
+
+
+def test_file_archive_state_roundtrip(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    assert ar.get_state("breath") is None
+    ar.index_state("breath", {"job": 1}, 10.0)
+    ar.index_state("breath", {"job": 2}, 20.0)
+    assert ar.get_state("breath") == ({"job": 2}, 20.0)
+
+
+# --------------------------------------------------------- mirror + adopt
+def test_open_jobs_mirror_to_archive_on_flush(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    store = JobStore(archive=ar)
+    store.create(_doc())
+    store.claim_open_jobs("w1", max_stuck_seconds=90)
+    store.flush()
+    recs = ar.search(status=list(J.OPEN_STATUSES))
+    assert len(recs) == 1
+    assert recs[0]["lease_holder"] == "w1"
+    assert recs[0]["status"] == J.PREPROCESS_INPROGRESS
+
+
+def test_adopt_stale_job_then_complete(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    a = JobStore(archive=ar)
+    a.create(_doc())
+    a.claim_open_jobs("w1", max_stuck_seconds=90)
+    a.flush()
+
+    b = JobStore(archive=ar)
+    # fresh lease: the owner is alive, nothing to adopt
+    assert b.adopt_stale_from_archive(max_stuck_seconds=90) == 0
+    # lease gone stale (peer crashed): adopted and re-claimable
+    assert b.adopt_stale_from_archive(max_stuck_seconds=90,
+                                      now=time.time() + 1000) == 1
+    assert b.adopted_total == 1
+    got = b.claim_open_jobs("w2", max_stuck_seconds=1e-9)
+    assert [d.id for d in got] == ["j1"]
+    b.transition("j1", J.PREPROCESS_COMPLETED, worker="w2")
+    b.transition("j1", J.POSTPROCESS_INPROGRESS, worker="w2")
+    b.transition("j1", J.COMPLETED_HEALTH, worker="w2")
+    # the archive's latest record is terminal now: nobody re-adopts it
+    c = JobStore(archive=ar)
+    assert c.adopt_stale_from_archive(max_stuck_seconds=90,
+                                      now=time.time() + 2000) == 0
+
+
+def test_adopt_never_clobbers_newer_local_state(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    a = JobStore(archive=ar)
+    a.create(_doc())
+    a.claim_open_jobs("w1", max_stuck_seconds=90)
+    a.flush()
+    # the same store completed the job AFTER the open mirror; a later
+    # adopt scan must not resurrect the open record over the terminal one
+    a.transition("j1", J.PREPROCESS_COMPLETED, worker="w1")
+    a.transition("j1", J.POSTPROCESS_INPROGRESS, worker="w1")
+    a.transition("j1", J.COMPLETED_UNHEALTH, worker="w1", reason="bad")
+    assert a.adopt_stale_from_archive(max_stuck_seconds=90,
+                                      now=time.time() + 1000) == 0
+    assert a.get("j1").status == J.COMPLETED_UNHEALTH
+
+
+def test_breath_state_rides_the_archive(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    a = JobStore(archive=ar)
+    a.put_state("breath", {"app:ns:hpa": {"armed": True}})
+    a.flush()
+    b = JobStore(archive=ar)  # replacement runtime, no snapshot
+    assert b.get_state("breath") == {"app:ns:hpa": {"armed": True}}
+    # a local write wins over the archived copy afterwards
+    b.put_state("breath", {"app:ns:hpa": {"armed": False}})
+    assert b.get_state("breath") == {"app:ns:hpa": {"armed": False}}
+
+
+# ---------------------------------------------- two-process kill -9 e2e
+_CHILD_A = r"""
+import sys, time
+import numpy as np
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.archive import FileArchive
+from foremast_tpu.engine.jobs import Document, JobStore, MetricQueries
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+store = JobStore(archive=FileArchive(sys.argv[1]))
+store.create(Document(
+    id="flagship", app_name="app", namespace="demo", strategy="canary",
+    start_time=to_rfc3339(0.0), end_time=to_rfc3339(0.0),
+    metrics={"error5xx": MetricQueries(current="http://prom/cur",
+                                       baseline="http://prom/base")},
+))
+claimed = store.claim_open_jobs("runtime-A", max_stuck_seconds=90)
+assert [d.id for d in claimed] == ["flagship"]
+store.flush()  # open job + lease stamp reach the shared archive
+print("READY", flush=True)
+time.sleep(300)  # wedged mid-job until kill -9
+"""
+
+_CHILD_B = r"""
+import sys, time
+import numpy as np
+from foremast_tpu.dataplane import FixtureDataSource
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.analyzer import Analyzer
+from foremast_tpu.engine.archive import FileArchive
+from foremast_tpu.engine.config import EngineConfig
+from foremast_tpu.engine.jobs import JobStore
+
+MAX_STUCK = 2.0
+rng = np.random.default_rng(0)
+ts = (np.arange(30) * 60.0).tolist()
+fixtures = {
+    "http://prom/cur": (ts, rng.normal(5.0, 0.5, 30).tolist()),   # bad canary
+    "http://prom/base": (ts, rng.normal(0.5, 0.05, 30).tolist()),
+}
+store = JobStore(archive=FileArchive(sys.argv[1]))
+eng = Analyzer(EngineConfig(max_stuck_seconds=MAX_STUCK,
+                            pairwise_threshold=1e-4),
+               FixtureDataSource(fixtures), store)
+t0 = time.time()
+while time.time() - t0 < 30.0:
+    store.adopt_stale_from_archive(worker="runtime-B",
+                                   max_stuck_seconds=MAX_STUCK)
+    eng.run_cycle(worker="runtime-B", now=10_000.0)
+    doc = store.get("flagship")
+    if doc is not None and doc.status in J.TERMINAL_STATUSES:
+        print("TERMINAL", doc.status, round(time.time() - t0, 2), flush=True)
+        sys.exit(0)
+    time.sleep(0.2)
+print("TIMEOUT", flush=True)
+sys.exit(1)
+"""
+
+
+def test_kill9_runtime_peer_completes_job_within_stuck_window(tmp_path):
+    """Verdict r3 #6 acceptance: runtime A claims a job and dies (kill -9,
+    no shutdown flush beyond the mirror it already did); replacement
+    runtime B adopts the job from the shared archive once the lease goes
+    stale and drives it to a verdict within the stuck window."""
+    archive_path = str(tmp_path / "shared.jsonl")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    a = subprocess.Popen([sys.executable, "-c", _CHILD_A, archive_path],
+                         stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = a.stdout.readline()
+        assert line.strip() == "READY", line
+        os.kill(a.pid, signal.SIGKILL)  # mid-job, no clean shutdown
+        a.wait(timeout=10)
+
+        t0 = time.time()
+        out = subprocess.run(
+            [sys.executable, "-c", _CHILD_B, archive_path],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert out.returncode == 0, (out.stdout, out.stderr[-800:])
+        fields = out.stdout.split()
+        assert fields[0] == "TERMINAL" and fields[1] == J.COMPLETED_UNHEALTH, out.stdout
+        # "within MAX_STUCK_IN_SECONDS": B's takeover latency is bounded
+        # by the stuck window (2 s) + one adopt/cycle lap, not by a human
+        assert time.time() - t0 < 60.0
+    finally:
+        if a.poll() is None:
+            a.kill()
+    # the shared archive's final word on the job is the terminal verdict
+    ar = FileArchive(archive_path)
+    assert ar.search(status=list(J.OPEN_STATUSES)) == []
+    final = ar.get("flagship")
+    assert final is not None and final["status"] == J.COMPLETED_UNHEALTH
+
+
+# ------------------------------------------------- compaction + multi-writer
+def test_file_archive_compaction_preserves_terminal_records(tmp_path):
+    """Open-job mirror churn must never rotate a terminal verdict away:
+    gc() trusts the archive to hold it. Compaction keeps the latest
+    record per id, so size tracks job count, not write rate."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"), max_bytes=4096)
+    ar.index_job({"id": "done", "status": J.COMPLETED_UNHEALTH,
+                  "modified_at": 1.0, "reason": "bad"})
+    # churn: one open job re-mirrored far past the rotation threshold
+    for i in range(200):
+        ar.index_job({"id": "busy", "status": J.INITIAL,
+                      "modified_at": 2.0 + i, "pad": "x" * 64})
+    assert ar.compactions >= 1
+    final = ar.get("done")
+    assert final is not None and final["status"] == J.COMPLETED_UNHEALTH
+    busy = ar.get("busy")
+    assert busy is not None and busy["modified_at"] == 201.0
+    # compacted steady state: 2 jobs, so both generations stay small
+    total = sum(os.path.getsize(str(tmp_path / "ar.jsonl") + s)
+                for s in ("", ".1") if os.path.exists(str(tmp_path / "ar.jsonl") + s))
+    assert total < 16 * 1024, total
+
+
+def test_file_archive_state_survives_compaction(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"), max_bytes=2048)
+    ar.index_state("breath", {"v": 1}, 10.0)
+    for i in range(100):
+        ar.index_job({"id": "busy", "status": J.INITIAL,
+                      "modified_at": float(i), "pad": "y" * 64})
+    assert ar.compactions >= 1
+    assert ar.get_state("breath") == ({"v": 1}, 10.0)
+
+
+def test_stale_open_record_cannot_shadow_newer_terminal(tmp_path):
+    """Multi-writer ordering hazard: a wedged peer appends its stale open
+    record AFTER another replica's terminal one. Dedupe is by the
+    record's own modified_at, not append order, so the terminal record
+    wins and the job is never re-adopted."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    ar.index_job({"id": "j", "status": J.COMPLETED_HEALTH,
+                  "modified_at": 100.0})
+    ar.index_job({"id": "j", "status": J.PREPROCESS_INPROGRESS,
+                  "modified_at": 50.0, "lease_at": 50.0})  # late stale append
+    assert ar.search(status=list(J.OPEN_STATUSES)) == []
+    assert ar.get("j")["status"] == J.COMPLETED_HEALTH
+    b = JobStore(archive=ar)
+    assert b.adopt_stale_from_archive(max_stuck_seconds=1,
+                                      now=time.time() + 1000) == 0
