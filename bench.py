@@ -135,6 +135,29 @@ def _cycle_bench() -> dict:
     # native parser) — per-family score decomposition and the bounded
     # LSTM train-on-miss cost (VERDICT r3 #3). The pure-pair legs above
     # stay as the round-over-round continuity numbers.
+    # fourth leg: the 8-device virtual-mesh reduction share (VERDICT r3
+    # #7) — time the sharded fleet program with and without its
+    # psum/all_gather top-k tail; turns "validated, not timed" into a
+    # measured fraction (bench_mesh.py documents the CPU-mesh caveats).
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    mrec, merr = _run_json_child(
+        [sys.executable, "-m", "foremast_tpu.bench_mesh"],
+        timeout_s=600, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if mrec is not None:
+        for k_ in ("value", "with_reduction_s", "score_only_s",
+                   "noise_floor_s", "overhead_below_noise",
+                   "reduction_share_cpu_mesh", "share_vs_device_scoring_est"):
+            extra[f"mesh_{k_}" if k_ != "value"
+                  else "mesh_reduction_overhead_s"] = mrec.get(k_)
+    else:
+        extra["mesh_error"] = merr
+
     rec, err = run_child("1", mix=True)
     if rec is not None:
         extra["cycle_mixed_jobs_per_sec"] = rec["value"]
@@ -238,6 +261,93 @@ def _digest_fields(key: str, value: float) -> dict:
     if math.isfinite(value):
         return {key: value}
     return {key: None, f"{key}_error": f"non-finite digest: {value!r}"}
+
+
+def _long_window_fields() -> dict:
+    """Long-window leg: the 7-day historical shapes (VERDICT r3 #4).
+
+    The reference's historical model runs on ~10,080-point windows
+    (metricsquery.go:93-99) — where `lax.scan` serialization and the
+    60-candidate Holt-Winters grid actually bite, none of which the
+    T=128 headline exercises. Three measurements, forced completion:
+
+      * p50/p99 for a B-job moving-average BAND batch at T=10,080
+        (predict + sigma + anomalies — the production band path);
+      * sequential vs associative-scan SES at the same shape — the
+        LONG_WINDOW_STEPS switch's justification, measured;
+      * the Holt-Winters grid fit (60 candidates via lax.map) at a
+        daily period on a smaller batch (its cost scales with G*B*T).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from foremast_tpu.ops import forecast as fc
+    from foremast_tpu.ops import seqscan as sq
+
+    T = int(os.environ.get("BENCH_LONG_WINDOW", "10080"))
+    B = int(os.environ.get("BENCH_LONG_BATCH", "256"))
+    B_HW = max(B // 8, 1)
+    n_runs = int(os.environ.get("BENCH_LONG_RUNS", "30"))
+
+    rng = np.random.default_rng(1)
+    x = np.cumsum(rng.normal(0, 0.2, (B, T)), axis=-1).astype(np.float32) + 50.0
+    m = rng.random((B, T)) > 0.05
+    region = np.zeros((B, T), bool)
+    region[:, -30:] = True  # judged current window: the last 30 min
+    alphas = np.full(B, 0.3, np.float32)
+    thr = np.full(B, 3.0, np.float32)
+    bound = np.zeros(B, np.int32)
+    mlb = np.zeros(B, np.float32)
+    xd, md, rd = jax.device_put(x), jax.device_put(m), jax.device_put(region)
+
+    @jax.jit
+    def band_fn(xv, xm, reg):
+        hist = xm & ~reg
+        preds = jax.vmap(fc._moving_average_1d, in_axes=(0, 0, None))(
+            xv, hist, 30)
+        sigma = fc.residual_sigma(xv, preds, hist, ~reg)
+        out = fc.band_anomalies(xv, xm, reg, preds, sigma, thr, bound, mlb)
+        return jax.tree.reduce(
+            lambda a, b: a + b.sum().astype(jnp.float32), out, jnp.float32(0))
+
+    def timed(fn, runs):
+        fn()  # compile + warm
+        ts = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        ts = np.sort(np.asarray(ts))
+        return {"p50": float(np.median(ts)),
+                "p99": float(np.percentile(ts, 99))}
+
+    out: dict = {"long_window": T, "long_batch": B}
+    band = timed(lambda: float(band_fn(xd, md, rd)), n_runs)
+    out["long_band_p99_s"] = round(band["p99"], 6)
+    out["long_band_p50_s"] = round(band["p50"], 6)
+
+    hist_mask = md & ~rd
+    seq = jax.jit(lambda: fc.ses_predictions(xd, hist_mask, alphas).sum())
+    assoc = jax.jit(
+        lambda: sq.ses_predictions_assoc(xd, hist_mask, alphas).sum())
+    seq_t = timed(lambda: float(seq()), max(n_runs // 3, 5))
+    assoc_t = timed(lambda: float(assoc()), max(n_runs // 3, 5))
+    out["long_ses_sequential_p50_s"] = round(seq_t["p50"], 6)
+    out["long_ses_assoc_p50_s"] = round(assoc_t["p50"], 6)
+    out["long_ses_assoc_speedup"] = round(
+        seq_t["p50"] / max(assoc_t["p50"], 1e-9), 2)
+
+    period = min(1440, T // 2)
+    fitm = np.asarray(hist_mask).copy()
+    fitm[:, : 2 * period] = False
+    xh, mh, fh = (jax.device_put(a[:B_HW]) for a in
+                  (x, np.asarray(hist_mask), fitm))
+    hw = jax.jit(
+        lambda: fc.fit_holt_winters(xh, mh, fh, period)[1].sum())
+    hw_t = timed(lambda: float(hw()), max(n_runs // 6, 3))
+    out["long_hw_fit_p50_s"] = round(hw_t["p50"], 6)
+    out["long_hw_batch"] = B_HW
+    return out
 
 
 def _device_fields() -> dict:
@@ -346,6 +456,9 @@ def main() -> None:
     if "--device-only" in sys.argv:
         print(json.dumps(_device_fields()))
         return
+    if "--long-only" in sys.argv:
+        print(json.dumps(_long_window_fields()))
+        return
 
     # parse the deadlines FIRST: a malformed env var must not throw away
     # a 15-minute cycle bench later, outside the degrade path
@@ -387,6 +500,23 @@ def main() -> None:
             )
         if device is None:
             device = {"value": 0.0, "vs_baseline": 0.0, "device_error": err}
+        elif os.environ.get("BENCH_SKIP_LONG", "0").strip().lower() in (
+                "1", "true", "yes", "on"):
+            device["long_window_skipped"] = True
+        else:
+            # the 7-day-window leg gets its OWN child + deadline: 10k-step
+            # scan compiles are slow through the axon remote-compile
+            # tunnel, and a long-leg death must not cost the headline
+            # artifact already in hand
+            long_rec, long_err = _run_json_child(
+                [sys.executable, os.path.abspath(__file__), "--long-only"],
+                timeout_s=_env_float("BENCH_LONG_TIMEOUT", 600.0),
+                env=child_env,
+            )
+            if long_rec is not None:
+                device.update(long_rec)
+            else:
+                device["long_window_error"] = long_err
     else:
         device = {
             "value": 0.0, "vs_baseline": 0.0,

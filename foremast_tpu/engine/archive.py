@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import urllib.error
 import urllib.request
 
 try:
@@ -32,6 +33,13 @@ except ImportError:  # Windows: no flock; single-process archives only
     fcntl = None
 
 __all__ = ["FileArchive", "EsArchive"]
+
+# jobs.py's TERMINAL_STATUSES, duplicated here because jobs.py imports
+# from this module (tests pin the two sets against drift)
+_TERMINAL = frozenset((
+    "completed_health", "completed_unhealth", "completed_unknown",
+    "preprocess_failed", "abort",
+))
 
 
 def _statuses(status) -> list | None:
@@ -73,10 +81,17 @@ class FileArchive:
     """
 
     def __init__(self, path: str, max_bytes: int = 64 * 1024 * 1024,
-                 keep_hpalogs: int = 1000):
+                 keep_hpalogs: int = 1000,
+                 keep_terminal_seconds: float = 30 * 86400.0):
         self.path = path
         self.max_bytes = max_bytes
         self.keep_hpalogs = keep_hpalogs
+        # compaction retention for TERMINAL documents: without an age
+        # bound, unique per-rollout job ids accumulate forever and every
+        # compaction rewrites the whole history under the flock. Open
+        # records are never aged (they are adoptable state, bounded by
+        # fleet size); state blobs are last-per-key.
+        self.keep_terminal_seconds = keep_terminal_seconds
         self._lock = threading.Lock()
         # times a lock-free scan exhausted its rescans and fell back to a
         # locked scan (sustained-rotation churn); exposed for observability
@@ -140,7 +155,12 @@ class FileArchive:
     def _compact_locked(self):
         """Merge both generations into `.1`, last-write-wins (caller holds
         the mutation lock, so no concurrent append can slip between the
-        copy and the truncation)."""
+        copy and the truncation). Terminal documents age out past
+        keep_terminal_seconds so the compacted size tracks the LIVE job
+        count, not deployment history."""
+        import time as _time
+
+        horizon = _time.time() - self.keep_terminal_seconds
         docs: dict[str, dict] = {}
         states: dict[str, dict] = {}
         hpalogs: list[dict] = []
@@ -160,9 +180,14 @@ class FileArchive:
                 hpalogs.append(rec)
         hpalogs.sort(key=lambda r: r.get("timestamp", 0.0))
         hpalogs = hpalogs[-self.keep_hpalogs:]
+        keep_docs = [
+            rec for rec in docs.values()
+            if rec.get("status") not in _TERMINAL
+            or rec.get("modified_at", 0.0) >= horizon
+        ]
         tmp = self.path + ".1.tmp"
         with open(tmp, "w") as f:
-            for rec in (*docs.values(), *states.values(), *hpalogs):
+            for rec in (*keep_docs, *states.values(), *hpalogs):
                 f.write(json.dumps(rec, separators=(",", ":")) + "\n")
         os.replace(tmp, self.path + ".1")
         # truncate the active file (its records now live compacted in .1)
@@ -240,9 +265,13 @@ class FileArchive:
         return (ino1, size)
 
     def search(self, app=None, namespace=None, status=None, strategy=None,
-               limit: int = 50) -> list[dict]:
-        """Latest record per job id (by its own modified_at), newest
-        first, capped.
+               limit: int = 50, oldest_first: bool = False) -> list[dict]:
+        """Latest record per job id (by its own modified_at), capped.
+
+        Sorted newest-first for humans; `oldest_first=True` for the
+        adoption scan — a crashed peer's stuck jobs have the OLDEST
+        stamps, so a newest-first cap at fleet scale would cut exactly
+        the records failover exists to find.
 
         Dedupe happens BEFORE filtering, so a status filter sees only each
         job's LATEST archived state — the same semantics as ES, where a PUT
@@ -265,7 +294,8 @@ class FileArchive:
             rec for rec in by_id.values()
             if _match(rec, app, namespace, status, strategy)
         ]
-        out.sort(key=lambda r: r.get("modified_at", 0.0), reverse=True)
+        out.sort(key=lambda r: r.get("modified_at", 0.0),
+                 reverse=not oldest_first)
         return out[:limit]
 
     # -- engine state blobs (breath cooldowns): last-writer-wins by stamp --
@@ -310,9 +340,25 @@ class EsArchive:
             return json.loads(r.read() or b"{}")
 
     def index_job(self, doc: dict) -> bool:
+        # external versioning by the doc's own modified_at: a recovered
+        # wedged peer's STALE open mirror must not overwrite a newer
+        # terminal record another replica already wrote (ES rejects
+        # version <= existing with 409 — which means the archive already
+        # holds something at least as new: success for our contract)
+        version = int(doc.get("modified_at", 0.0) * 1_000_000)
         try:
-            self._req("PUT", f"/{self.documents_index}/_doc/{doc['id']}", doc)
+            self._req(
+                "PUT",
+                f"/{self.documents_index}/_doc/{doc['id']}"
+                f"?version_type=external_gte&version={version}",
+                doc,
+            )
             return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return True  # archive already newer: record is safe
+            self.errors += 1
+            return False
         except Exception:  # noqa: BLE001 - never fail a verdict on archive IO
             self.errors += 1
             return False
@@ -334,10 +380,20 @@ class EsArchive:
         return res.get("_source")
 
     def index_state(self, key: str, value, updated_at: float) -> bool:
+        version = int(updated_at * 1_000_000)
         try:
-            self._req("PUT", f"/{self.state_index}/_doc/{key}",
-                      {"key": key, "value": value, "updated_at": updated_at})
+            self._req(
+                "PUT",
+                f"/{self.state_index}/_doc/{key}"
+                f"?version_type=external_gte&version={version}",
+                {"key": key, "value": value, "updated_at": updated_at},
+            )
             return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return True  # a newer state blob is already archived
+            self.errors += 1
+            return False
         except Exception:  # noqa: BLE001
             self.errors += 1
             return False
@@ -354,7 +410,7 @@ class EsArchive:
         return (src.get("value"), src.get("updated_at", 0.0))
 
     def search(self, app=None, namespace=None, status=None, strategy=None,
-               limit: int = 50) -> list[dict]:
+               limit: int = 50, oldest_first: bool = False) -> list[dict]:
         terms = []
         for field_name, v in (("app_name", app), ("namespace", namespace),
                               ("strategy", strategy)):
@@ -364,12 +420,15 @@ class EsArchive:
         if statuses is not None:
             terms.append({"terms": {"status.keyword": statuses}})
         query = {"bool": {"must": terms}} if terms else {"match_all": {}}
+        # oldest_first: the adoption scan wants the STALEST records — a
+        # newest-first cap would cut a crashed peer's stuck jobs first
+        order = "asc" if oldest_first else "desc"
         try:
             res = self._req(
                 "POST",
                 f"/{self.documents_index}/_search",
                 {"query": query, "size": limit,
-                 "sort": [{"modified_at": "desc"}]},
+                 "sort": [{"modified_at": order}]},
             )
         except Exception:  # noqa: BLE001
             self.errors += 1

@@ -581,11 +581,15 @@ class JobStore:
         if not self.mirror_open:
             return
         with self._lock:
+            # ANY archive-dirty doc, not just open ones: a terminal whose
+            # transition-time archive write failed must retry HERE (next
+            # flush), not wait for gc's retention window — until the
+            # terminal record lands, the archive's newest state for the
+            # job is a stale open mirror that peers would adopt
             cut = [
                 (doc, doc.to_json(), doc.modified_at)
                 for doc in self._jobs.values()
-                if doc.status in OPEN_STATUSES
-                and doc.archived_at < doc.modified_at
+                if doc.archived_at < doc.modified_at
             ][: self._MIRROR_BATCH]
             state_cut = [
                 (k, self._state[k], self._state_updated.get(k, 0.0))
@@ -628,8 +632,10 @@ class JobStore:
             return 0
         now = time.time() if now is None else now
         adopted = 0
+        # oldest_first: stale jobs have the OLDEST stamps; a newest-first
+        # cap at fleet scale would return only the healthy churn
         for rec in self.archive.search(status=list(OPEN_STATUSES),
-                                       limit=limit):
+                                       limit=limit, oldest_first=True):
             rec = {k: v for k, v in rec.items() if k != "_type"}
             try:
                 doc = Document.from_json(rec)

@@ -244,8 +244,11 @@ def test_es_archive_requests_and_error_tolerance(monkeypatch):
     a.index_hpalog({"job_id": "j1"})
     res = a.search(app="a", status="completed_health")
     assert res == [{"id": "j1", "app_name": "a"}]
-    methods_paths = [(m, p) for m, p, _ in calls]
+    methods_paths = [(m, p.split("?")[0]) for m, p, _ in calls]
     assert ("PUT", "/documents/_doc/j1") in methods_paths
+    # the PUT carries external_gte versioning (stale-write protection)
+    put_q = [p for m, p, _ in calls if m == "PUT"][0]
+    assert "version_type=external_gte" in put_q
     assert ("POST", "/hpalogs/_doc") in methods_paths
     (_, _, search_body) = calls[-1]
     assert {"term": {"app_name.keyword": "a"}} in search_body["query"]["bool"]["must"]
@@ -316,6 +319,9 @@ class _FakeEs:
         import threading as _th
 
         self.docs: dict[str, dict] = {}
+        self.versions: dict[str, int] = {}  # external_gte enforcement
+        self.states: dict[str, dict] = {}
+        self.state_versions: dict[str, int] = {}
         self.hpalogs: list[dict] = []
         outer = self
 
@@ -340,11 +346,27 @@ class _FakeEs:
                 self.wfile.write(raw)
 
             def do_PUT(self):
-                parts = self.path.strip("/").split("/")
+                from urllib.parse import parse_qs, urlparse
+
+                u = urlparse(self.path)
+                parts = u.path.strip("/").split("/")
+                q = parse_qs(u.query)
+                version = int(q.get("version", ["0"])[0])
+                vtype = q.get("version_type", [""])[0]
                 if parts[:2] == ["documents", "_doc"]:
-                    outer.docs[parts[2]] = self._body()
-                    return self._send(200, {"result": "created"})
-                self._send(404, {})
+                    store, vers, key = outer.docs, outer.versions, parts[2]
+                elif parts[:2] == ["enginestate", "_doc"]:
+                    store, vers, key = (outer.states, outer.state_versions,
+                                        parts[2])
+                else:
+                    return self._send(404, {})
+                # real ES external_gte: reject strictly-older versions
+                if vtype == "external_gte" and version < vers.get(key, -1):
+                    return self._send(409, {"error": "version_conflict"})
+                store[key] = self._body()
+                if vtype == "external_gte":
+                    vers[key] = version
+                return self._send(200, {"result": "created"})
 
             def do_POST(self):
                 parts = self.path.strip("/").split("/")
@@ -362,10 +384,13 @@ class _FakeEs:
                 parts = self.path.strip("/").split("/")
                 if parts[:2] == ["documents", "_doc"]:
                     doc = outer.docs.get(parts[2])
-                    if doc is None:
-                        return self._send(404, {"found": False})
-                    return self._send(200, {"found": True, "_source": doc})
-                self._send(404, {})
+                elif parts[:2] == ["enginestate", "_doc"]:
+                    doc = outer.states.get(parts[2])
+                else:
+                    return self._send(404, {})
+                if doc is None:
+                    return self._send(404, {"found": False})
+                return self._send(200, {"found": True, "_source": doc})
 
         self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
         self.port = self.server.server_address[1]
@@ -383,7 +408,11 @@ class _FakeEs:
                 [(field, vs)] = clause["terms"].items()
                 field = field.removesuffix(".keyword")
                 out = [d for d in out if d.get(field) in vs]
-        out.sort(key=lambda d: -d.get("modified_at", 0))
+        sort = q.get("sort", [{"modified_at": "desc"}])
+        order = list(sort[0].values())[0]
+        order = order if isinstance(order, str) else order.get("order", "desc")
+        out.sort(key=lambda d: d.get("modified_at", 0),
+                 reverse=(order == "desc"))
         return out[: q.get("size", 10)]
 
     def close(self):
@@ -434,3 +463,71 @@ def test_jobstore_archives_terminal_to_es_and_gc_prunes():
         assert store.search(app="x")[0]["id"] == "j"
     finally:
         es.close()
+
+
+def test_es_archive_stale_write_cannot_overwrite_newer(tmp_path):
+    """external_gte versioning over the wire: a recovered wedged peer's
+    stale open mirror must not clobber a newer terminal record (and the
+    409 counts as success — the archive already holds something newer)."""
+    es = _FakeEs()
+    try:
+        a = EsArchive(f"http://127.0.0.1:{es.port}")
+        assert a.index_job({"id": "j", "status": "completed_health",
+                            "modified_at": 100.0})
+        assert a.index_job({"id": "j", "status": "preprocess_inprogress",
+                            "modified_at": 50.0})  # stale: rejected, but True
+        assert es.docs["j"]["status"] == "completed_health"
+        assert a.errors == 0
+    finally:
+        es.close()
+
+
+def test_es_archive_state_roundtrip_over_wire():
+    es = _FakeEs()
+    try:
+        a = EsArchive(f"http://127.0.0.1:{es.port}")
+        assert a.get_state("breath") is None
+        assert a.index_state("breath", {"v": 1}, 10.0)
+        assert a.index_state("breath", {"v": 0}, 5.0)  # stale: no-op, True
+        assert a.get_state("breath") == ({"v": 1}, 10.0)
+    finally:
+        es.close()
+
+
+def test_es_archive_search_oldest_first():
+    es = _FakeEs()
+    try:
+        a = EsArchive(f"http://127.0.0.1:{es.port}")
+        a.index_job({"id": "old", "status": "initial", "modified_at": 1.0})
+        a.index_job({"id": "new", "status": "initial", "modified_at": 9.0})
+        ids = [r["id"] for r in a.search(status="initial", oldest_first=True)]
+        assert ids == ["old", "new"]
+    finally:
+        es.close()
+
+
+def test_compaction_ages_out_old_terminal_records(tmp_path):
+    """Compacted size must track the LIVE job count: unique per-rollout
+    terminal ids age out past keep_terminal_seconds, open records never."""
+    import time as _t
+
+    ar = FileArchive(str(tmp_path / "ar.jsonl"), max_bytes=2048,
+                     keep_terminal_seconds=3600.0)
+    old = _t.time() - 7200.0
+    ar.index_job({"id": "ancient", "status": "completed_health",
+                  "modified_at": old})
+    ar.index_job({"id": "stale-open", "status": "preprocess_inprogress",
+                  "modified_at": old})
+    for i in range(60):
+        ar.index_job({"id": f"churn-{i}", "status": "completed_health",
+                      "modified_at": _t.time(), "pad": "z" * 64})
+    assert ar.compactions >= 1
+    assert ar.get("ancient") is None  # aged out
+    assert ar.get("stale-open") is not None  # adoptable state: kept
+    assert ar.get("churn-59") is not None  # recent terminal: kept
+
+
+def test_terminal_and_jobs_archive_status_sets_match():
+    from foremast_tpu.engine.archive import _TERMINAL
+
+    assert _TERMINAL == frozenset(J.TERMINAL_STATUSES)

@@ -1,0 +1,29 @@
+"""The mesh-reduction and long-window bench legs are driver-run product
+surface (bench.py children); pin their record shapes on tiny inputs."""
+import numpy as np
+
+
+def test_mesh_reduction_leg_record_shape():
+    from foremast_tpu import bench_mesh
+
+    rec = bench_mesh.run(B_total=256, T=32, n_runs=3)
+    assert rec["n_devices"] == 8  # conftest's virtual mesh
+    assert rec["pairs"] == 256
+    assert rec["with_reduction_s"] > 0 and rec["score_only_s"] > 0
+    assert 0.0 <= rec["reduction_share_cpu_mesh"] < 1.0
+    assert 0.0 <= rec["share_vs_device_scoring_est"] < 1.0
+    # overhead is max(with-without, 0): never negative
+    assert rec["value"] >= 0.0
+
+
+def test_long_window_leg_record_shape(monkeypatch):
+    import bench as bench_mod
+
+    monkeypatch.setenv("BENCH_LONG_WINDOW", "512")
+    monkeypatch.setenv("BENCH_LONG_BATCH", "16")
+    monkeypatch.setenv("BENCH_LONG_RUNS", "3")
+    rec = bench_mod._long_window_fields()
+    assert rec["long_window"] == 512 and rec["long_batch"] == 16
+    assert rec["long_band_p99_s"] >= rec["long_band_p50_s"] > 0
+    assert rec["long_ses_assoc_speedup"] > 0
+    assert rec["long_hw_fit_p50_s"] > 0 and rec["long_hw_batch"] == 2

@@ -225,17 +225,18 @@ def test_file_archive_compaction_preserves_terminal_records(tmp_path):
     gc() trusts the archive to hold it. Compaction keeps the latest
     record per id, so size tracks job count, not write rate."""
     ar = FileArchive(str(tmp_path / "ar.jsonl"), max_bytes=4096)
+    now = time.time()
     ar.index_job({"id": "done", "status": J.COMPLETED_UNHEALTH,
-                  "modified_at": 1.0, "reason": "bad"})
+                  "modified_at": now, "reason": "bad"})
     # churn: one open job re-mirrored far past the rotation threshold
     for i in range(200):
         ar.index_job({"id": "busy", "status": J.INITIAL,
-                      "modified_at": 2.0 + i, "pad": "x" * 64})
+                      "modified_at": now + 2.0 + i, "pad": "x" * 64})
     assert ar.compactions >= 1
     final = ar.get("done")
     assert final is not None and final["status"] == J.COMPLETED_UNHEALTH
     busy = ar.get("busy")
-    assert busy is not None and busy["modified_at"] == 201.0
+    assert busy is not None and busy["modified_at"] == now + 201.0
     # compacted steady state: 2 jobs, so both generations stay small
     total = sum(os.path.getsize(str(tmp_path / "ar.jsonl") + s)
                 for s in ("", ".1") if os.path.exists(str(tmp_path / "ar.jsonl") + s))
