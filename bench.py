@@ -303,8 +303,7 @@ def _long_window_fields() -> dict:
     @jax.jit
     def band_fn(xv, xm, reg):
         hist = xm & ~reg
-        preds = jax.vmap(fc._moving_average_1d, in_axes=(0, 0, None))(
-            xv, hist, 30)
+        preds = fc.moving_average_predictions(xv, hist, 30)
         sigma = fc.residual_sigma(xv, preds, hist, ~reg)
         out = fc.band_anomalies(xv, xm, reg, preds, sigma, thr, bound, mlb)
         return jax.tree.reduce(

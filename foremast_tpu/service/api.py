@@ -330,6 +330,21 @@ class ForemastService:
             f"foremast_snapshot_flush_seconds "
             f"{self.store.snapshot_flush_seconds}"
         )
+        # RAM-only exposure (worst-case job-loss window on crash): last
+        # realized window per flush, the max observed, and the live age
+        # of the oldest unflushed mutation
+        lines.append(
+            f"foremast_loss_window_seconds "
+            f"{round(self.store.loss_window_last_seconds, 4)}"
+        )
+        lines.append(
+            f"foremast_loss_window_max_seconds "
+            f"{round(self.store.loss_window_max_seconds, 4)}"
+        )
+        lines.append(
+            f"foremast_loss_window_open_seconds "
+            f"{round(self.store.loss_window_open_seconds, 4)}"
+        )
         if self.store.archive is not None:
             lines.append(
                 "foremast_archive_errors "

@@ -1,6 +1,13 @@
 """The mesh-reduction and long-window bench legs are driver-run product
 surface (bench.py children); pin their record shapes on tiny inputs."""
+import os
+import sys
+
 import numpy as np
+
+# bench.py lives at the repo root (driver contract), not in the package;
+# make the import work under bare `pytest` from any CWD
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def test_mesh_reduction_leg_record_shape():
