@@ -1,5 +1,8 @@
 """Post-optimization TPU measurement: components + full verdict, forced completion.
-Run when the axon tunnel is healthy:  nohup python _tpu_remeasure.py > /tmp/remeasure.log 2>&1 &
+Run when the axon tunnel is healthy:
+  nohup python scripts/tpu_component_profile.py > /tmp/remeasure.log 2>&1 &
+To isolate the exact-KS DP's device cost, run once more with
+FOREMAST_KS_EXACT_MAX_T=0 (Stephens-only) and diff the fused line.
 """
 import time, numpy as np, jax, jax.numpy as jnp
 from foremast_tpu.ops import pairwise as pw

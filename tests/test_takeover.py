@@ -268,3 +268,50 @@ def test_stale_open_record_cannot_shadow_newer_terminal(tmp_path):
     b = JobStore(archive=ar)
     assert b.adopt_stale_from_archive(max_stuck_seconds=1,
                                       now=time.time() + 1000) == 0
+
+
+_CHILD_WRITER = r"""
+import sys, time
+from foremast_tpu.engine.archive import FileArchive
+
+path, tag = sys.argv[1], sys.argv[2]
+ar = FileArchive(path, max_bytes=8192)  # small: forces compactions mid-run
+now = time.time()
+for i in range(120):
+    # open mirror then terminal — the terminal must be each id's last word
+    ar.index_job({"id": f"{tag}-{i}", "status": "preprocess_inprogress",
+                  "modified_at": now + i, "pad": "x" * 80})
+    assert ar.index_job({"id": f"{tag}-{i}", "status": "completed_health",
+                         "modified_at": now + i + 0.5, "pad": "x" * 80})
+print("DONE", ar.compactions, flush=True)
+"""
+
+
+def test_two_process_archive_writers_lose_nothing(tmp_path):
+    """Concurrent mirror churn from two OS processes on one shared path,
+    with compactions firing throughout: every job's terminal record must
+    survive (flock-serialized mutations, single-write appends, compaction
+    merging both generations). A torn interleave or a rotation clobber
+    would silently drop records — the exact multi-writer hazards the
+    failover deployment introduces."""
+    path = str(tmp_path / "shared.jsonl")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _CHILD_WRITER, path, tag],
+                         stdout=subprocess.PIPE, text=True, env=env)
+        for tag in ("a", "b")
+    ]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    compactions = sum(int(o.split()[1]) for o in outs)
+    assert compactions >= 1, f"no compaction fired: {outs}"
+    ar = FileArchive(path, max_bytes=8192)
+    for tag in ("a", "b"):
+        for i in range(120):
+            rec = ar.get(f"{tag}-{i}")
+            assert rec is not None, (tag, i, compactions)
+            assert rec["status"] == "completed_health", (tag, i, rec)
+    # and no job is still visible as open
+    assert ar.search(status="preprocess_inprogress", limit=500) == []
