@@ -315,3 +315,31 @@ def test_two_process_archive_writers_lose_nothing(tmp_path):
             assert rec["status"] == "completed_health", (tag, i, rec)
     # and no job is still visible as open
     assert ar.search(status="preprocess_inprogress", limit=500) == []
+
+
+def test_concurrent_adoption_is_optimistic_and_converges(tmp_path):
+    """Two live runtimes may BOTH adopt the same stale job (the
+    reference's ES takeover has the same property) — that must be safe:
+    both can claim and complete it, verdict writes are last-write-wins,
+    and the archive converges to one terminal record."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    a = JobStore(archive=ar)
+    a.create(_doc())
+    a.claim_open_jobs("w-dead", max_stuck_seconds=90)
+    a.flush()
+
+    later = time.time() + 1000
+    b, c = JobStore(archive=ar), JobStore(archive=ar)
+    assert b.adopt_stale_from_archive(worker="B", max_stuck_seconds=90,
+                                      now=later) == 1
+    assert c.adopt_stale_from_archive(worker="C", max_stuck_seconds=90,
+                                      now=later) == 1  # optimistic: both
+    for store, w in ((b, "wB"), (c, "wC")):
+        assert [d.id for d in store.claim_open_jobs(
+            w, max_stuck_seconds=1e-9)] == ["j1"]
+        store.transition("j1", J.PREPROCESS_COMPLETED, worker=w)
+        store.transition("j1", J.POSTPROCESS_INPROGRESS, worker=w)
+        store.transition("j1", J.COMPLETED_HEALTH, worker=w)
+    # the archive holds exactly one terminal record for the job
+    assert ar.get("j1")["status"] == J.COMPLETED_HEALTH
+    assert ar.search(status=list(J.OPEN_STATUSES)) == []
