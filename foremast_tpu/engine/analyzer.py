@@ -176,6 +176,13 @@ class Analyzer:
         # insertion-ordered dict doubles as the LRU eviction queue.
         self._lstm_cache: dict = {}
         self._lstm_models: dict = {}  # (F, hidden, latent) -> module instance
+        # fleet-scoring support: every trained entry gets a version, and
+        # stacked parameter pytrees are cached per (shape, members) — the
+        # 256-way eager jnp.stack costs ~20x the fleet launch itself, so
+        # it must happen only when membership/params change, not per cycle
+        self._lstm_param_version = 0
+        self._lstm_stack_cache: dict = {}
+        self.lstm_stack_rebuilds = 0  # observability: stack-cache churn
         # per-CYCLE train-on-miss counter (reset in _run_cycle); lives on
         # the instance so the _isolate per-job retry path cannot reset it
         self._lstm_trained_this_cycle = 0
@@ -653,6 +660,8 @@ class Analyzer:
 
         cfg = self.config
         results = {}
+        # (item, params, err_mu, err_sd, version, cwin, cmask)
+        scoreable: list = []
         budget = cfg.lstm_max_train_per_cycle
         for it in items:
             x, m, n_h, n_c = _joint_grid(it.hist, it.cur)
@@ -713,25 +722,101 @@ class Analyzer:
                     err_mu, err_sd = lstm_ae.fit_score_normalizer(
                         state.params, hwin, hmask, model.apply
                     )
-                entry = (state.params, float(err_mu), float(err_sd))
+                self._lstm_param_version += 1
+                entry = (state.params, float(err_mu), float(err_sd),
+                         self._lstm_param_version)
             self._lstm_cache[cache_key] = entry  # re-insert = mark recent
             while len(self._lstm_cache) > cfg.max_cache_size:
                 self._lstm_cache.pop(next(iter(self._lstm_cache)))
-            params, err_mu, err_sd = entry
-            z = float(
-                np.max(
-                    np.asarray(
-                        lstm_ae.anomaly_scores(
-                            params, cwin, cmask, err_mu, err_sd, model.apply
-                        )
-                    )
-                )
-            )
+            params, err_mu, err_sd, version = entry
+            scoreable.append((it, params, err_mu, err_sd, version,
+                              cwin, cmask))
+
+        for (it, z) in self._score_multi_fleet(scoreable):
             results[(it.job_id, "+".join(it.metrics), "lstm")] = {
                 "unhealthy": z > cfg.lstm_threshold,
                 "z": z,
             }
         return results
+
+    # fleet scoring engages above this group size; smaller groups take the
+    # per-job path (rung padding would waste more than it saves)
+    _LSTM_FLEET_MIN = 4
+
+    def _score_multi_fleet(self, scoreable):
+        """Score collected multi-metric jobs, batching same-shape groups.
+
+        Each job owns its own trained AE params, so a warm fleet's scoring
+        was J per-job device dispatches per cycle — the dominant cost of
+        the multi family once training is cached. Jobs whose score windows
+        share a (F, W, K) shape stack into ONE vmapped launch over a
+        stacked parameter pytree (lstm_ae.anomaly_scores_fleet), with the
+        job axis padded to the fixed batch rungs so XLA compiles one
+        program per (rung, shape) for the life of the process.
+
+        Yields (item, z) pairs.
+        """
+        import jax as _jax
+        import jax.numpy as jnp
+
+        groups: dict[tuple, list] = {}
+        for rec in scoreable:
+            cwin = rec[5]
+            key = (cwin.shape[2], cwin.shape[1], cwin.shape[0])  # (F, W, K)
+            groups.setdefault(key, []).append(rec)
+        chunk_cap = self._bucket_rows(self.config.score_batch)
+        for (F, W, K), recs in groups.items():
+            model = self._lstm_model(F)
+            if len(recs) < self._LSTM_FLEET_MIN:
+                for it, params, mu, sd, _ver, cwin, cmask in recs:
+                    z = float(np.max(np.asarray(lstm_ae.anomaly_scores(
+                        params, cwin, cmask, mu, sd, model.apply))))
+                    yield it, z
+                continue
+            # chunk like _score_chunks: groups beyond the configured batch
+            # cap split into full chunks (pad can never go negative)
+            for lo in range(0, len(recs), chunk_cap):
+                chunk = recs[lo:lo + chunk_cap]
+                J = len(chunk)
+                rung = self._bucket_rows(J)
+                pad = rung - J
+                # stacked-params cache: the stack itself costs ~20x the
+                # fleet launch, so reuse it while the member set +
+                # versions hold (stable for a warm continuous fleet;
+                # rebuilt on retrain, membership change, or rung move).
+                # LRU with re-insert on hit, so concurrently-live shape
+                # groups cannot evict each other cycle over cycle.
+                stack_key = (F, W, K, rung, tuple(r[4] for r in chunk))
+                pstack = self._lstm_stack_cache.pop(stack_key, None)
+                if pstack is None:
+                    self.lstm_stack_rebuilds += 1
+
+                    def stack(leaves):
+                        arr = jnp.stack(leaves)
+                        if pad:
+                            reps = jnp.repeat(arr[-1:], pad, axis=0)
+                            arr = jnp.concatenate([arr, reps])
+                        return arr
+
+                    pstack = _jax.tree.map(
+                        lambda *xs: stack(list(xs)), *[r[1] for r in chunk])
+                self._lstm_stack_cache[stack_key] = pstack  # mark recent
+                while len(self._lstm_stack_cache) > 32:
+                    self._lstm_stack_cache.pop(
+                        next(iter(self._lstm_stack_cache)))
+                X = np.stack([r[5] for r in chunk])
+                M = np.stack([r[6] for r in chunk])
+                mus = np.asarray([r[2] for r in chunk], np.float32)
+                sds = np.asarray([r[3] for r in chunk], np.float32)
+                if pad:
+                    X = np.concatenate([X, np.repeat(X[-1:], pad, axis=0)])
+                    M = np.concatenate([M, np.repeat(M[-1:], pad, axis=0)])
+                    mus = np.concatenate([mus, np.repeat(mus[-1:], pad)])
+                    sds = np.concatenate([sds, np.repeat(sds[-1:], pad)])
+                zs = np.asarray(lstm_ae.anomaly_scores_fleet(
+                    pstack, X, M, mus, sds, model.apply))[:J]
+                for (it, *_), z in zip(chunk, zs.max(axis=1)):
+                    yield it, float(z)
 
     def _score_hpa(self, items: list[_HpaItem]):
         """Batch HPA items: primary (priority 0 / tps-like) metric drives the

@@ -31,7 +31,8 @@ import optax
 from flax import linen as nn
 
 __all__ = ["LstmAutoencoder", "TrainState", "init_state", "train_step", "train",
-           "anomaly_scores", "fit_score_normalizer", "param_shardings"]
+           "anomaly_scores", "anomaly_scores_fleet", "fit_score_normalizer",
+           "param_shardings"]
 
 _F = jnp.float32
 
@@ -170,3 +171,18 @@ def anomaly_scores(params, x, mask, mu, sigma, apply_fn):
     """
     errs = reconstruction_errors(params, x, mask, apply_fn)
     return (errs - mu) / sigma
+
+
+@partial(jax.jit, static_argnames=("apply_fn",))
+def anomaly_scores_fleet(params_stack, x, mask, mu, sigma, apply_fn):
+    """Fleet-wide scoring: J jobs' models in ONE launch.
+
+    Each multi-metric job owns its own trained parameters, so fleet
+    scoring vmaps over a STACKED parameter pytree alongside the data —
+    (J, K, W, F) windows against (J, ...) params — collapsing J per-job
+    device dispatches (~ms each, dominating a warm multi-metric cycle at
+    fleet scale) into one batched program whose inner matmuls gain a
+    J-wide batch dimension on the MXU. Returns (J, K) z-scores.
+    """
+    return jax.vmap(anomaly_scores, in_axes=(0, 0, 0, 0, 0, None))(
+        params_stack, x, mask, mu, sigma, apply_fn)

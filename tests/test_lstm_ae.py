@@ -113,3 +113,34 @@ def test_param_shardings_tensor_parallel_train_and_score():
     errs_repl = lstm_ae.reconstruction_errors(params_repl, x, m, model.apply)
     np.testing.assert_allclose(np.asarray(errs), np.asarray(errs_repl),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_fleet_scoring_matches_per_job():
+    """anomaly_scores_fleet (one launch, stacked params) must reproduce
+    per-job anomaly_scores exactly — it is the same computation with a
+    vmapped job axis."""
+    import numpy as np
+
+    from foremast_tpu.models import lstm_ae
+
+    J, K, W, F = 5, 3, 12, 3
+    model = lstm_ae.LstmAutoencoder(hidden=8, latent=4, features=F)
+    rng = np.random.default_rng(0)
+    params_list, mus, sds = [], [], []
+    X = rng.normal(0, 1, (J, K, W, F)).astype(np.float32)
+    M = rng.random((J, K, W, F)) > 0.1
+    for j in range(J):
+        state, _ = lstm_ae.init_state(model, jax.random.PRNGKey(j), T=W)
+        params_list.append(state.params)
+        mus.append(0.5 + 0.1 * j)
+        sds.append(1.0 + 0.05 * j)
+    import jax.numpy as jnp
+
+    pstack = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+    zs_fleet = np.asarray(lstm_ae.anomaly_scores_fleet(
+        pstack, X, M, np.asarray(mus, np.float32),
+        np.asarray(sds, np.float32), model.apply))
+    for j in range(J):
+        zs_one = np.asarray(lstm_ae.anomaly_scores(
+            params_list[j], X[j], M[j], mus[j], sds[j], model.apply))
+        np.testing.assert_allclose(zs_fleet[j], zs_one, rtol=2e-5, atol=1e-5)
