@@ -464,8 +464,10 @@ def main() -> None:
     timeout_s = _env_float("BENCH_DEVICE_TIMEOUT", 1200.0)
     preflight_timeout_s = _env_float("BENCH_PREFLIGHT_TIMEOUT", 90.0)
     preflight_window_s = _env_float("BENCH_PREFLIGHT_WINDOW", 900.0)
-    cycle_extra = _cycle_bench()
-    # The device leg runs in a CHILD with a hard deadline: a wedged TPU
+    # The device leg runs FIRST: the headline is the round's most
+    # important artifact, so nothing may die before it — and its measured
+    # score time then calibrates the mesh leg's share estimate.
+    # It runs in a CHILD with a hard deadline: a wedged TPU
     # tunnel (a killed grant-holder can hang jax.devices() indefinitely)
     # must degrade to a JSON line carrying the host-path numbers + an
     # error field — never a silent hang that records nothing. The
@@ -522,6 +524,14 @@ def main() -> None:
             "device_error": f"preflight: tunnel unhealthy after "
                             f"{preflight_window_s:.0f}s window | {probe_err}",
         }
+    # calibrate the mesh leg's reduction-share estimate with THIS run's
+    # measured device score time (p50 minus the readback round-trip)
+    # instead of bench_mesh.py's hardcoded prior
+    p50 = device.get("p50_s_at_100k")
+    rtt = device.get("readback_rtt_floor_s", 0.0)
+    if p50 and not cpu_run:
+        os.environ["BENCH_DEVICE_SCORE_S"] = str(max(p50 - rtt, 1e-6))
+    cycle_extra = _cycle_bench()
     print(json.dumps({
         "metric": "canary_pairs_scored_per_sec_per_chip",
         "unit": "pairs/s/chip",
