@@ -530,7 +530,10 @@ def main() -> None:
     p50 = device.get("p50_s_at_100k")
     rtt = device.get("readback_rtt_floor_s", 0.0)
     if p50 and not cpu_run:
-        os.environ["BENCH_DEVICE_SCORE_S"] = str(max(p50 - rtt, 1e-6))
+        # setdefault: an operator-exported BENCH_DEVICE_SCORE_S is a
+        # documented override and must win over self-calibration
+        os.environ.setdefault(
+            "BENCH_DEVICE_SCORE_S", str(max(p50 - rtt, 1e-6)))
     cycle_extra = _cycle_bench()
     print(json.dumps({
         "metric": "canary_pairs_scored_per_sec_per_chip",

@@ -31,8 +31,8 @@ import optax
 from flax import linen as nn
 
 __all__ = ["LstmAutoencoder", "TrainState", "init_state", "train_step", "train",
-           "anomaly_scores", "anomaly_scores_fleet", "fit_score_normalizer",
-           "param_shardings"]
+           "train_fleet", "anomaly_scores", "anomaly_scores_fleet",
+           "fit_score_normalizer", "param_shardings"]
 
 _F = jnp.float32
 
@@ -142,6 +142,43 @@ def train(model, state, tx, x, mask, epochs: int = 50):
             params, opt_state, x, mask, model.apply, tx
         )
     return TrainState(params=params, opt_state=opt_state, step=state.step + epochs), loss
+
+
+@partial(jax.jit, static_argnames=("apply_fn", "tx"))
+def _train_step_fleet(params, opt_state, x, mask, apply_fn, tx):
+    return jax.vmap(
+        lambda p, o, xx, mm: train_step(p, o, xx, mm, apply_fn, tx)
+    )(params, opt_state, x, mask)
+
+
+def train_fleet(model, rng, x, mask, epochs: int = 50, lr: float = 1e-3):
+    """Train J same-shape jobs' autoencoders in ONE vmapped loop.
+
+    Every job deliberately starts from the SAME deterministic init (the
+    single-job path uses a fixed PRNGKey too), so the stacked start state
+    is a broadcast; each epoch is then one `train_step` vmapped over
+    (params, opt_state, windows) — J jobs' training collapses from J
+    sequential loops of E dispatches each into E dispatches total, and
+    the per-step matmuls gain a J-wide batch dimension on the MXU.
+
+    Args:
+      x, mask: (J, K, W, F) historical training windows per job.
+    Returns (params_stack, err_mu (J,), err_sd (J,)) — the stacked
+    parameters slice per job for the cache, and the per-job healthy-error
+    normalizers.
+    """
+    J, K, W, F = x.shape
+    state, tx = init_state(model, rng, T=W, lr=lr)
+    bcast = lambda a: jnp.broadcast_to(a[None], (J,) + a.shape)  # noqa: E731
+    params = jax.tree.map(bcast, state.params)
+    opt_state = jax.tree.map(bcast, state.opt_state)
+    for _ in range(epochs):
+        params, opt_state, _ = _train_step_fleet(
+            params, opt_state, x, mask, model.apply, tx)
+    mus, sds = jax.vmap(
+        lambda p, xx, mm: fit_score_normalizer(p, xx, mm, model.apply)
+    )(params, x, mask)
+    return params, mus, sds
 
 
 @partial(jax.jit, static_argnames=("apply_fn",))

@@ -772,3 +772,40 @@ def test_lstm_fleet_scoring_path_engages(monkeypatch):
     # module namespace at trace time, so the spy fires once during the
     # trace — what must NOT happen is one dispatch per job (5 calls)
     assert calls["single"] <= 1, calls
+
+
+def test_lstm_same_app_jobs_share_one_training_slot():
+    """N jobs of one app share a cache key: a cold cycle must train ONE
+    model for them (one budget slot), and all N score from it — not N
+    redundant trainings draining the warm-up budget."""
+    fixtures = {}
+    docs = []
+    n_h, n_c = 128, 16
+    rng = np.random.default_rng(50)
+    for i, name in enumerate(("latency", "cpu", "tps")):
+        fixtures[f"h{i}"] = ((np.arange(n_h) * STEP).tolist(),
+                             rng.normal(10, 1, n_h).tolist())
+        fixtures[f"c{i}"] = (((n_h + np.arange(n_c)) * STEP).tolist(),
+                             rng.normal(10, 1, n_c).tolist())
+    for j in range(3):  # three jobs, same app, same metrics
+        docs.append(Document(
+            id=f"dup{j}", app_name="one-app", namespace="d",
+            strategy="canary",
+            start_time=to_rfc3339(0), end_time=to_rfc3339(1e9),
+            metrics={name: MetricQueries(current=f"c{i}",
+                                         historical=f"h{i}")
+                     for i, name in enumerate(("latency", "cpu", "tps"))},
+        ))
+    store = JobStore()
+    for d in docs:
+        store.create(d)
+    cfg = EngineConfig(algorithm="lstm_autoencoder", lstm_window=16,
+                       lstm_epochs=3, lstm_hidden=8, lstm_latent=4,
+                       policies={}, lstm_threshold=1e9,
+                       lstm_max_train_per_cycle=1)  # ONE slot suffices
+    analyzer = Analyzer(cfg, FixtureDataSource(fixtures), store)
+    out = analyzer.run_cycle(now=100.0)
+    assert len(analyzer._lstm_cache) == 1
+    assert analyzer._lstm_trained_this_cycle == 1
+    # all three jobs were judged (healthy requeue), none starved
+    assert all(s == J.INITIAL for s in out.values()), out
