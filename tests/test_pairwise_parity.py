@@ -51,13 +51,33 @@ def test_mann_whitney(seed, ties, shift):
 @pytest.mark.parametrize("ties", [False, True])
 @pytest.mark.parametrize("shift", [0.0, 1.0])
 def test_wilcoxon(seed, ties, shift):
+    """Parity against scipy's AUTO dispatch: exact null for untied,
+    zero-free n <= 50 (where the engine's live windows sit and the
+    normal approximation drifts up to ~0.02), approx beyond/with ties."""
     x, xm, y, ym = _windows(seed, ties=ties, shift=shift)
     both = xm & ym
     W, p = wilcoxon_signed_rank(x, xm, y, ym)
     d = (x - y)[both]
     d = d[d != 0]
-    ref = sps.wilcoxon(d, zero_method="wilcox", correction=False, method="approx")
+    ref = sps.wilcoxon(d, zero_method="wilcox", correction=False)
     np.testing.assert_allclose(float(W), ref.statistic, rtol=1e-5)
+    np.testing.assert_allclose(float(p), ref.pvalue, atol=ATOL, rtol=1e-3)
+
+
+def test_wilcoxon_large_n_uses_approx():
+    """Beyond WILCOXON_EXACT_MAX_N the tie-corrected normal approximation
+    remains the (documented) branch, matching scipy method='approx'."""
+    from foremast_tpu.ops.pairwise import WILCOXON_EXACT_MAX_N
+
+    n = WILCOXON_EXACT_MAX_N + 10
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, n).astype(np.float32)
+    y = (x - rng.normal(0.3, 1, n)).astype(np.float32)
+    m = np.ones(n, bool)
+    W, p = wilcoxon_signed_rank(x, m, y, m)
+    d = (x - y)[(x - y) != 0]
+    ref = sps.wilcoxon(d, zero_method="wilcox", correction=False,
+                       method="approx")
     np.testing.assert_allclose(float(p), ref.pvalue, atol=ATOL, rtol=1e-3)
 
 
