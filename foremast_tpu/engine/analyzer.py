@@ -186,6 +186,16 @@ class Analyzer:
         # per-CYCLE train-on-miss counter (reset in _run_cycle); lives on
         # the instance so the _isolate per-job retry path cannot reset it
         self._lstm_trained_this_cycle = 0
+        # jobs left unjudged because the cycle's train budget was spent —
+        # distinguishes "fleet warming up" (rising counter: budget too
+        # small for the churn) from "jobs simply in progress" (zero);
+        # cumulative like lstm_stack_rebuilds, also stamped per cycle on
+        # the engine.score.lstm span. Tracked as a per-cycle ID SET, not a
+        # counter: the _isolate per-job retry path re-invokes the scorer
+        # within one cycle and a counter would double-count every skipped
+        # job after a batch failure.
+        self.lstm_budget_skips = 0
+        self._lstm_budget_skipped_ids: set = set()
 
     # ------------------------------------------------------------------ fetch
     def _fetch_window(self, url: str, now: float) -> Window | None:
@@ -720,6 +730,7 @@ class Analyzer:
                     # multi-metric fleet must not blow the cycle budget on
                     # unbounded AE training): leave the job unjudged; it
                     # stays in progress and warms up on a later cycle.
+                    self._lstm_budget_skipped_ids.add(it.job_id)
                     continue
                 self._lstm_trained_this_cycle += 1
                 # defer: same-shape misses train together in one vmapped
@@ -1053,6 +1064,7 @@ class Analyzer:
 
         live = {k: v for k, v in states.items() if not v.failed}
         self._lstm_trained_this_cycle = 0
+        self._lstm_budget_skipped_ids = set()
         with tracing.span("engine.score", pairs=len(all_pairs),
                           bands=len(all_bands), bis=len(all_bis),
                           multis=len(all_multis), hpas=len(all_hpas)):
@@ -1064,8 +1076,10 @@ class Analyzer:
                 band_res, band_bad = self._isolate(self._score_bands, all_bands)
             with tracing.span("engine.score.bivariate", n=len(all_bis)):
                 bi_res, bi_bad = self._isolate(self._score_bivariate, all_bis)
-            with tracing.span("engine.score.lstm", n=len(all_multis)):
+            with tracing.span("engine.score.lstm", n=len(all_multis)) as lsp:
                 multi_res, multi_bad = self._isolate(self._score_multi, all_multis)
+                lsp.attrs["budget_skips"] = len(self._lstm_budget_skipped_ids)
+                self.lstm_budget_skips += len(self._lstm_budget_skipped_ids)
             with tracing.span("engine.score.hpa", n=len(all_hpas)):
                 hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
         scoring_failed = {**pair_bad, **band_bad, **bi_bad, **multi_bad, **hpa_bad}

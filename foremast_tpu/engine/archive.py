@@ -97,6 +97,12 @@ class FileArchive:
         # locked scan (sustained-rotation churn); exposed for observability
         self.locked_scan_fallbacks = 0
         self.compactions = 0
+        # times the sidecar .lock could not be opened/flocked while fcntl
+        # IS available: mutations proceeded under the in-process lock only,
+        # and compaction was suppressed (truncating without the
+        # cross-process lock can destroy another replica's append)
+        self.lock_degradations = 0
+        self.compactions_skipped_unlocked = 0
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -111,15 +117,23 @@ class FileArchive:
             def __enter__(self):
                 outer._lock.acquire()
                 self._fd = None
+                # cross-process exclusion held? True when fcntl is absent
+                # (per-process lock is all there is by design) or the flock
+                # succeeded; False = DEGRADED (lock file unopenable), which
+                # callers must treat as "no right to compact"
+                self.cross_locked = fcntl is None
                 if fcntl is not None:
                     try:
                         self._fd = os.open(outer.path + ".lock",
                                            os.O_CREAT | os.O_RDWR, 0o644)
                         fcntl.flock(self._fd, fcntl.LOCK_EX)
+                        self.cross_locked = True
                     except OSError:
+                        outer.lock_degradations += 1
                         if self._fd is not None:
                             os.close(self._fd)
                             self._fd = None
+                return self
 
             def __exit__(self, *exc):
                 if self._fd is not None:
@@ -134,11 +148,20 @@ class FileArchive:
     # -- writing --
     def _append(self, rec: dict) -> bool:
         line = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
-        with self._flock():
+        with self._flock() as lk:
             try:
                 if (os.path.exists(self.path)
                         and os.path.getsize(self.path) + len(line) > self.max_bytes):
-                    self._compact_locked()
+                    if lk.cross_locked:
+                        self._compact_locked()
+                    else:
+                        # degraded: an unlocked compaction could truncate
+                        # away a concurrent peer append in a shared-archive
+                        # (RWX PVC) deployment — the append below is safe
+                        # (O_APPEND, interleave-atomic), compaction is not.
+                        # The file grows past max_bytes until the lock
+                        # heals; counted so operators see it.
+                        self.compactions_skipped_unlocked += 1
             except OSError:
                 pass
             try:

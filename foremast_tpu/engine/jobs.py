@@ -197,6 +197,15 @@ class JobStore:
         # adopt a crashed peer's in-flight work (adopt_stale_from_archive)
         self.mirror_open = mirror_open and archive is not None
         self.adopted_total = 0  # observability: jobs adopted from peers
+        self.mirror_failures_total = 0  # failed mirror writes (any cause)
+        # per-doc retry backoff after a failed mirror write: id ->
+        # (retry_at, current_delay). Keeps a permanently-rejected doc (ES
+        # 400 mapping conflict, oversized doc) from head-of-line-blocking
+        # the cut every flush — after a failure the doc sits out a doubling
+        # 5 s -> 300 s window while everything behind it mirrors normally.
+        # Transient outages clear on the first successful retry (backoff
+        # entry dropped), so a blip costs one doc ~5 s of mirror staleness.
+        self._mirror_backoff: dict[str, tuple[float, float]] = {}
         # RAM-only exposure instrumentation (VERDICT r3 #8): how long do
         # accepted mutations live only in RAM before reaching a durable
         # medium? _dirty_since marks the OLDEST unflushed mutation; each
@@ -614,6 +623,7 @@ class JobStore:
             raise
 
     _MIRROR_BATCH = 512  # open-doc archive writes per flush (bounds latency)
+    _MIRROR_FAIL_CAP = 8  # consecutive failures treated as archive outage
 
     def _mirror_to_archive(self):
         """Mirror changed OPEN jobs + engine state to the archive.
@@ -626,16 +636,20 @@ class JobStore:
         half is adopt_stale_from_archive()."""
         if not self.mirror_open:
             return
+        now = time.time()
         with self._lock:
             # ANY archive-dirty doc, not just open ones: a terminal whose
             # transition-time archive write failed must retry HERE (next
             # flush), not wait for gc's retention window — until the
             # terminal record lands, the archive's newest state for the
-            # job is a stale open mirror that peers would adopt
+            # job is a stale open mirror that peers would adopt.
+            # Docs in failure backoff sit out their window so a run of
+            # permanently-rejected docs can never occupy the whole cut.
             cut = [
                 (doc, doc.to_json(), doc.modified_at)
                 for doc in self._jobs.values()
                 if doc.archived_at < doc.modified_at
+                and self._mirror_backoff.get(doc.id, (0.0, 0.0))[0] <= now
             ][: self._MIRROR_BATCH]
             state_cut = [
                 (k, self._state[k], self._state_updated.get(k, 0.0))
@@ -643,13 +657,46 @@ class JobStore:
                 if self._state_updated.get(k, 0.0)
                 > self._state_archived.get(k, 0.0)
             ]
+        consecutive_failures = 0
         for doc, rec, cut_modified in cut:  # archive I/O outside the lock
-            if self.archive.index_job(rec):
-                # the cut version's own stamp: a doc modified mid-write
-                # keeps archived_at < modified_at and re-mirrors next flush
-                doc.archived_at = max(doc.archived_at, cut_modified)
-            else:
-                break  # archive down: retry the rest next flush
+            ok = self.archive.index_job(rec)
+            with self._lock:  # backoff map is read by /metrics threads
+                if ok:
+                    consecutive_failures = 0
+                    self._mirror_backoff.pop(doc.id, None)
+                    # the cut version's own stamp: a doc modified mid-write
+                    # keeps archived_at < modified_at and re-mirrors next
+                    # flush
+                    doc.archived_at = max(doc.archived_at, cut_modified)
+                else:
+                    # a failed write parks THIS doc in a doubling backoff
+                    # and moves on, so a permanently-rejected doc cannot
+                    # head-of-line-block the fleet's failover mirror; a
+                    # genuinely dead archive still short-circuits via the
+                    # consecutive-failure cap instead of burning the batch.
+                    # TERMINAL docs cap near the flush cadence, not 300 s:
+                    # until the terminal record lands, the archive's newest
+                    # state is a stale open mirror a peer could adopt after
+                    # the outage heals — that window must stay ~one flush,
+                    # while still rotating a poisoned terminal doc out of
+                    # the head of the cut.
+                    self.mirror_failures_total += 1
+                    cap = 300.0 if doc.status in OPEN_STATUSES else 10.0
+                    delay = min(
+                        self._mirror_backoff.get(doc.id, (0.0, 2.5))[1] * 2,
+                        cap)
+                    self._mirror_backoff[doc.id] = (now + delay, delay)
+                    consecutive_failures += 1
+            if consecutive_failures >= self._MIRROR_FAIL_CAP:
+                break  # archive-wide outage: retry the rest next flush
+        with self._lock:
+            if len(self._mirror_backoff) > 4 * self._MIRROR_BATCH:
+                # bound the map: drop expired entries (their docs simply
+                # become eligible again; terminal+archived docs leave stale
+                # keys here)
+                self._mirror_backoff = {
+                    k: v for k, v in self._mirror_backoff.items()
+                    if v[0] > now}
         if hasattr(self.archive, "index_state"):
             for key, value, stamp in state_cut:
                 if self.archive.index_state(key, value, stamp):
@@ -657,10 +704,19 @@ class JobStore:
                         self._state_archived[key] = max(
                             self._state_archived.get(key, 0.0), stamp)
 
+    def mirror_backed_off_docs(self, now: float | None = None) -> int:
+        """Docs currently parked in mirror-failure backoff (a persistently
+        nonzero value while the archive is otherwise healthy means the
+        archive is REJECTING those docs, not suffering an outage)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return sum(1 for v in self._mirror_backoff.values() if v[0] > now)
+
     def adopt_stale_from_archive(self, worker: str = "",
                                  max_stuck_seconds: float = 90.0,
                                  limit: int = 1024,
-                                 now: float | None = None) -> int:
+                                 now: float | None = None,
+                                 skew_margin_seconds: float = 15.0) -> int:
         """Adopt open jobs a crashed/partitioned peer left in the archive.
 
         The reference's failover medium is ES: any brain replica re-claims
@@ -672,6 +728,14 @@ class JobStore:
         steal then reprocesses them. Like the reference, takeover is
         optimistic — a live-but-slow peer's job can be double-scored;
         verdict writes are last-write-wins per id, so that is harmless.
+
+        The staleness test compares PEER-written wall-clock stamps against
+        the LOCAL clock, so cross-replica clock skew eats directly into the
+        takeover threshold: skew approaching max_stuck_seconds could adopt
+        a live peer's job. `skew_margin_seconds` widens the threshold to
+        absorb ordinary NTP-grade drift; deployments without NTP should
+        raise it (see docs/operations.md "Clock skew" and the
+        examples/k8s/runtime-ha.yaml notes).
 
         Returns the number of jobs adopted."""
         if self.archive is None:
@@ -687,7 +751,8 @@ class JobStore:
                 doc = Document.from_json(rec)
             except (TypeError, ValueError):
                 continue  # malformed/foreign record: not adoptable
-            if now - max(doc.lease_at, doc.modified_at) <= max_stuck_seconds:
+            if (now - max(doc.lease_at, doc.modified_at)
+                    <= max_stuck_seconds + skew_margin_seconds):
                 continue  # the owner is (or was recently) alive
             with self._lock:
                 cur = self._jobs.get(doc.id)

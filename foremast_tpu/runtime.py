@@ -62,6 +62,7 @@ class Runtime:
         archive=None,
         job_retention_seconds: float = 24 * 3600.0,
         adopt_interval_seconds: float = 30.0,
+        adopt_skew_margin_seconds: float = 15.0,
     ):
         self.config = config or from_env()
         source = data_source or PrometheusDataSource()
@@ -74,13 +75,17 @@ class Runtime:
         # archive for a crashed peer's stale open jobs (0 disables; the
         # archive scan is not free, so it is NOT every cycle)
         self.adopt_interval_seconds = adopt_interval_seconds
+        # NTP-skew allowance added to the staleness threshold before a
+        # peer's job is adopted (docs/operations.md "Clock skew")
+        self.adopt_skew_margin_seconds = adopt_skew_margin_seconds
         self._last_adopt = 0.0
         self.exporter = VerdictExporter()
         self.analyzer = Analyzer(
             self.config, self.source, self.store, exporter=self.exporter
         )
         self.service = ForemastService(
-            self.store, exporter=self.exporter, query_endpoint=query_endpoint
+            self.store, exporter=self.exporter, query_endpoint=query_endpoint,
+            analyzer=self.analyzer,
         )
         self.wavefront_sink = wavefront_sink
         self._stop = threading.Event()
@@ -137,6 +142,7 @@ class Runtime:
                     n = self.store.adopt_stale_from_archive(
                         worker=worker,
                         max_stuck_seconds=self.config.max_stuck_seconds,
+                        skew_margin_seconds=self.adopt_skew_margin_seconds,
                     )
                     if n:
                         print(f"[foremast-tpu] adopted {n} stale job(s) "
@@ -224,6 +230,7 @@ def main():
         archive=archive,
         job_retention_seconds=_env_seconds("JOB_RETENTION_SECONDS", 24 * 3600.0),
         adopt_interval_seconds=_env_seconds("ARCHIVE_ADOPT_INTERVAL", 30.0),
+        adopt_skew_margin_seconds=_env_seconds("ARCHIVE_ADOPT_SKEW_MARGIN", 15.0),
     )
     proxy = os.environ.get("WAVEFRONT_PROXY", "")
     if proxy:

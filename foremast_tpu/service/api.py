@@ -194,10 +194,13 @@ class ForemastService:
     """Route handlers over the shared store/exporter."""
 
     def __init__(self, store: JobStore, exporter: VerdictExporter | None = None,
-                 query_endpoint: str = ""):
+                 query_endpoint: str = "", analyzer=None):
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.query_endpoint = query_endpoint  # metric-store base for the proxy
+        # optional engine handle: lets /metrics surface analyzer-side
+        # counters (LSTM budget skips, stack rebuilds) next to the store's
+        self.analyzer = analyzer
         # set by make_server: () -> the HTTP admission gate's shed counter
         self.http_shed_count = None
 
@@ -352,6 +355,38 @@ class ForemastService:
             )
             lines.append(
                 f"foremast_jobs_adopted_total {self.store.adopted_total}"
+            )
+            lines.append(
+                "foremast_archive_mirror_failures_total "
+                f"{self.store.mirror_failures_total}"
+            )
+            # docs currently parked in mirror-failure backoff: a persistent
+            # nonzero value with a healthy archive = poisoned docs the
+            # archive rejects (vs mirror_failures_total, which also counts
+            # plain outage write failures)
+            lines.append(
+                "foremast_archive_mirror_backed_off_docs "
+                f"{self.store.mirror_backed_off_docs()}"
+            )
+            lines.append(
+                "foremast_archive_lock_degradations "
+                f"{getattr(self.store.archive, 'lock_degradations', 0)}"
+            )
+            lines.append(
+                "foremast_archive_compactions_skipped_unlocked "
+                f"{getattr(self.store.archive, 'compactions_skipped_unlocked', 0)}"
+            )
+        if self.analyzer is not None:
+            # rising skips = the LSTM train-on-miss budget is too small for
+            # the fleet's identity churn (jobs stuck warming up); zero =
+            # multi-metric jobs are simply in progress
+            lines.append(
+                "foremast_lstm_budget_skips_total "
+                f"{self.analyzer.lstm_budget_skips}"
+            )
+            lines.append(
+                "foremast_lstm_stack_rebuilds_total "
+                f"{self.analyzer.lstm_stack_rebuilds}"
             )
         if self.http_shed_count is not None:
             lines.append(f"foremast_http_shed_total {self.http_shed_count()}")

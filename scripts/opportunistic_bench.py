@@ -1,0 +1,111 @@
+"""Opportunistic TPU device-bench capture (VERDICT r04 #1).
+
+Rounds 3 and 4 both lost their device artifact to an end-of-round axon
+tunnel wedge while working code sat in the repo all round. This harness
+inverts the timing: it runs in the background from the FIRST minute of
+the round, probes the tunnel on a gentle cadence, and the first time the
+probe succeeds it runs the full device + long-window bench legs and
+writes ``BENCH_LOCAL_r05.json`` — so the round's headline artifact is
+banked at the earliest healthy moment, not gambled on end-of-round
+health.
+
+Cadence policy (same wedge facts as bench.py:_preflight, observed on
+this machine): timeout-KILLING a process that awaits the TPU grant is
+itself what wedges jax.devices() machine-wide, and the wedge clears on
+its own given quiet time. So each cycle spawns at most ONE probe, and a
+timed-out probe is followed by a LONG quiet sleep (default 25 min) —
+never a tight retry loop. A deterministic probe failure (import error,
+broken env) aborts: retrying a non-wedge failure is pure stall.
+
+Usage:  python scripts/opportunistic_bench.py [--out BENCH_LOCAL_r05.json]
+Exits 0 once the artifact is written, 1 on deterministic failure,
+2 when the deadline expires without a healthy probe.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def run_json(cmd: list, timeout_s: float) -> tuple[dict | None, str | None]:
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s, check=True, cwd=REPO)
+        return json.loads(out.stdout.strip().splitlines()[-1]), None
+    except Exception as e:  # noqa: BLE001
+        stderr = getattr(e, "stderr", None) or ""
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        tail = " / ".join(stderr.strip().splitlines()[-3:])
+        return None, f"{type(e).__name__}: {e}" + (f" | {tail}" if tail else "")
+
+
+def main() -> int:
+    out_path = os.path.join(REPO, "BENCH_LOCAL_r05.json")
+    if "--out" in sys.argv:
+        idx = sys.argv.index("--out") + 1
+        if idx >= len(sys.argv):
+            print("usage: opportunistic_bench.py [--out PATH]",
+                  file=sys.stderr)
+            return 1
+        out_path = sys.argv[idx]
+    probe_timeout = float(os.environ.get("OPP_PROBE_TIMEOUT", "90"))
+    quiet_sleep = float(os.environ.get("OPP_QUIET_SLEEP", "1500"))
+    deadline = time.time() + float(os.environ.get("OPP_DEADLINE", "36000"))
+
+    probe = [sys.executable, "-c",
+             "import json, jax; d = jax.devices(); "
+             "print(json.dumps({'n': len(d), "
+             "'backend': jax.default_backend()}))"]
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        rec, err = run_json(probe, probe_timeout)
+        if rec is not None:
+            if rec.get("backend") != "tpu":
+                log(f"probe healthy but backend={rec.get('backend')}; abort")
+                return 1
+            log(f"probe #{attempt}: tunnel HEALTHY ({rec}) — running device leg")
+            dev, derr = run_json(
+                [sys.executable, BENCH, "--device-only"], timeout_s=1500)
+            if dev is None:
+                log(f"device leg failed: {derr}; quiet-sleeping")
+                time.sleep(quiet_sleep)
+                continue
+            long_rec, lerr = run_json(
+                [sys.executable, BENCH, "--long-only"], timeout_s=900)
+            if long_rec is not None:
+                dev.update(long_rec)
+            else:
+                dev["long_window_error"] = lerr
+            dev["metric"] = "canary_pairs_scored_per_sec_per_chip"
+            dev["unit"] = "pairs/s/chip"
+            dev["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())
+            dev["capture_mode"] = "opportunistic_mid_round"
+            with open(out_path, "w") as f:
+                f.write(json.dumps(dev) + "\n")
+            log(f"artifact written: {out_path}")
+            return 0
+        if not (err or "").startswith("TimeoutExpired"):
+            log(f"probe #{attempt}: deterministic failure: {err}; abort")
+            return 1
+        log(f"probe #{attempt}: wedged (timeout {probe_timeout:.0f}s); "
+            f"quiet-sleeping {quiet_sleep:.0f}s")
+        time.sleep(quiet_sleep)
+    log("deadline expired without a healthy probe")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

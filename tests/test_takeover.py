@@ -343,3 +343,101 @@ def test_concurrent_adoption_is_optimistic_and_converges(tmp_path):
     # the archive holds exactly one terminal record for the job
     assert ar.get("j1")["status"] == J.COMPLETED_HEALTH
     assert ar.search(status=list(J.OPEN_STATUSES)) == []
+
+
+# ------------------------------------------- ADVICE r04: mirror resilience
+def test_mirror_skips_permanently_rejected_doc(tmp_path):
+    """A single doc the archive rejects (ES 400 mapping conflict shape)
+    must not head-of-line-block every doc behind it from mirroring —
+    that would silently disable cross-replica failover fleet-wide."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    real_index = ar.index_job
+    ar.index_job = lambda rec: (False if rec.get("id") == "poison"
+                                else real_index(rec))
+    store = JobStore(archive=ar)
+    store.create(_doc("poison"))
+    store.create(_doc("j2"))
+    store.create(_doc("j3"))
+    store.claim_open_jobs("w1", max_stuck_seconds=90)
+    store.flush()
+    mirrored = {r["id"] for r in ar.search(status=list(J.OPEN_STATUSES))}
+    assert {"j2", "j3"} <= mirrored and "poison" not in mirrored
+    assert store.mirror_failures_total >= 1
+
+
+def test_mirror_outage_short_circuits_on_consecutive_failures(tmp_path):
+    """A genuinely dead archive must still short-circuit the flush (the
+    per-doc skip is for isolated rejections, not for hammering a dead
+    backend N times per flush)."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    calls = []
+    ar.index_job = lambda rec: (calls.append(rec.get("id")), False)[1]
+    store = JobStore(archive=ar)
+    for i in range(JobStore._MIRROR_FAIL_CAP * 3):
+        store.create(_doc(f"j{i}"))
+    store.claim_open_jobs("w1", max_stuck_seconds=90)
+    calls.clear()
+    store._mirror_to_archive()
+    assert len(calls) == JobStore._MIRROR_FAIL_CAP
+
+
+def test_adopt_skew_margin_spares_borderline_lease(tmp_path):
+    """Staleness within max_stuck + skew margin belongs to a live peer
+    whose clock may simply drift — adoption starts past the margin."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    a = JobStore(archive=ar)
+    a.create(_doc())
+    a.claim_open_jobs("w1", max_stuck_seconds=90)
+    a.flush()
+    b = JobStore(archive=ar)
+    # 95 s stale: past max_stuck(90) but inside the 15 s skew margin
+    assert b.adopt_stale_from_archive(max_stuck_seconds=90,
+                                      now=time.time() + 95) == 0
+    # 110 s stale: past margin too -> adopted
+    assert b.adopt_stale_from_archive(max_stuck_seconds=90,
+                                      now=time.time() + 110) == 1
+
+
+def test_degraded_flock_suppresses_compaction(tmp_path, monkeypatch):
+    """When the sidecar .lock cannot be flocked while fcntl IS available,
+    appends proceed (O_APPEND is interleave-atomic) but compaction must
+    NOT run — an unlocked truncation can destroy a peer's concurrent
+    append on a shared (RWX PVC) archive. Counted for observability."""
+    from foremast_tpu.engine import archive as A
+
+    ar = FileArchive(str(tmp_path / "ar.jsonl"), max_bytes=200)
+
+    def broken_flock(fd, op):
+        raise OSError(13, "flock denied")
+
+    monkeypatch.setattr(A.fcntl, "flock", broken_flock)
+    for i in range(20):  # enough bytes to cross max_bytes repeatedly
+        assert ar.index_job({"id": f"j{i}", "status": J.INITIAL,
+                             "modified_at": float(i)})
+    assert ar.compactions == 0
+    assert ar.compactions_skipped_unlocked > 0
+    assert ar.lock_degradations > 0
+    # every record still present (no truncation happened)
+    assert len(ar.search(limit=100)) == 20
+
+
+def test_adjacent_poison_run_cannot_starve_docs_behind_it(tmp_path):
+    """Review hardening: >= _MIRROR_FAIL_CAP adjacent permanently-rejected
+    docs trip the outage short-circuit on one flush, but their failure
+    backoff must let the docs behind them mirror on the next flush."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    real_index = ar.index_job
+    ar.index_job = lambda rec: (False if rec.get("id", "").startswith("poison")
+                                else real_index(rec))
+    store = JobStore(archive=ar)
+    for i in range(JobStore._MIRROR_FAIL_CAP + 2):
+        store.create(_doc(f"poison{i}"))
+    store.create(_doc("good1"))
+    store.create(_doc("good2"))
+    store.claim_open_jobs("w1", max_stuck_seconds=90)
+    store._mirror_to_archive()  # trips the cap inside the poison run
+    store._mirror_to_archive()  # poisons backed off -> goods mirror
+    mirrored = {r["id"] for r in ar.search(status=list(J.OPEN_STATUSES),
+                                           limit=100)}
+    assert {"good1", "good2"} <= mirrored
+    assert store.mirror_backed_off_docs() >= JobStore._MIRROR_FAIL_CAP
