@@ -98,6 +98,14 @@ class _HpaItem:
     current: Window
     is_increase: bool = True
     priority: int = 0
+    # wire isAbsolute (models.go:179-183): static SLA limit is a value on
+    # the metric's own scale vs a multiple of the healthy historical mean
+    is_absolute: bool = False
+    # ready-pod-count Window from the job's podCountURL, stamped on every
+    # item of the job by _preprocess; None = no pod data (neutral 1/1).
+    # Split into (pods_now, pods_hist) at score time against the job's own
+    # current-window boundary (_pod_count_stats).
+    pod_window: object = None
 
 
 def _concat_trimmed(hist: Window, cur: Window):
@@ -145,6 +153,30 @@ def _concat_ts(cur: Window, n_h: int, j: int) -> float:
     tail-kept, current head-kept — _concat_trimmed/_joint_grid invariant).
     """
     return float(cur.start + (j - n_h) * cur.step)
+
+
+def _pod_count_stats(win, split_ts: float):
+    """(pods_now, pods_hist) from a ready-pod-count Window, or None.
+
+    `split_ts` is the start of the job's CURRENT (scoring) window, so the
+    recent/older split aligns exactly with the region the demand estimate
+    covers and the history the capacity proxy averages — no second copy
+    of the materialization-window constant. Single-sided data falls back
+    to the other side so a short fetch still normalizes consistently
+    rather than mixing a real pods_now with a fabricated pods_hist.
+    """
+    if win is None or win.n_valid == 0:
+        return None
+    t = win.start + np.arange(win.values.shape[0]) * win.step
+    recent = win.mask & (t >= split_ts)
+    older = win.mask & ~recent
+    n_now = float(win.values[recent].mean()) if recent.any() else None
+    n_hist = float(win.values[older].mean()) if older.any() else None
+    if n_now is None and n_hist is None:
+        return None
+    n_now = n_hist if n_now is None else n_now
+    n_hist = n_now if n_hist is None else n_hist
+    return (max(n_now, 1e-6), max(n_hist, 1e-6))
 
 
 @dataclass
@@ -223,6 +255,17 @@ class Analyzer:
         not matching its family's metric count) scores univariate bands."""
         pairs, bands, bis, multis, hpas = [], [], [], [], []
         candidates = []  # (name, hist, cur, policy) judgeable by history
+        pod_window = None
+        if doc.strategy == STRATEGY_HPA and doc.pod_count_url:
+            # podCountURL (metricsquery.go:149-169): ready-pod counts over
+            # the job window, fetched once per job and folded into a true
+            # per-pod score (see ops.hpa.hpa_scores pods_now/pods_hist).
+            # Best-effort: a missing count series degrades to the
+            # aggregate score, never fails the job.
+            try:
+                pod_window = self._fetch_window(doc.pod_count_url, now)
+            except FetchError:
+                pod_window = None
         for name, mq in doc.metrics.items():
             policy = self.config.policy_for(name)
             cur = self._fetch_window(mq.current, now)
@@ -235,7 +278,8 @@ class Analyzer:
             if doc.strategy == STRATEGY_HPA:
                 if hist is not None:
                     hpas.append(
-                        _HpaItem(doc.id, name, hist, cur, mq.is_increase, mq.priority)
+                        _HpaItem(doc.id, name, hist, cur, mq.is_increase,
+                                 mq.priority, mq.is_absolute, pod_window)
                     )
                 continue
             if base is not None and base.n_valid > 0:
@@ -954,7 +998,45 @@ class Analyzer:
         sv, sm = pack_windows(list(sla_w), pad_to=T)
         reg = np.stack(list(regions))
 
-        def hpa_fn(tv_c, tm_c, reg_c, sv_c, sm_c):
+        # per-job SLA criteria (dynamic_autoscaling.md:45-56): mode from
+        # ML_SLA_MODE, limit from the SLA metric's policy (sla_limit{N})
+        # falling back to ML_SLA_LIMIT; a static/min mode with no limit
+        # configured degrades to dynamic (there is nothing static to hold
+        # the metric against), never to a fake 1e9 "static" limit that
+        # would make SLA_MIN collapse to dynamic silently.
+        mode_cfg = {"static": hpa_ops.SLA_STATIC, "min": hpa_ops.SLA_MIN}.get(
+            self.config.sla_mode, hpa_ops.SLA_DYNAMIC)
+        limits = np.empty(len(rows), np.float32)
+        modes = np.empty(len(rows), np.int32)
+        absolutes = np.empty(len(rows), bool)
+        pods_now = np.ones(len(rows), np.float32)
+        pods_hist = np.ones(len(rows), np.float32)
+        had_pods = [False] * len(rows)
+        for i, (_job_id, tps_it, sla_it) in enumerate(rows):
+            lim = self.config.policy_for(sla_it.metric).sla_limit
+            if lim <= 0.0:
+                lim = self.config.sla_limit
+            if lim <= 0.0:
+                limits[i], modes[i] = 1e9, hpa_ops.SLA_DYNAMIC
+            else:
+                limits[i], modes[i] = lim, mode_cfg
+            # limit interpretation: ABSOLUTE (the deploy convention quotes
+            # latency SLAs in ms) unless the operator opts the fleet into
+            # relative limits (ML_SLA_LIMIT_RELATIVE); a wire
+            # isAbsolute=true still pins that metric absolute under the
+            # relative default. The bare wire default (false) must NOT
+            # silently turn ML_SLA_LIMIT=250ms into 250*mean.
+            absolutes[i] = (sla_it.is_absolute
+                            or not self.config.sla_limit_relative)
+            # pod counts split at the job's own current-window boundary —
+            # the exact region/history split the demand and capacity use
+            pc = _pod_count_stats(tps_it.pod_window, tps_it.current.start)
+            if pc is not None:
+                pods_now[i], pods_hist[i] = pc
+                had_pods[i] = True
+
+        def hpa_fn(tv_c, tm_c, reg_c, sv_c, sm_c, lim_c, mode_c, abs_c,
+                   pn_c, ph_c):
             n = tv_c.shape[0]
             hist_mask = tm_c & ~reg_c
             preds = np.asarray(
@@ -963,13 +1045,17 @@ class Analyzer:
             sigma = np.asarray(fc.residual_sigma(tv_c, preds, hist_mask, ~reg_c))
             return hpa_ops.hpa_scores(
                 tv_c, tm_c, reg_c, preds, sigma, sv_c, sm_c,
-                np.full(n, 1e9, np.float32),  # static SLA unset -> huge
-                np.full(n, hpa_ops.SLA_DYNAMIC, np.int32),
+                lim_c, mode_c,
                 np.full(n, self.config.threshold, np.float32),
                 np.full(n, self.config.sla_headroom_safe, np.float32),
+                pods_now=pn_c, pods_hist=ph_c, sla_absolute=abs_c,
             )
 
-        res = self._score_chunks(hpa_fn, [tv, tm, reg, sv, sm])
+        res = self._score_chunks(
+            hpa_fn,
+            [tv, tm, reg, sv, sm, limits, modes, absolutes,
+             pods_now, pods_hist],
+        )
         for i, (job_id, tps_it, sla_it) in enumerate(rows):
             out[job_id] = {
                 "raw_score": float(res["score"][i]),
@@ -981,6 +1067,9 @@ class Analyzer:
                 "lower": float(res["tps_lower"][i]),
                 "sla_current": float(res["sla_current"][i]),
                 "sla_limit": float(res["sla_limit"][i]),
+                "pods_now": float(res["pods_now"][i]),
+                "demand_per_pod": float(res["demand_per_pod"][i]),
+                "has_pod_data": had_pods[i],
             }
         return out
 
@@ -1216,6 +1305,16 @@ class Analyzer:
             f"hpa score {gated:.1f} (raw {res['raw_score']:.1f}) via "
             f"{reason_names.get(res['reason_code'], '?')} on {res['tps_metric']}"
         )
+        if res.get("has_pod_data"):
+            # per-pod normalization context rides the FREE-FORM reason;
+            # details stay strictly {current, upper, lower} band entries —
+            # letter templating and wire consumers (models.go:194-209)
+            # format every detail as a metric-vs-band sentence, which a
+            # replicas-vs-demand tuple would turn into nonsense
+            reason += (
+                f" [per-pod: {res['pods_now']:.1f} pods, "
+                f"demand/pod {res['demand_per_pod']:.1f}]"
+            )
         self.store.add_hpalog(
             J.HpaLog(
                 job_id=doc.id,

@@ -20,6 +20,11 @@ class MetricPolicy:
     threshold: float = 2.0  # band half-width in sigmas
     bound: int = 1  # bitmask: 1 upper, 2 lower, 3 both
     min_lower_bound: float = 0.0
+    # static SLA limit when this metric plays the HPA reward role
+    # (docs/dynamic_autoscaling.md:45-56); 0 = unset, inherit ML_SLA_LIMIT.
+    # Interpreted per the metric's wire isAbsolute flag: absolute value on
+    # the metric's scale, or a multiple of the healthy historical mean.
+    sla_limit: float = 0.0
 
 
 # deployed defaults (foremast-brain.yaml:34-73)
@@ -107,6 +112,20 @@ class EngineConfig:
     # utilization scale-down is fully model-driven; between it and 1.0 the
     # reward ramps scale-down off (ops/hpa.py reward-shaping block)
     sla_headroom_safe: float = 0.7
+    # SLA criteria mode for the HPA reward (ML_SLA_MODE; reference
+    # dynamic_autoscaling.md:45-56): "static" fixed limit, "dynamic"
+    # mean+3sigma of healthy history, "min" = min of both. Static modes
+    # need a limit (ML_SLA_LIMIT or per-metric sla_limit{N}); a static
+    # mode with no limit configured degrades to dynamic for that job.
+    sla_mode: str = "dynamic"  # ML_SLA_MODE
+    sla_limit: float = 0.0  # ML_SLA_LIMIT (0 = unset)
+    # limit interpretation default: False = limits are ABSOLUTE values on
+    # the metric's scale (latency ms — the deploy convention); True =
+    # un-flagged metrics read the limit as a multiple of the healthy
+    # historical mean. A wire isAbsolute=true always pins that metric
+    # absolute. Guards ML_SLA_LIMIT=250(ms) from silently becoming
+    # 250*mean under the wire flag's bare default.
+    sla_limit_relative: bool = False  # ML_SLA_LIMIT_RELATIVE
     policies: dict = field(default_factory=lambda: dict(DEFAULT_POLICIES))
 
     def policy_for(self, metric_name: str) -> MetricPolicy:
@@ -191,6 +210,7 @@ def from_env(env=None) -> EngineConfig:
             threshold=_env_float(env, f"threshold{i}", base.threshold),
             bound=_env_int(env, f"bound{i}", base.bound),
             min_lower_bound=_env_float(env, f"min_lower_bound{i}", base.min_lower_bound),
+            sla_limit=_env_float(env, f"sla_limit{i}", 0.0),
         )
     return EngineConfig(
         algorithm=env.get("ML_ALGORITHM", "moving_average_all"),
@@ -227,5 +247,8 @@ def from_env(env=None) -> EngineConfig:
         lstm_max_train_per_cycle=_env_int(env, "LSTM_MAX_TRAIN_PER_CYCLE", 8),
         multimetric_auto=_env_bool(env, "ML_MULTIMETRIC_AUTO", True),
         sla_headroom_safe=_env_float(env, "SLA_HEADROOM_SAFE", 0.7),
+        sla_mode=env.get("ML_SLA_MODE", "dynamic").strip().lower(),
+        sla_limit=_env_float(env, "ML_SLA_LIMIT", 0.0),
+        sla_limit_relative=_env_bool(env, "ML_SLA_LIMIT_RELATIVE", False),
         policies=policies,
     )

@@ -674,14 +674,16 @@ class JobStore:
                     # head-of-line-block the fleet's failover mirror; a
                     # genuinely dead archive still short-circuits via the
                     # consecutive-failure cap instead of burning the batch.
-                    # TERMINAL docs cap near the flush cadence, not 300 s:
-                    # until the terminal record lands, the archive's newest
-                    # state is a stale open mirror a peer could adopt after
-                    # the outage heals — that window must stay ~one flush,
-                    # while still rotating a poisoned terminal doc out of
-                    # the head of the cut.
+                    # Caps stay far below the adoption threshold
+                    # (max_stuck 90 s + skew margin): a doc parked past it
+                    # after an outage heals would leave its last-mirrored
+                    # lease stamp stale enough for a healthy peer to adopt
+                    # the LIVE owner's job (open docs), or leave a stale
+                    # open mirror shadowing an unlanded terminal record
+                    # (terminal docs). 30 s/10 s still rotate poisoned
+                    # docs out of the head of the cut (flush cadence ~1 s).
                     self.mirror_failures_total += 1
-                    cap = 300.0 if doc.status in OPEN_STATUSES else 10.0
+                    cap = 30.0 if doc.status in OPEN_STATUSES else 10.0
                     delay = min(
                         self._mirror_backoff.get(doc.id, (0.0, 2.5))[1] * 2,
                         cap)

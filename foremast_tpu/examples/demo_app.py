@@ -348,10 +348,17 @@ def run_demo_hpa(cycles: int = 4, now: float | None = None) -> dict:
     cpu_series = list(0.5 + surge / 500.0 + rng.normal(0, 0.02, T))
     lat_series = list(rng.normal(80.0, 5.0, T))
 
+    # ready replicas held at 2 through the surge: per-pod demand rises
+    # with the traffic, so the per-pod score tells the same scale-up story
+    # — while proving the podCountURL path is consumed end-to-end
+    pods_series = [2.0] * T
+
     def resolve(url: str):
         from urllib.parse import unquote
 
         q = unquote(url)
+        if "ready_count" in q:
+            return ts, pods_series
         if "tps" in q:
             return ts, tps_series
         if "latency" in q:
@@ -431,6 +438,11 @@ def run_demo_hpa(cycles: int = 4, now: float | None = None) -> dict:
         "score_series_exported": any(
             s[0] == "foremastbrain:namespace_app_per_pod:hpa_score"
             for s in exporter.samples()
+        ),
+        # per-pod normalization active: the podCountURL the operator built
+        # was fetched and folded into the score (per-pod reason context)
+        "per_pod_normalized": any(
+            "per-pod" in log.reason for log in store.hpalogs_for(job_id)
         ),
     }
 

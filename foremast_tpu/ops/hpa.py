@@ -83,6 +83,9 @@ def hpa_scores(
     sla_mode,
     threshold,
     sla_safe_fraction=None,
+    pods_now=None,
+    pods_hist=None,
+    sla_absolute=None,
 ):
     """Compute fleet HPA scores.
 
@@ -97,12 +100,36 @@ def hpa_scores(
       tps_sigma:  (B,) residual scale of the forecaster on history.
       sla:        (B, T) SLA metric series (latency).
       sla_mask:   (B, T) validity.
-      sla_static_limit: (B,) static SLA limit per service.
-      sla_mode:   (B,) int32 — SLA_STATIC / SLA_DYNAMIC / SLA_MIN.
+      sla_static_limit: (B,) static SLA limit per service (see sla_absolute
+                  for how it is interpreted). Callers pass a huge sentinel
+                  (1e9) when no limit is configured — with SLA_DYNAMIC mode
+                  it is simply unused.
+      sla_mode:   (B,) int32 — SLA_STATIC / SLA_DYNAMIC / SLA_MIN
+                  (docs/dynamic_autoscaling.md:45-56: static criteria,
+                  3-sigma dynamic criteria, or min of both).
       threshold:  (B,) band half-width in sigmas for the traffic band.
       sla_safe_fraction: (B,) optional — the SLA utilization below which
                   scale-down is fully model-driven (default 0.7); between
                   it and 1.0 the reward ramps scale-down off (see below).
+      pods_now:   (B,) optional — ready-pod count over the scoring window
+                  (from the job's podCountURL, metricsquery.go:149-169).
+                  With pods_hist it normalizes the score to a true PER-POD
+                  ratio: demand the fleet already absorbed by scaling up
+                  does not re-trigger a scale-up. Default 1.0 (per-pod ==
+                  aggregate, the no-pod-data degenerate).
+      pods_hist:  (B,) optional — mean ready-pod count over the history the
+                  capacity proxy is computed from. Default 1.0.
+      sla_absolute: (B,) optional bool — True: sla_static_limit is an
+                  absolute value on the metric's own scale (latency ms).
+                  False: it is RELATIVE — a multiple of the healthy
+                  historical mean (e.g. 1.5 = "violated at 1.5x normal").
+                  Omitted (None) = all-absolute. The ENGINE resolves this
+                  per row from the wire isAbsolute flag
+                  (models.go:179-183) and ML_SLA_LIMIT_RELATIVE — the
+                  wire flag's bare default (false) maps to ABSOLUTE
+                  unless the operator opts the fleet into relative
+                  limits, so an ms-quoted ML_SLA_LIMIT can never be
+                  silently multiplied by the mean (analyzer._score_hpa).
 
     Returns dict:
       score:  (B,) float in [0, 100] — 50 = keep replicas.
@@ -111,6 +138,8 @@ def hpa_scores(
       sla_current, sla_limit: (B,).
       tps_upper, tps_lower: (B,) — band means over the region (for hpalogs
       details {current, upper, lower} per models.go:194-209 semantics).
+      demand_per_pod: (B,) — demand / pods_now, the quantity the
+      namespace_app_per_pod:hpa_score series name promises.
     """
     thr = threshold[:, None] * tps_sigma[:, None]
     upper = tps_pred + thr
@@ -137,9 +166,20 @@ def hpa_scores(
     demand = jnp.maximum(jnp.where(anomalous, anomaly_demand, pred_mean), 0.0)
 
     # capacity proxy: the historical traffic level the current replica count
-    # was provisioned for. score = 50 * demand/provisioned is then exactly
-    # "50 * pods-needed / pods-present" under throughput-proportional pods.
+    # was provisioned for. Without pod counts, score = 50*demand/provisioned
+    # is "50 * pods-needed / pods-present" only under throughput-
+    # proportional pods AND an unchanged replica count; with podCountURL
+    # data both sides normalize to PER-POD quantities, so demand already
+    # absorbed by a prior scale-up reads as per-pod-neutral (score 50)
+    # instead of re-triggering — the reason the reference ships the pod
+    # count query separately (metricsquery.go:149-169).
     provisioned = _masked_mean(tps, tps_mask & ~region)
+    p_now = (jnp.ones_like(provisioned) if pods_now is None
+             else jnp.maximum(pods_now.astype(_F), 1e-6))
+    p_hist = (jnp.ones_like(provisioned) if pods_hist is None
+              else jnp.maximum(pods_hist.astype(_F), 1e-6))
+    demand_per_pod = demand / p_now
+    capacity_per_pod = provisioned / p_hist
 
     # SLA reward: limit per configured mode; violation forces scale-up bias.
     hist_sel = sla_mask & ~region
@@ -150,13 +190,21 @@ def hpa_scores(
         )
     )
     dyn_limit = sla_mu + 3.0 * sla_sd
+    # isAbsolute=False: the configured limit is a multiple of the healthy
+    # historical mean, not a value on the metric's own scale
+    static_eff = (
+        sla_static_limit
+        if sla_absolute is None
+        else jnp.where(sla_absolute, sla_static_limit,
+                       sla_static_limit * sla_mu)
+    )
     limit = jnp.where(
         sla_mode == SLA_STATIC,
-        sla_static_limit,
+        static_eff,
         jnp.where(
             sla_mode == SLA_DYNAMIC,
             dyn_limit,
-            jnp.minimum(sla_static_limit, dyn_limit),
+            jnp.minimum(static_eff, dyn_limit),
         ),
     )
     sla_current = _masked_mean(sla, sla_mask & region)
@@ -181,7 +229,7 @@ def hpa_scores(
         else sla_safe_fraction.astype(_F)
     )
     h = sla_current / jnp.maximum(limit, 1e-9)
-    base = 50.0 * demand / jnp.maximum(provisioned, 1e-6)
+    base = 50.0 * demand_per_pod / jnp.maximum(capacity_per_pod, 1e-6)
     w = jnp.clip((1.0 - h) / jnp.maximum(1.0 - safe, 1e-6), 0.0, 1.0)
     shaped = jnp.where(base < 50.0, 50.0 - (50.0 - base) * w, base)
     viol_floor = 75.0 + 25.0 * jnp.clip(h - 1.0, 0.0, 1.0)
@@ -203,6 +251,8 @@ def hpa_scores(
         "score": score,
         "reason": reason,
         "demand": demand,
+        "demand_per_pod": demand_per_pod,
+        "pods_now": p_now,
         "current_tps": current_tps,
         "sla_current": sla_current,
         "sla_limit": limit,

@@ -178,6 +178,15 @@ def build_document(req: dict) -> Document:
                 },
             }
         )
+    # continuous/hpa jobs re-materialize their windows every cycle; the
+    # pod-count query must ride along (a concrete start/end stamped at
+    # create time would go stale after the first cycle and freeze the
+    # per-pod normalization at day-one replica counts). historical=True:
+    # per-pod scoring needs the replica history the capacity proxy spans,
+    # not just the scoring window.
+    pod_count_url = req.get("podCountURL", "")
+    if continuous and pod_count_url:
+        pod_count_url = placeholderize(pod_count_url, historical=True)
     return Document(
         id=job_id,
         app_name=app,
@@ -186,7 +195,7 @@ def build_document(req: dict) -> Document:
         start_time=start_time,
         end_time=end_time,
         metrics=metrics,
-        pod_count_url=req.get("podCountURL", ""),
+        pod_count_url=pod_count_url,
     )
 
 

@@ -809,3 +809,157 @@ def test_lstm_same_app_jobs_share_one_training_slot():
     assert analyzer._lstm_trained_this_cycle == 1
     # all three jobs were judged (healthy requeue), none starved
     assert all(s == J.INITIAL for s in out.values()), out
+
+
+# ------------------------- VERDICT r04 #2: HPA SLA modes + per-pod scoring
+def _mk_hpa_job(store, fixtures, job_id, *, tps_current=240.0,
+                sla_current=5.0, pods=None, rng=None, sla_absolute=True):
+    """HPA job: history ~100 tps / ~5 latency; current window overridable;
+    optional pod-count series (hist_pods -> now_pods)."""
+    rng = rng or np.random.default_rng(5)
+    # production-shaped windows: the current URL covers ONLY the trailing
+    # scoring window, the historical URL the 90-step history before it —
+    # the per-pod recent/older split keys off current.start, so a
+    # current window spanning the whole series would wash it out
+    hist_ts, hist_v = _series(rng, 100.0, 90, spread=3.0)
+    cur_ts = [hist_ts[-1] + STEP + t for t in np.arange(30) * STEP]
+    cur_url = f"http://prom/{job_id}/tps_cur"
+    hist_url = f"http://prom/{job_id}/tps_hist"
+    fixtures[hist_url] = (hist_ts, hist_v)
+    fixtures[cur_url] = (cur_ts, rng.normal(tps_current, 5, 30).tolist())
+    s_ts, s_v = _series(rng, 5.0, 90, spread=0.3)
+    sla_cur_url = f"http://prom/{job_id}/sla_cur"
+    sla_hist_url = f"http://prom/{job_id}/sla_hist"
+    fixtures[sla_hist_url] = (s_ts, s_v)
+    fixtures[sla_cur_url] = (cur_ts,
+                             rng.normal(sla_current, 0.3, 30).tolist())
+    pod_url = ""
+    if pods is not None:
+        hist_pods, now_pods = pods
+        pod_url = f"http://prom/{job_id}/pods"
+        fixtures[pod_url] = (hist_ts + cur_ts,
+                            [hist_pods] * 90 + [now_pods] * 30)
+    doc = Document(
+        id=job_id, app_name=job_id, namespace="demo", strategy="hpa",
+        start_time="START_TIME", end_time="END_TIME",
+        metrics={
+            "tps": MetricQueries(historical=hist_url, current=cur_url,
+                                 priority=0),
+            "latency": MetricQueries(historical=sla_hist_url,
+                                     current=sla_cur_url,
+                                     priority=1, is_absolute=sla_absolute),
+        },
+        pod_count_url=pod_url,
+    )
+    store.create(doc)
+    return float(cur_ts[-1]) + STEP  # a "now" placing the last 30min window
+
+
+def _raw_score(store, job_id):
+    import re
+
+    logs = store.hpalogs_for(job_id)
+    m = re.search(r"raw ([0-9.]+)", logs[0].reason)
+    return float(m.group(1))
+
+
+def test_hpa_per_pod_score_absorbs_taken_scaleups():
+    """podCountURL consumed (VERDICT r04 missing #3): traffic 2.4x with
+    replicas already scaled 4->9.6 reads per-pod-neutral (~50); the same
+    traffic with no pod data reads as a surge (>65)."""
+    fixtures, store = {}, JobStore()
+    now = _mk_hpa_job(store, fixtures, "nopods:demo:hpa")
+    _mk_hpa_job(store, fixtures, "pods:demo:hpa", pods=(4.0, 9.6))
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    analyzer.run_cycle(now=now)
+    assert _raw_score(store, "nopods:demo:hpa") > 65
+    assert 35 <= _raw_score(store, "pods:demo:hpa") <= 65
+    # the reason records the replica count + per-pod demand it used; the
+    # details list stays strictly band-shaped {current, upper, lower} so
+    # letter templating and wire consumers never render a replicas-vs-
+    # demand tuple as a metric band (models.go:194-209)
+    podded = store.hpalogs_for("pods:demo:hpa")[0]
+    assert "[per-pod: 9.6 pods" in podded.reason
+    assert {d["metricType"] for d in podded.details} == {"tps", "latency"}
+    # and the no-pod job logs no per-pod context (nothing fabricated)
+    assert "per-pod" not in store.hpalogs_for("nopods:demo:hpa")[0].reason
+
+
+def test_hpa_sla_mode_static_env_plumbed():
+    """ML_SLA_MODE=static + ML_SLA_LIMIT below the healthy latency level
+    forces the SLA-violation scale-up path; the same data under the
+    default dynamic mode stays trend-driven (limit ~ mean+3sigma)."""
+    from foremast_tpu.engine.config import from_env
+
+    fixtures, store = {}, JobStore()
+    now = _mk_hpa_job(store, fixtures, "app:demo:hpa", tps_current=100.0)
+    cfg = from_env({"ML_SLA_MODE": "static", "ML_SLA_LIMIT": "3.0"})
+    assert cfg.sla_mode == "static" and cfg.sla_limit == 3.0
+    analyzer = Analyzer(cfg, FixtureDataSource(fixtures), store)
+    analyzer.run_cycle(now=now)
+    assert "SLA violation" in store.hpalogs_for("app:demo:hpa")[0].reason
+
+    store2 = JobStore()
+    fixtures2 = {}
+    now2 = _mk_hpa_job(store2, fixtures2, "app:demo:hpa", tps_current=100.0)
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures2), store2)
+    analyzer.run_cycle(now=now2)
+    assert "SLA violation" not in store2.hpalogs_for("app:demo:hpa")[0].reason
+
+
+def test_hpa_static_mode_without_limit_degrades_to_dynamic():
+    """A static/min mode with no limit configured anywhere must not
+    invent one: the job scores under the dynamic criteria instead."""
+    fixtures, store = {}, JobStore()
+    now = _mk_hpa_job(store, fixtures, "app:demo:hpa", tps_current=100.0)
+    analyzer = Analyzer(EngineConfig(sla_mode="static"),
+                        FixtureDataSource(fixtures), store)
+    analyzer.run_cycle(now=now)
+    logs = store.hpalogs_for("app:demo:hpa")
+    assert logs and "SLA violation" not in logs[0].reason
+    # dynamic limit ~ mean+3sigma of healthy history (~5 +- 0.3) -> single
+    # digits, not a 1e9 sentinel leaking into the log details
+    sla_detail = [d for d in logs[0].details if d["metricType"] == "latency"]
+    assert sla_detail and sla_detail[0]["upper"] < 100
+
+
+def test_per_metric_sla_limit_env_override():
+    from foremast_tpu.engine.config import from_env
+
+    cfg = from_env({
+        "metric_type_threshold_count": "1",
+        "metric_type0": "latency",
+        "sla_limit0": "250",
+        "ML_SLA_MODE": "min",
+    })
+    assert cfg.policy_for("namespace_app_pod_latency").sla_limit == 250.0
+    assert cfg.policy_for("error5xx").sla_limit == 0.0
+
+
+def test_relative_sla_limit_requires_explicit_opt_in():
+    """ML_SLA_LIMIT=250 quoted in ms must stay absolute under the wire
+    isAbsolute flag's bare default (false); ML_SLA_LIMIT_RELATIVE=1 opts
+    the fleet into the multiple-of-mean reading (limit 3x mean ~5 -> ~15,
+    healthy ~5 passes; absolute 3.0 would violate — asserted above)."""
+    from foremast_tpu.engine.config import from_env
+
+    fixtures, store = {}, JobStore()
+    now = _mk_hpa_job(store, fixtures, "app:demo:hpa", tps_current=100.0)
+    cfg = from_env({"ML_SLA_MODE": "static", "ML_SLA_LIMIT": "250"})
+    analyzer = Analyzer(cfg, FixtureDataSource(fixtures), store)
+    analyzer.run_cycle(now=now)
+    logs = store.hpalogs_for("app:demo:hpa")
+    sla_detail = [d for d in logs[0].details if d["metricType"] == "latency"]
+    assert abs(sla_detail[0]["upper"] - 250.0) < 1e-3  # absolute, not 250*mean
+
+    fixtures2, store2 = {}, JobStore()
+    now2 = _mk_hpa_job(store2, fixtures2, "app:demo:hpa", tps_current=100.0,
+                       sla_absolute=False)  # un-flagged on the wire
+    cfg = from_env({"ML_SLA_MODE": "static", "ML_SLA_LIMIT": "3.0",
+                    "ML_SLA_LIMIT_RELATIVE": "1"})
+    analyzer = Analyzer(cfg, FixtureDataSource(fixtures2), store2)
+    analyzer.run_cycle(now=now2)
+    logs = store2.hpalogs_for("app:demo:hpa")
+    assert "SLA violation" not in logs[0].reason  # 3x mean ~15 > current ~5
+    sla_detail = [d for d in logs[0].details if d["metricType"] == "latency"]
+    assert 10 < sla_detail[0]["upper"] < 20

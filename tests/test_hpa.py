@@ -182,3 +182,54 @@ def test_analyzer_hydrates_breath_from_store(tmp_path):
     assert eng2.breath._since == {"app/ns": (1, 2000.0)}
     # held >= breath_up_s since the pre-restart arm: signal passes
     assert eng2.breath.apply("app/ns", 80.0, now=2130.0) == 80.0
+
+
+# ------------------- VERDICT r04 #2: SLA modes / isAbsolute / per-pod score
+def test_sla_min_mode_takes_tighter_of_static_and_dynamic():
+    """SLA_MIN (dynamic_autoscaling.md:45-56 'Min of above two'): history
+    sigma ~0.5 at mean ~5 gives dyn_limit ~6.5; static 50 -> min is the
+    dynamic one. With static 3 (below dynamic), min is the static one and
+    the healthy-history SLA of ~5 violates it."""
+    kw = _setup(100, sla_current=5.0)
+    kw["sla_mode"] = np.int32([hpa.SLA_MIN])
+    out = hpa.hpa_scores(**kw)
+    assert float(out["sla_limit"][0]) < 10  # dynamic won over static=50
+    kw["sla_static_limit"] = np.float32([3.0])
+    out = hpa.hpa_scores(**kw)
+    assert abs(float(out["sla_limit"][0]) - 3.0) < 1e-5  # static won
+    assert int(out["reason"][0]) == hpa.REASON_SLA_VIOLATION
+
+
+def test_relative_sla_limit_scales_with_history_mean():
+    """isAbsolute=False (models.go:179-183): the static limit is a
+    MULTIPLE of the healthy historical mean (~5), so 1.5 means 'violated
+    at 1.5x normal' -> effective limit ~7.5."""
+    kw = _setup(100, sla_current=5.0)
+    kw["sla_static_limit"] = np.float32([1.5])
+    kw["sla_absolute"] = np.array([False])
+    out = hpa.hpa_scores(**kw)
+    assert 6.5 < float(out["sla_limit"][0]) < 8.5
+    # same limit value taken absolutely = 1.5 latency units: violated
+    kw["sla_absolute"] = np.array([True])
+    out = hpa.hpa_scores(**kw)
+    assert int(out["reason"][0]) == hpa.REASON_SLA_VIOLATION
+
+
+def test_per_pod_normalization_absorbs_taken_scaleups():
+    """Traffic 2x BUT replicas already 2x (podCountURL): per-pod demand is
+    unchanged -> score ~50, no re-trigger. Without pod data the same
+    traffic reads as a 2x surge -> strong scale-up. This is why the
+    reference ships the pod-count query (metricsquery.go:149-169)."""
+    kw = _setup(200)  # current traffic 2x the provisioned level
+    out_no_pods = hpa.hpa_scores(**kw)
+    assert float(out_no_pods["score"][0]) > 65
+    kw["pods_now"] = np.float32([8.0])
+    kw["pods_hist"] = np.float32([4.0])
+    out = hpa.hpa_scores(**kw)
+    assert 35 <= float(out["score"][0]) <= 65
+    assert abs(float(out["pods_now"][0]) - 8.0) < 1e-6
+    # and pods constant while traffic doubles still scales up
+    kw["pods_now"] = np.float32([4.0])
+    out = hpa.hpa_scores(**kw)
+    assert float(out["score"][0]) > 65
+    assert float(out["demand_per_pod"][0]) > 40  # ~200/4

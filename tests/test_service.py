@@ -116,11 +116,17 @@ def test_hpa_job_id_and_placeholders():
             "historical": {"tps": {"url": "http://prom/api?start=1&end=2&step=60"}},
         },
     }
+    body["podCountURL"] = "http://prom/api?query=ready&start=1000&end=2000&step=60"
     doc = build_document(body)
     assert doc.id == "shop:prod:hpa"
     assert "start=START_TIME&end=END_TIME" in doc.metrics["tps"].current
     assert "start=START_TIME_H" in doc.metrics["tps"].historical
     assert doc.start_time == "START_TIME"
+    # the pod-count query re-materializes per cycle like the metric URLs
+    # (a create-time window would freeze per-pod scoring at day-one
+    # replica counts) and spans the capacity-proxy history (_H)
+    assert "start=START_TIME_H" in doc.pod_count_url
+    assert "end=END_TIME" in doc.pod_count_url
 
 
 def test_wavefront_url_construction():
