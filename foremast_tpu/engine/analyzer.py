@@ -261,10 +261,13 @@ class Analyzer:
             # the job window, fetched once per job and folded into a true
             # per-pod score (see ops.hpa.hpa_scores pods_now/pods_hist).
             # Best-effort: a missing count series degrades to the
-            # aggregate score, never fails the job.
+            # aggregate score, never fails the job. Catches ANY failure,
+            # not just FetchError — a proxy can flatten errors to a 200
+            # with an unparseable body, and a garbage pod endpoint must
+            # not abort the cycle (prep_many only converts FetchError).
             try:
                 pod_window = self._fetch_window(doc.pod_count_url, now)
-            except FetchError:
+            except Exception:  # noqa: BLE001 - optional signal, never fatal
                 pod_window = None
         for name, mq in doc.metrics.items():
             policy = self.config.policy_for(name)
@@ -338,8 +341,11 @@ class Analyzer:
 
     # ladder continues past the default chunk so a LARGE configured
     # score_batch still pads small fleets to the nearest rung, never to
-    # the full chunk (10k rows must not pad to a 1M-row launch)
-    _BATCH_BUCKETS = (16, 64, 256, 1024, 4096, 16384, 65536)
+    # the full chunk (10k rows must not pad to a 1M-row launch). The
+    # 512 rung exists for the expensive per-row families (LSTM fleet
+    # scoring: a 500-job fleet padding to 1024 doubles the scan work;
+    # measured 6.8 s -> ~3.5 s per mixed cycle on CPU).
+    _BATCH_BUCKETS = (16, 64, 256, 512, 1024, 4096, 16384, 65536)
 
     def _bucket_rows(self, n: int) -> int:
         """Smallest batch rung >= n, capped at the configured chunk."""
@@ -693,13 +699,18 @@ class Analyzer:
                 }
         return results
 
-    def _lstm_model(self, F: int):
-        key = (F, self.config.lstm_hidden, self.config.lstm_latent)
+    def _lstm_model(self, F: int, unroll: int = 8):
+        """Module instance per (F, dims, unroll). Scoring uses unroll=8
+        (fleet-launch dispatch bound); training passes unroll=1 (the
+        unrolled fwd+bwd compiles slower and runs ~2x slower). Both share
+        one param tree shape — see LstmAutoencoder.unroll."""
+        key = (F, self.config.lstm_hidden, self.config.lstm_latent, unroll)
         if key not in self._lstm_models:
             self._lstm_models[key] = lstm_ae.LstmAutoencoder(
                 hidden=self.config.lstm_hidden,
                 latent=self.config.lstm_latent,
                 features=F,
+                unroll=unroll,
             )
         return self._lstm_models[key]
 
@@ -828,7 +839,7 @@ class Analyzer:
             return (state.params, float(mu_), float(sd_))
 
         for (k, W, F), recs in groups.items():
-            model = self._lstm_model(F)
+            model = self._lstm_model(F, unroll=1)  # training: no unroll
             with tracing.span("engine.lstm_train", jobs=len(recs),
                               features=F, window=W):
                 trained: list

@@ -963,3 +963,17 @@ def test_relative_sla_limit_requires_explicit_opt_in():
     assert "SLA violation" not in logs[0].reason  # 3x mean ~15 > current ~5
     sla_detail = [d for d in logs[0].details if d["metricType"] == "latency"]
     assert 10 < sla_detail[0]["upper"] < 20
+
+
+def test_garbage_pod_count_body_never_fails_the_job():
+    """podCountURL is an OPTIONAL signal: a proxy flattening errors to a
+    200 with an unparseable body must degrade to the aggregate score,
+    not crash preprocess for the job (or the cycle)."""
+    fixtures, store = {}, JobStore()
+    now = _mk_hpa_job(store, fixtures, "app:demo:hpa", pods=(4.0, 9.6))
+    fixtures["http://prom/app:demo:hpa/pods"] = (["<html>"], ["oops"])
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    out = analyzer.run_cycle(now=now)
+    assert out["app:demo:hpa"] == J.INITIAL  # scored + requeued
+    logs = store.hpalogs_for("app:demo:hpa")
+    assert logs and "per-pod" not in logs[0].reason  # aggregate fallback
