@@ -498,6 +498,7 @@ class Analyzer:
             period, _ = fc.detect_period(
                 xv_c, xm_c & ~reg_c, cands,
                 np.int32(fallback), np.float32(cfg.hw_min_seasonal_acf),
+                alias_margin=np.float32(cfg.hw_alias_margin),
             )
             return {"period": period}
 
@@ -542,7 +543,8 @@ class Analyzer:
             period = (period_override if period_override is not None
                       else min(self.config.hw_period, max(xv.shape[1] // 2, 2)))
             _, preds = fc.fit_seasonal_trend(
-                xv, hist_mask, hist_mask, period, self.config.st_order
+                xv, hist_mask, hist_mask, period, self.config.st_order,
+                n_changepoints=self.config.st_changepoints,
             )
         else:  # moving_average_all default
             preds = fc.moving_average_predictions(xv, hist_mask, self.config.ma_window)

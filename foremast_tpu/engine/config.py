@@ -84,7 +84,18 @@ class EngineConfig:
     hw_period_auto: bool = True  # HW_PERIOD_AUTO
     hw_period_candidates: tuple = (60, 480, 720, 1440)  # HW_PERIOD_CANDIDATES
     hw_min_seasonal_acf: float = 0.2  # HW_MIN_SEASONAL_ACF
+    # harmonic-alias margin: a shorter (fundamental-first) candidate wins
+    # when its ACF score sits within this of the best candidate's. Larger
+    # = stronger preference for the fundamental over its multiples, at
+    # the cost of letting a noisier short candidate beat a genuinely
+    # better long one (ops/forecast.py:detect_period).
+    hw_alias_margin: float = 0.05  # HW_ALIAS_MARGIN
     st_order: int = 3  # seasonal-trend (prophet) Fourier order
+    # Prophet piecewise-linear trend: hinge changepoints on a uniform grid
+    # over the first 80% of the window, L1-ish shrunk (iterated ridge) so
+    # the trend stays piecewise-sparse (ops/forecast.py:fit_seasonal_trend).
+    # 0 restores the single linear trend.
+    st_changepoints: int = 12  # ST_CHANGEPOINTS
     # LSTM-autoencoder multivariate mode (3+ metrics; faq.md:8-10)
     lstm_window: int = 32  # subwindow length (steps) per training sample
     lstm_epochs: int = 30
@@ -238,7 +249,9 @@ def from_env(env=None) -> EngineConfig:
             if p.strip()
         ),
         hw_min_seasonal_acf=_env_float(env, "HW_MIN_SEASONAL_ACF", 0.2),
+        hw_alias_margin=_env_float(env, "HW_ALIAS_MARGIN", 0.05),
         st_order=_env_int(env, "ST_ORDER", 3),
+        st_changepoints=_env_int(env, "ST_CHANGEPOINTS", 12),
         lstm_window=_env_int(env, "LSTM_WINDOW", 32),
         lstm_epochs=_env_int(env, "LSTM_EPOCHS", 30),
         lstm_hidden=_env_int(env, "LSTM_HIDDEN", 32),

@@ -223,7 +223,8 @@ holt_winters_predictions = jax.jit(
 # actually exhibit?
 # ---------------------------------------------------------------------------
 @partial(jax.jit, static_argnames=("candidates",))
-def detect_period(x, mask, candidates: tuple, fallback, min_acf):
+def detect_period(x, mask, candidates: tuple, fallback, min_acf,
+                  alias_margin=0.05):
     """Batched seasonal-period estimation over masked history.
 
     The reference models TPS "seasonality+trend" for HPA scoring
@@ -241,11 +242,18 @@ def detect_period(x, mask, candidates: tuple, fallback, min_acf):
          fleet's periods are operational ones: hour / shift / day / week);
       3. a candidate only counts when the history holds >= 2 full cycles
          of overlap support (pair count >= lag), else its score is -inf;
-      4. the FIRST candidate within a small margin of the best score wins
-         — every multiple of the true period scores just as high (lag 2p
-         realigns a p-cycle exactly), so list candidates fundamental-first
-         (ascending) and the margin rule resolves the harmonic alias
-         toward the shortest supported cycle;
+      3b. HALF-LAG CONTRAST: a candidate p is genuinely periodic only if
+         its ACF at lag p beats the ACF at lag p/2 — a true p-cycle
+         anti-aligns at the half lag, while a smooth LONGER cycle scores
+         nearly as high at p/2 as at p (lag 60 of a pure daily cycle
+         correlates at ~0.97; without this test every slow series would
+         elect the shortest candidate);
+      4. the FIRST contrast-passing candidate within a small margin of the
+         best contrast-passing score wins — every multiple of the true
+         period scores just as high (lag 2p realigns a p-cycle exactly),
+         so list candidates fundamental-first (ascending) and the margin
+         rule resolves the harmonic alias toward the shortest supported
+         cycle;
       5. fall back to `fallback` when even the best autocorrelation is
          below `min_acf` (aperiodic series keep the configured default
          rather than chasing noise).
@@ -276,11 +284,7 @@ def detect_period(x, mask, candidates: tuple, fallback, min_acf):
     icept = (sy - slope * st) / n
     d = jnp.where(mask, xf - icept[:, None] - slope[:, None] * t[None, :], 0.0)
 
-    scores = []
-    for p in candidates:
-        if not (2 <= p < T):
-            scores.append(jnp.full((B,), -jnp.inf, _F))
-            continue
+    def acf_at(p):
         w = m[:, p:] * m[:, :-p]
         lead, lag = d[:, p:], d[:, :-p]
         num = jnp.sum(w * lead * lag, -1)
@@ -289,12 +293,46 @@ def detect_period(x, mask, candidates: tuple, fallback, min_acf):
         )
         r = num / jnp.where(den == 0, 1.0, den)
         supported = jnp.sum(w, -1) >= float(p)  # >= 2 full cycles of span
-        scores.append(jnp.where(supported & (den > 0), r, -jnp.inf))
+        return jnp.where(supported & (den > 0), r, -jnp.inf)
+
+    scores, contrasts = [], []
+    for p in candidates:
+        if not (2 <= p < T):
+            scores.append(jnp.full((B,), -jnp.inf, _F))
+            contrasts.append(jnp.zeros((B,), bool))
+            continue
+        r = acf_at(p)
+        scores.append(r)
+        # half-lag contrast: a TRUE period p anti-aligns at lag p/2
+        # (ACF strongly negative there), while a smooth longer cycle
+        # scores almost as high at p/2 as at p — plain lag-p ACF alone
+        # would let any slow series elect the shortest candidate (lag 60
+        # of a pure daily cycle correlates at cos(2*pi*60/1440) ~ 0.97).
+        # The comparison carries a small tolerance: a series whose true
+        # period divides BOTH p and p/2 (e.g. period 30 under candidate
+        # 60) realigns exactly at both lags — r(p) ~ r(p/2) to within
+        # noise — and is a harmonically VALID pick that must pass, not a
+        # per-series coin flip; only a half-lag ACF that beats lag p by
+        # MORE than the tolerance marks p as riding a smoother, longer
+        # cycle. Candidates too short for a meaningful half lag skip it.
+        contrasts.append(
+            r + 0.01 >= acf_at(p // 2) if p >= 4
+            else jnp.full((B,), True))
     S = jnp.stack(scores, axis=-1)  # (B, C)
-    best_score = jnp.max(S, axis=-1, keepdims=True)
-    # harmonic-alias resolution: first candidate within the margin wins
-    # (argmax over booleans returns the first True)
-    eligible = S >= jnp.maximum(best_score - 0.05, min_acf)
+    ok = jnp.stack(contrasts, axis=-1)  # (B, C)
+    # the margin reference is the best GENUINELY-periodic candidate: a
+    # contrast-failing harmonic's score must neither win nor crowd out
+    # the fundamental via the margin window
+    best_score = jnp.max(jnp.where(ok, S, -jnp.inf), axis=-1, keepdims=True)
+    # harmonic-alias resolution: candidates are ordered fundamental-first
+    # (ascending), and a multiple of the true period scores (nearly) as
+    # high as the fundamental itself, so the FIRST candidate within
+    # `alias_margin` of the best score wins (argmax over booleans returns
+    # the first True). The margin trades alias robustness against
+    # fundamental fidelity: larger values let a slightly-noisier short
+    # candidate beat a genuinely better long one; tune via
+    # HW_ALIAS_MARGIN (engine) when candidate ACFs sit close together.
+    eligible = ok & (S >= jnp.maximum(best_score - alias_margin, min_acf))
     pick = jnp.argmax(eligible, axis=-1)
     cand = jnp.asarray(candidates, jnp.int32)
     period = jnp.where(
@@ -358,42 +396,78 @@ def fit_holt_winters(x, mask, fit_mask, period: int, grid=None):
 # ---------------------------------------------------------------------------
 # Prophet-style decomposable model: linear trend + Fourier seasonality.
 # ---------------------------------------------------------------------------
-@partial(jax.jit, static_argnames=("period", "order"))
+@partial(jax.jit,
+         static_argnames=("period", "order", "n_changepoints", "l1_iters"))
 def fit_seasonal_trend(x, mask, fit_mask, period: int, order: int = 3,
-                       ridge: float = 1e-4):
+                       ridge: float = 1e-4, n_changepoints: int = 0,
+                       cp_shrink: float = 3e-3, l1_iters: int = 3):
     """Fit trend+seasonality per series by masked ridge least squares.
 
     The reference brain's menu lists Prophet for single-metric forecasting
     (docs/guides/design.md:53-88). Prophet's core is a decomposable model
-    y(t) = g(t) + s(t): piecewise-linear trend plus a Fourier-series
-    seasonality, fit by regularized regression. This is that core, TPU-shaped:
-    one closed-form weighted least-squares solve per series — the normal
+    y(t) = g(t) + s(t): PIECEWISE-linear trend plus a Fourier-series
+    seasonality, fit by regularized regression. This is that core,
+    TPU-shaped: closed-form weighted least-squares solves — the normal
     equations are batched (B, D, D) systems that XLA maps straight onto the
     MXU, replacing Prophet's per-series Stan/L-BFGS optimizer loop.
+
+    Changepoints (n_changepoints > 0) add Prophet's defining trend
+    flexibility: hinge columns relu(t - s_j) on a uniform grid over the
+    first 80% of the window (Prophet's default changepoint_range), so the
+    trend may change slope at each s_j. Prophet shrinks the slope deltas
+    with a Laplace (L1) prior to keep the trend piecewise-SPARSE;
+    here that is an iterated ridge (iteratively reweighted least squares
+    approximation of L1: penalty_j = cp_shrink / (|delta_j| + eps),
+    `l1_iters` rounds) — each round is still one batched solve, so the
+    whole fit stays a handful of MXU launches for any fleet size.
 
     Args:
       x, mask:   (B, T) values + validity.
       fit_mask:  (B, T) bool — points whose residuals define the fit
                  (historical region).
       period:    seasonal period in steps (static).
-      order:     Fourier order K (static); D = 2 + 2K design columns.
+      order:     Fourier order K (static).
       ridge:     Tikhonov weight keeping the solve well-posed when a series
                  has few valid points or the window spans < one period.
+      n_changepoints: hinge-grid size C (static); D = 2 + C + 2K columns.
+      cp_shrink: L1-ish penalty scale on the hinge slope deltas (the
+                 analogue of 1/changepoint_prior_scale — larger = straighter
+                 trend).
+      l1_iters:  reweighting rounds (static; 1 = plain ridge on hinges).
 
     Returns (beta (B, D), preds (B, T)).
     """
     B, T = x.shape
     tn = jnp.arange(T, dtype=_F) / jnp.maximum(T - 1, 1)
     cols = [jnp.ones(T, _F), tn]
+    C = n_changepoints
+    if C > 0:
+        # grid over the first 80% of the window; none at t=0 (that slope
+        # delta would be indistinguishable from the base slope)
+        s = (jnp.arange(1, C + 1, dtype=_F) / (C + 1)) * 0.8
+        cols += [jnp.maximum(tn - sj, 0.0) for sj in s]
     w = 2.0 * jnp.pi * jnp.arange(T, dtype=_F) / period
     for k in range(1, order + 1):
         cols += [jnp.sin(k * w), jnp.cos(k * w)]
     X = jnp.stack(cols, axis=-1)  # (T, D)
     D = X.shape[-1]
     sel = (mask & fit_mask).astype(_F)  # (B, T)
-    A = jnp.einsum("td,te,bt->bde", X, X, sel) + ridge * jnp.eye(D, dtype=_F)
+    G = jnp.einsum("td,te,bt->bde", X, X, sel)  # (B, D, D) gram
     rhs = jnp.einsum("td,bt->bd", X, sel * x.astype(_F))
-    beta = jnp.linalg.solve(A, rhs[..., None])[..., 0]  # (B, D)
+    # hinge-column indicator for the per-column penalty vector
+    is_cp = jnp.zeros(D, _F).at[2:2 + C].set(1.0) if C > 0 else jnp.zeros(D, _F)
+
+    def solve(pen):  # pen: (B, D) per-series per-column ridge weights
+        A = G + jax.vmap(jnp.diag)(pen)
+        return jnp.linalg.solve(A, rhs[..., None])[..., 0]  # (B, D)
+
+    pen0 = jnp.broadcast_to(ridge + cp_shrink * is_cp, (B, D))
+    beta = solve(pen0)
+    for _ in range(max(l1_iters - 1, 0) if C > 0 else 0):
+        # IRLS: L1 on deltas ~ ridge with weight 1/|delta| — small deltas
+        # get crushed toward 0 (sparse kinks), real kinks keep their slope
+        pen = ridge + cp_shrink * is_cp / (jnp.abs(beta) + 1e-3)
+        beta = solve(pen)
     preds = jnp.einsum("td,bd->bt", X, beta)
     return beta, preds
 
