@@ -499,6 +499,7 @@ class Analyzer:
                 xv_c, xm_c & ~reg_c, cands,
                 np.int32(fallback), np.float32(cfg.hw_min_seasonal_acf),
                 alias_margin=np.float32(cfg.hw_alias_margin),
+                contrast_margin=np.float32(cfg.hw_contrast_margin),
             )
             return {"period": period}
 

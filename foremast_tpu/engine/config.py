@@ -90,6 +90,10 @@ class EngineConfig:
     # the cost of letting a noisier short candidate beat a genuinely
     # better long one (ops/forecast.py:detect_period).
     hw_alias_margin: float = 0.05  # HW_ALIAS_MARGIN
+    # half-lag contrast slack: a candidate fails only when its half-lag
+    # ACF beats its lag-p ACF by MORE than this (ties within noise are
+    # harmonically valid picks — see ops/forecast.py:detect_period)
+    hw_contrast_margin: float = 0.01  # HW_CONTRAST_MARGIN
     st_order: int = 3  # seasonal-trend (prophet) Fourier order
     # Prophet piecewise-linear trend: hinge changepoints on a uniform grid
     # over the first 80% of the window, L1-ish shrunk (iterated ridge) so
@@ -250,6 +254,7 @@ def from_env(env=None) -> EngineConfig:
         ),
         hw_min_seasonal_acf=_env_float(env, "HW_MIN_SEASONAL_ACF", 0.2),
         hw_alias_margin=_env_float(env, "HW_ALIAS_MARGIN", 0.05),
+        hw_contrast_margin=_env_float(env, "HW_CONTRAST_MARGIN", 0.01),
         st_order=_env_int(env, "ST_ORDER", 3),
         st_changepoints=_env_int(env, "ST_CHANGEPOINTS", 12),
         lstm_window=_env_int(env, "LSTM_WINDOW", 32),

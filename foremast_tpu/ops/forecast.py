@@ -224,7 +224,7 @@ holt_winters_predictions = jax.jit(
 # ---------------------------------------------------------------------------
 @partial(jax.jit, static_argnames=("candidates",))
 def detect_period(x, mask, candidates: tuple, fallback, min_acf,
-                  alias_margin=0.05):
+                  alias_margin=0.05, contrast_margin=0.01):
     """Batched seasonal-period estimation over masked history.
 
     The reference models TPS "seasonality+trend" for HPA scoring
@@ -316,7 +316,7 @@ def detect_period(x, mask, candidates: tuple, fallback, min_acf,
         # MORE than the tolerance marks p as riding a smoother, longer
         # cycle. Candidates too short for a meaningful half lag skip it.
         contrasts.append(
-            r + 0.01 >= acf_at(p // 2) if p >= 4
+            r + contrast_margin >= acf_at(p // 2) if p >= 4
             else jnp.full((B,), True))
     S = jnp.stack(scores, axis=-1)  # (B, C)
     ok = jnp.stack(contrasts, axis=-1)  # (B, C)

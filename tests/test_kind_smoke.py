@@ -1,0 +1,231 @@
+"""Real-apiserver smoke (VERDICT r04 weak #5 / next #6).
+
+`KubeClient`'s wire coverage lives in tests/test_kube_wire.py against
+tests/fake_apiserver.py; what that cannot prove is acceptance by a REAL
+apiserver: CRD schema admission, merge-patch semantics, the /status
+subresource, RBAC'd token auth, and controller-manager-created
+ReplicaSets/Pods feeding pod-name resolution. This module proves exactly
+that, against a `kind` cluster, end to end:
+
+  1. apply deploy/crds/ (schema acceptance),
+  2. run the real OperatorLoop (KubeClient transport, in-process analyst
+     + engine with canned metrics) over a real Deployment,
+  3. roll a "bad" revision, let the engine flag it, and assert the
+     remediation ReplicaSet-template PATCH landed on the live Deployment.
+
+GATING: skips — visibly, never silently passes — unless `kind` AND
+`kubectl` are on PATH. A cluster named `foremast-smoke` is reused when
+present (fast local iteration), else created and torn down; cluster
+creation needs image pulls, so a sandboxed/airgapped box skips at that
+point with the creation error as the reason.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import time
+import urllib.parse
+
+import numpy as np
+import pytest
+
+HAVE_TOOLS = shutil.which("kind") and shutil.which("kubectl")
+pytestmark = pytest.mark.skipif(
+    not HAVE_TOOLS, reason="kind/kubectl not installed: real-apiserver "
+    "smoke runs only where a cluster can exist")
+
+CLUSTER = "foremast-smoke"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, timeout=180, **kw):
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, **kw)
+
+
+def _kubectl(*args, timeout=60):
+    r = _run(["kubectl", "--context", f"kind-{CLUSTER}", *args],
+             timeout=timeout)
+    if r.returncode != 0:
+        raise RuntimeError(f"kubectl {' '.join(args)}: {r.stderr.strip()}")
+    return r.stdout
+
+
+@pytest.fixture(scope="module")
+def kind_client():
+    """A KubeClient bound to a kind cluster (created here if absent)."""
+    from foremast_tpu.operator.kube import KubeClient
+
+    clusters = _run(["kind", "get", "clusters"]).stdout.split()
+    created = False
+    if CLUSTER not in clusters:
+        r = _run(["kind", "create", "cluster", "--name", CLUSTER,
+                  "--wait", "120s"], timeout=600)
+        if r.returncode != 0:
+            pytest.skip(f"kind cluster creation failed (no image access?): "
+                        f"{r.stderr.strip().splitlines()[-1:]}")
+        created = True
+    try:
+        # token auth: the client is in-cluster-token-shaped, so mint a
+        # short-lived SA token instead of repacking kind's client certs
+        _run(["kubectl", "--context", f"kind-{CLUSTER}", "create",
+              "serviceaccount", "foremast-smoke", "-n", "default"])
+        _run(["kubectl", "--context", f"kind-{CLUSTER}", "create",
+              "clusterrolebinding", "foremast-smoke-admin",
+              "--clusterrole=cluster-admin",
+              "--serviceaccount=default:foremast-smoke"])
+        token = _kubectl("create", "token", "foremast-smoke",
+                         "-n", "default", "--duration", "1h").strip()
+        cfg = json.loads(_kubectl("config", "view", "--raw", "-o", "json"))
+        cluster = next(c["cluster"] for c in cfg["clusters"]
+                       if c["name"] == f"kind-{CLUSTER}")
+        server = cluster["server"]
+        ca_path = None
+        if "certificate-authority-data" in cluster:
+            import base64
+            import tempfile
+
+            f = tempfile.NamedTemporaryFile("wb", suffix=".crt",
+                                            delete=False)
+            f.write(base64.b64decode(cluster["certificate-authority-data"]))
+            f.close()
+            ca_path = f.name
+        yield KubeClient(base_url=server, token=token, ca_path=ca_path)
+    finally:
+        if created:
+            _run(["kind", "delete", "cluster", "--name", CLUSTER],
+                 timeout=300)
+
+
+def _wait(pred, what, timeout=90, interval=2.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_crds_accepted_and_flagship_rollback_on_real_apiserver(kind_client):
+    ns, app = "default", "smoke-demo"
+    try:
+        _flow(kind_client, ns, app)
+    finally:
+        # ALWAYS start a reused cluster clean: a stale AutoRollback monitor
+        # surviving a failed run would let the next run's assertions pass
+        # against yesterday's state
+        for res in ("deployment", "deploymentmonitor", "deploymentmetadata"):
+            _run(["kubectl", "--context", f"kind-{CLUSTER}", "delete",
+                  res, app, "-n", ns, "--ignore-not-found"])
+
+
+def _flow(kind_client, ns, app):
+    from foremast_tpu.dataplane import FixtureDataSource, VerdictExporter
+    from foremast_tpu.engine import Analyzer, EngineConfig, JobStore
+    from foremast_tpu.operator.analyst import InProcessAnalyst
+    from foremast_tpu.operator.loop import OperatorLoop
+    from foremast_tpu.operator.types import (
+        Analyst, DeploymentMetadata, Metrics, RemediationAction,
+    )
+    from foremast_tpu.service.api import ForemastService
+
+    kube = kind_client
+
+    # 1. CRD schema acceptance by the real admission chain
+    for crd in ("deploymentmetadata.yaml", "deploymentmonitor.yaml"):
+        _kubectl("apply", "-f", os.path.join(REPO, "deploy", "crds", crd))
+    _wait(lambda: "deploymentmonitors" in _kubectl(
+        "api-resources", "--api-group=deployment.foremast.ai",
+        "-o", "name"), "CRD registration")
+
+    # per-app config through the real CRD path (exercises the codec both
+    # ways: upsert -> apiserver admission -> list/get)
+    kube.upsert_metadata(DeploymentMetadata(
+        name=app, namespace=ns,
+        analyst=Analyst(endpoint="in-process"),
+        metrics=Metrics(data_source_type="prometheus",
+                        endpoint="http://prom/api/v1/"),
+    ))
+    assert kube.get_metadata(ns, app) is not None
+
+    # 2. a real Deployment; the controller-manager mints RS + pods (the
+    # kind node preloads the pause image, so no external pull needed)
+    manifest = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": app, "namespace": ns, "labels": {"app": app}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": app}},
+            "template": {
+                "metadata": {"labels": {"app": app}},
+                "spec": {"containers": [{
+                    "name": "main",
+                    "image": "registry.k8s.io/pause:3.9",
+                    "env": [{"name": "REV", "value": "v1"}],
+                }]},
+            },
+        },
+    }
+    p = _run(["kubectl", "--context", f"kind-{CLUSTER}", "apply",
+              "-f", "-"], input=json.dumps(manifest))
+    assert p.returncode == 0, p.stderr
+    v1_pods = {po["metadata"]["name"] for po in _wait(
+        lambda: kube.list_pods(ns, {"app": app}), "v1 pod object")}
+
+    # engine with canned metrics, keyed on POD IDENTITY captured before
+    # the rollout: the baseline query is pod-scoped to the v1 pods
+    # (barrelman old_pods) and must stay healthy even while the v1 pod is
+    # still alive during the maxSurge overlap — "any live pod" labeling
+    # would storm the baseline too and erase the contrast the verdict
+    # needs
+    rng = np.random.default_rng(5)
+    now = time.time()
+
+    def resolver(url):
+        url = urllib.parse.unquote(url)
+        if "pod=~" in url:
+            level = 30 if any(pn in url for pn in v1_pods) else 300
+            return ([now - 600 + 60 * i for i in range(10)],
+                    list(rng.poisson(level, 10).astype(float)))
+        return ([now - 86400 + 60 * i for i in range(1440)],
+                list(rng.poisson(30, 1440).astype(float)))
+
+    store = JobStore()
+    exporter = VerdictExporter()
+    engine = Analyzer(EngineConfig(), FixtureDataSource(resolver=resolver),
+                      store, exporter=exporter)
+    service = ForemastService(store, exporter=exporter)
+    loop = OperatorLoop(kube, InProcessAnalyst(service))
+
+    loop.tick(now)  # v1 world -> baseline Healthy monitor
+    m = _wait(lambda: kube.get_monitor(ns, app), "baseline monitor")
+    m.spec.remediation = RemediationAction(option="AutoRollback")
+    kube.upsert_monitor(m)
+
+    # 3. roll v2 (env diff) and wait for the second RS revision + pod
+    manifest["spec"]["template"]["spec"]["containers"][0]["env"] = [
+        {"name": "REV", "value": "v2"}]
+    p = _run(["kubectl", "--context", f"kind-{CLUSTER}", "apply",
+              "-f", "-"], input=json.dumps(manifest))
+    assert p.returncode == 0, p.stderr
+    _wait(lambda: len({rs["metadata"]["name"]
+                       for rs in kube.list_replicasets(ns)
+                       if rs["metadata"].get("ownerReferences", [{}])[0]
+                       .get("name") == app}) >= 2, "second ReplicaSet")
+
+    loop.tick(time.time())  # sees the env diff -> starts canary analysis
+    engine.run_cycle()  # scores: new pods error storm -> unhealthy
+    loop.tick(time.time())  # applies verdict -> remediation rollback
+
+    m = kube.get_monitor(ns, app)
+    assert m is not None and m.status.remediation_taken, (
+        f"phase={m.status.phase} remediation_taken="
+        f"{m.status.remediation_taken}")
+    # the rollback PATCH is synchronous: the live Deployment's template
+    # must already read back at v1
+    dep = kube.get_deployment(ns, app)
+    env = dep["spec"]["template"]["spec"]["containers"][0].get("env", [])
+    assert {"name": "REV", "value": "v1"} in env, env
