@@ -37,10 +37,12 @@ def log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
-def run_json(cmd: list, timeout_s: float) -> tuple[dict | None, str | None]:
+def run_json(cmd: list, timeout_s: float,
+             env: dict | None = None) -> tuple[dict | None, str | None]:
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=timeout_s, check=True, cwd=REPO)
+                             timeout=timeout_s, check=True, cwd=REPO,
+                             env=env)
         return json.loads(out.stdout.strip().splitlines()[-1]), None
     except Exception as e:  # noqa: BLE001
         stderr = getattr(e, "stderr", None) or ""
@@ -88,6 +90,28 @@ def main() -> int:
                 dev.update(long_rec)
             else:
                 dev["long_window_error"] = lerr
+            # same healthy window: the exact-null device cost (VERDICT
+            # r04 #4) — fused two-sample family with the exact DP nulls
+            # on (default), KS off, and both off; each variant its own
+            # subprocess (the gates latch at module import)
+            exact_legs = {}
+            for name, env_extra in (
+                    ("exact_on", {}),
+                    ("ks_off", {"FOREMAST_KS_EXACT_MAX_T": "0"}),
+                    ("both_off", {"FOREMAST_KS_EXACT_MAX_T": "0",
+                                  "FOREMAST_WILCOXON_EXACT_MAX_N": "0"})):
+                env = dict(os.environ)
+                env.update(env_extra)
+                rec2, err2 = run_json(
+                    [sys.executable,
+                     os.path.join(REPO, "scripts",
+                                  "exact_null_device_cost.py")],
+                    timeout_s=600, env=env)
+                if rec2 is None:
+                    exact_legs[name] = {"error": err2}
+                else:
+                    exact_legs[name] = rec2
+            dev["exact_null_legs"] = exact_legs
             dev["metric"] = "canary_pairs_scored_per_sec_per_chip"
             dev["unit"] = "pairs/s/chip"
             dev["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
