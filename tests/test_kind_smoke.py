@@ -128,7 +128,7 @@ def _flow(kind_client, ns, app):
     from foremast_tpu.operator.analyst import InProcessAnalyst
     from foremast_tpu.operator.loop import OperatorLoop
     from foremast_tpu.operator.types import (
-        Analyst, DeploymentMetadata, Metrics, RemediationAction,
+        Analyst, DeploymentMetadata, Metrics, Monitoring, RemediationAction,
     )
     from foremast_tpu.service.api import ForemastService
 
@@ -147,7 +147,13 @@ def _flow(kind_client, ns, app):
         name=app, namespace=ns,
         analyst=Analyst(endpoint="in-process"),
         metrics=Metrics(data_source_type="prometheus",
-                        endpoint="http://prom/api/v1/"),
+                        endpoint="http://prom/api/v1/",
+                        # without a monitored-metric list no analysis job
+                        # is ever created and the flow dies silently
+                        # healthy (caught driving the Auto-remediation
+                        # path end-to-end)
+                        monitoring=[Monitoring(metric_name="error5xx",
+                                               metric_alias="error5xx")]),
     ))
     assert kube.get_metadata(ns, app) is not None
 

@@ -800,3 +800,36 @@ def test_hpa_strategy_anyway_stamps_and_other_clears():
     m = kube.get_monitor("default", "demo")
     assert m.spec.hpa_score_template == ""
     assert m.status.hpa_score_enabled is False  # both reset, like on_delete
+
+
+def test_remediation_auto_prefers_rollback_then_pause():
+    """Remediation 'Auto' (a stub in the reference,
+    MonitorController.go:291-294): roll back when a known-good revision
+    exists, else pause the deployment as the safe floor."""
+    kube = FakeKube()
+    _rollback_fixture(kube)
+    mc = MonitorController(kube, Barrelman(kube, ScriptedAnalyst()))
+    monitor = DeploymentMonitor(
+        name="demo", namespace="default",
+        spec=MonitorSpec(remediation=RemediationAction(option="Auto"),
+                         rollback_revision=1),
+        status=MonitorStatus(phase=PHASE_UNHEALTHY),
+    )
+    kube.upsert_monitor(monitor)
+    mc.on_update(None, monitor)
+    d = kube.get_deployment("default", "demo")
+    assert d["spec"]["template"]["spec"]["containers"][0]["image"] == "app:r1"
+    assert not d["spec"].get("paused")
+
+    # no revision to return to -> pause instead
+    kube2 = FakeKube()
+    _rollback_fixture(kube2)
+    mc2 = MonitorController(kube2, Barrelman(kube2, ScriptedAnalyst()))
+    monitor2 = DeploymentMonitor(
+        name="demo", namespace="default",
+        spec=MonitorSpec(remediation=RemediationAction(option="Auto")),
+        status=MonitorStatus(phase=PHASE_UNHEALTHY),
+    )
+    kube2.upsert_monitor(monitor2)
+    mc2.on_update(None, monitor2)
+    assert kube2.get_deployment("default", "demo")["spec"]["paused"] is True
