@@ -230,10 +230,18 @@ class MonitorController:
             # the evident intent is policy-driven selection, so: roll back
             # when a known-good revision exists to return to, otherwise
             # pause the deployment (stops a bad rollout from progressing
-            # while a human decides — the safe floor). Both legs reuse the
-            # audited single-action paths below.
+            # while a human decides — the safe floor). A rollback that
+            # ERRORS (target ReplicaSet pruned by revisionHistoryLimit,
+            # deployment paused mid-flight, ...) falls back to pause too:
+            # "Auto" promises SOME containment, never an error + a still-
+            # progressing bad rollout. Both legs reuse the audited
+            # single-action paths below.
             if monitor.spec.rollback_revision > 0:
-                return self.rollback(monitor)
+                err = self.rollback(monitor)
+                if not err:
+                    return ""
+                pause_err = self.pause(monitor)
+                return err if pause_err else ""
             return self.pause(monitor)
         return ""
 

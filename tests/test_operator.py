@@ -833,3 +833,24 @@ def test_remediation_auto_prefers_rollback_then_pause():
     kube2.upsert_monitor(monitor2)
     mc2.on_update(None, monitor2)
     assert kube2.get_deployment("default", "demo")["spec"]["paused"] is True
+
+
+def test_remediation_auto_falls_back_to_pause_when_rollback_cannot():
+    """Review hardening: Auto with a rollback_revision whose ReplicaSet
+    was pruned (revisionHistoryLimit) must still contain the rollout —
+    fall back to pause instead of erroring and leaving the bad version
+    progressing."""
+    kube = FakeKube()
+    _rollback_fixture(kube)
+    # prune every ReplicaSet: the rollback target is gone
+    kube.replicasets.clear()
+    mc = MonitorController(kube, Barrelman(kube, ScriptedAnalyst()))
+    monitor = DeploymentMonitor(
+        name="demo", namespace="default",
+        spec=MonitorSpec(remediation=RemediationAction(option="Auto"),
+                         rollback_revision=1),
+        status=MonitorStatus(phase=PHASE_UNHEALTHY),
+    )
+    kube.upsert_monitor(monitor)
+    mc.on_update(None, monitor)
+    assert kube.get_deployment("default", "demo")["spec"]["paused"] is True
