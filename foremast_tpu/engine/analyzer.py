@@ -745,10 +745,15 @@ class Analyzer:
                 # (COMPLETED_UNKNOWN at endTime), same as sparse band jobs
                 continue
             hist_m = m[:, :n_h]
-            hw = hist_m.astype(np.float32)
+            hw = hist_m.astype(np.float64)
             n = np.maximum(hw.sum(axis=1), 1.0)
-            mu = (x[:, :n_h] * hw).sum(axis=1) / n
-            sd = np.sqrt((((x[:, :n_h] - mu[:, None]) * hw) ** 2).sum(axis=1) / n)
+            # float64 reductions: any f32-finite history (<= 3.4e38)
+            # squares and sums without overflow in f64, so mu/sd stay
+            # finite and the standardized series is well-defined — no
+            # NaN edge, no warning suppression needed (review r05)
+            xh = x[:, :n_h].astype(np.float64)
+            mu = (xh * hw).sum(axis=1) / n
+            sd = np.sqrt((((xh - mu[:, None]) * hw) ** 2).sum(axis=1) / n)
             sd = np.maximum(sd, 1e-6)
             xs = ((x - mu[:, None]) / sd[:, None]).T.astype(np.float32)  # (T, F)
             ms = m.T  # (T, F)

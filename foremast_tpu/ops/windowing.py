@@ -67,6 +67,23 @@ def resample_to_grid(
     end = align_step(end + step - 1, step)
     ts = np.asarray(timestamps, dtype=np.float64)
     vs = np.asarray(values, dtype=np.float64)
+    if ts.shape != vs.shape:
+        # a buggy/custom source returning mismatched series must degrade
+        # to the overlapping prefix, not crash the whole fleet's cycle
+        # (preprocess converts only FetchError; a ValueError here would
+        # escape per-job isolation). The Prometheus wire can't produce
+        # this — its samples are [ts, val] pairs — so trimming loses
+        # nothing real.
+        n = min(ts.size, vs.size)
+        ts, vs = ts[:n], vs[:n]
+    if vs.size:
+        # finiteness must be judged at the STORAGE dtype: a 1e39 sample is
+        # f64-finite but casts to f32 inf, which would land with mask=True
+        # and poison every downstream reduction the mask contract promises
+        # to protect. Masking here (NaN is dropped by both the python and
+        # native filters) keeps the two resample paths consistent.
+        with np.errstate(over="ignore"):  # the cast is the check
+            vs = np.where(np.isfinite(vs.astype(np.float32)), vs, np.nan)
     if ts.size >= 512:
         # large (historical) windows: single-pass C resampler when built
         from .. import native

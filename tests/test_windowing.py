@@ -69,3 +69,21 @@ def test_pack_windows_refuses_truncation():
     ws = [Window(np.ones(100, np.float32), np.ones(100, bool), 0)]
     with pytest.raises(ValueError):
         pack_windows(ws, pad_to=64)
+
+
+def test_resample_masks_values_beyond_f32_range():
+    """A 1e39 sample is f64-finite but f32-inf: it must be MASKED, not
+    stored as inf with mask=True (the mask contract is what lets every
+    downstream kernel skip finiteness checks). Exercised on both the
+    python path and (when built) the native >=512-point path."""
+    import numpy as np
+
+    from foremast_tpu.ops.windowing import resample_to_grid
+
+    for n in (10, 600):  # python path; native path when available
+        ts = [60.0 * i for i in range(n)]
+        vals = [10.0] * n
+        vals[n // 2] = 1e39
+        w = resample_to_grid(ts, vals, 0, 60 * n)
+        assert np.all(np.isfinite(w.values[w.mask]))
+        assert w.mask.sum() == n - 1  # the monster sample is masked out
