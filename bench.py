@@ -167,6 +167,10 @@ def _cycle_bench() -> dict:
         extra["cycle_mixed_family_score_s"] = rec.get("family_score_s_per_cycle")
         extra["cycle_mixed_lstm_train_s"] = rec.get("lstm_train_s_per_cycle")
         extra["cycle_mixed_lstm_trains"] = rec.get("lstm_trains_per_cycle")
+        # steady-state warm-up accounting (round 5): the timed cycles are
+        # train-free; the one-time warm-up cost is recorded separately
+        extra["cycle_mixed_warmup_cycles"] = rec.get("warmup_cycles")
+        extra["cycle_mixed_lstm_train_warmup_s"] = rec.get("lstm_train_warmup_s")
     else:
         extra["cycle_mixed_error"] = err
     return extra
