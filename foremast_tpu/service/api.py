@@ -71,7 +71,15 @@ def _canon_time(x):
     try:
         f = float(x)
     except (TypeError, ValueError):
-        return x
+        if isinstance(x, str):
+            # placeholder strings (START_TIME/END_TIME) and RFC3339 pass
+            # through untouched for downstream materialization
+            return x
+        # lists/objects would be f-string-embedded into the query URL as
+        # python reprs — a garbage 200 whose fetches can never succeed
+        raise ApiError(
+            400, f"time parameter must be a number or string, "
+                 f"got {type(x).__name__}") from None
     return int(f) if f.is_integer() else x
 
 
@@ -88,6 +96,8 @@ def _category_url(entry: dict, strategy: str) -> str:
         raise ApiError(400, f"metric entry must be an object, got {type(entry).__name__}")
     if entry.get("url"):
         url = entry["url"]
+        if not isinstance(url, str):
+            raise ApiError(400, "metric 'url' must be a string")
     else:
         params = entry.get("parameters", {})
         if not isinstance(params, dict):
@@ -95,7 +105,11 @@ def _category_url(entry: dict, strategy: str) -> str:
         query = params.get("query", "")
         if not query:
             return ""
+        if not isinstance(query, str):
+            raise ApiError(400, "metric 'parameters.query' must be a string")
         endpoint = params.get("endpoint", "http://prometheus:9090/api/v1/")
+        if not isinstance(endpoint, str):
+            raise ApiError(400, "metric 'parameters.endpoint' must be a string")
         start = _canon_time(params.get("start", 0))
         end = _canon_time(params.get("end", 0))
         try:
@@ -109,19 +123,53 @@ def _category_url(entry: dict, strategy: str) -> str:
     return url
 
 
+def _wire_bool(flags: dict, key: str, default: bool, metric: str) -> bool:
+    """Boolean wire flags that FLIP SEMANTICS (metric direction, limit
+    interpretation) must never be silently mis-coerced: bool("false") is
+    True, and a Go client marshalling strings would invert every verdict
+    direction. Accepts real booleans and the unambiguous string forms."""
+    v = flags.get(key, default)
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, int) and v in (0, 1):
+        return bool(v)  # JSON 0/1 is unambiguous
+    if isinstance(v, str):
+        low = v.strip().lower()
+        if low in ("true", "1", "yes"):
+            return True
+        if low in ("false", "0", "no", ""):
+            return False
+    raise ApiError(400, f"invalid {key} {v!r} for metric {metric}")
+
+
+def _as_object(x, name: str) -> dict:
+    """JSON-shape gate: real clients produce every type confusion (arrays
+    for objects, strings for maps); each must be a clean 400, never a
+    500 from an AttributeError deep in conversion."""
+    if x is None:
+        return {}
+    if not isinstance(x, dict):
+        raise ApiError(400, f"{name} must be a JSON object, "
+                            f"got {type(x).__name__}")
+    return x
+
+
 def build_document(req: dict) -> Document:
     """Validate + convert a create request into a job Document."""
+    req = _as_object(req, "request body")
     app = req.get("appName", "")
-    if not app or not _APP_RE.match(app):
-        raise ApiError(400, f"invalid appName {app!r}")
+    if not isinstance(app, str) or not app or not _APP_RE.match(app):
+        raise ApiError(400, f"invalid appName {str(app)[:128]!r}")
     strategy = req.get("strategy", "rollingUpdate")
     if strategy not in VALID_STRATEGIES:
         raise ApiError(400, f"invalid strategy {strategy!r}")
     namespace = req.get("namespace", "default")
-    info = req.get("metricsInfo", {})
-    current = info.get("current", {})
-    baseline = info.get("baseline", {})
-    historical = info.get("historical", {})
+    if not isinstance(namespace, str):
+        raise ApiError(400, "namespace must be a string")
+    info = _as_object(req.get("metricsInfo"), "metricsInfo")
+    current = _as_object(info.get("current"), "metricsInfo.current")
+    baseline = _as_object(info.get("baseline"), "metricsInfo.baseline")
+    historical = _as_object(info.get("historical"), "metricsInfo.historical")
     if not current and strategy != "hpa":
         raise ApiError(400, "metricsInfo.current is required")
 
@@ -131,18 +179,21 @@ def build_document(req: dict) -> Document:
     # HPA tps/sla selection tie-breaks on insertion order — scores must not
     # change across a restart
     for name in sorted(set(current) | set(baseline) | set(historical)):
-        if not _METRIC_RE.match(name):
-            raise ApiError(400, f"invalid metric name {name!r}")
-        cur_e = current.get(name, {})
+        if not isinstance(name, str) or not _METRIC_RE.match(name):
+            raise ApiError(400, f"invalid metric name {str(name)[:128]!r}")
+        cur_e = _as_object(current.get(name), f"metricsInfo.current.{name}")
+        base_e = _as_object(baseline.get(name), f"metricsInfo.baseline.{name}")
+        hist_e = _as_object(historical.get(name),
+                            f"metricsInfo.historical.{name}")
         cur = _category_url(cur_e, strategy)
-        base = _category_url(baseline.get(name, {}), strategy)
-        hist = _category_url(historical.get(name, {}), strategy)
+        base = _category_url(base_e, strategy)
+        hist = _category_url(hist_e, strategy)
         if continuous:
             cur = placeholderize(cur, historical=False)
             base = ""
             hist = placeholderize(hist, historical=True)
         # hpa flags may ride whichever category carries the metric
-        flags = cur_e or baseline.get(name, {}) or historical.get(name, {})
+        flags = cur_e or base_e or hist_e
         try:
             priority = int(flags.get("priority", 0))
         except (TypeError, ValueError):
@@ -154,12 +205,14 @@ def build_document(req: dict) -> Document:
             baseline=base,
             historical=hist,
             priority=priority,
-            is_increase=bool(flags.get("isIncrease", True)),
-            is_absolute=bool(flags.get("isAbsolute", False)),
+            is_increase=_wire_bool(flags, "isIncrease", True, name),
+            is_absolute=_wire_bool(flags, "isAbsolute", False, name),
         )
 
     start_time = req.get("startTime", "")
     end_time = req.get("endTime", "")
+    if not isinstance(start_time, str) or not isinstance(end_time, str):
+        raise ApiError(400, "startTime/endTime must be RFC3339 strings")
     if continuous:
         start_time, end_time = START_PLACEHOLDER, END_PLACEHOLDER
 
@@ -185,6 +238,8 @@ def build_document(req: dict) -> Document:
     # per-pod scoring needs the replica history the capacity proxy spans,
     # not just the scoring window.
     pod_count_url = req.get("podCountURL", "")
+    if not isinstance(pod_count_url, str):
+        raise ApiError(400, "podCountURL must be a string")
     if continuous and pod_count_url:
         pod_count_url = placeholderize(pod_count_url, historical=True)
     return Document(

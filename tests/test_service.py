@@ -264,3 +264,72 @@ def test_metrics_includes_engine_self_gauges():
         finally:
             server.shutdown()
             server.server_close()
+
+
+def test_malformed_payload_zoo_never_500s(stack):
+    """Every malformed create body gets a clean 4xx with a string error —
+    never a 500 (an unhandled exception in build_document) and never a
+    silent 200 on garbage. The zoo covers the JSON type confusions real
+    clients produce."""
+    base_url, *_ = stack
+    zoo = [
+        None,  # null body
+        [],  # array, not object
+        "string",  # scalar
+        {},  # empty object
+        {"appName": "x" * 10_000, "strategy": "canary"},  # absurd name
+        {"appName": "ok", "strategy": "canary", "metricsInfo": "nope"},
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": []}},  # wrong container type
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": {"m": "not-a-dict"}}},
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": {"bad metric name!": {"url": "u"}}}},
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": {"m": {"url": "u",
+                                           "priority": "high"}}}},
+        {"appName": "ok", "strategy": "hpa",
+         "metricsInfo": {"current": {"m": {"parameters": "nope"}}}},
+        {"appName": "ok", "strategy": "canary", "startTime": 12345,
+         "metricsInfo": {"current": {"m": {"url": "u"}}}},
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": {"m": {"url": 123}}}},
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": {"m": {"parameters": {"query": 123}}}}},
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": {"m": {"parameters": {
+             "query": "q", "endpoint": 9}}}}},
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": {"m": {"parameters": {
+             "query": "q", "start": [1, 2]}}}}},
+        # string booleans on direction-flipping flags: bool("false") is
+        # True — silent inversion of every verdict direction, must 400
+        {"appName": "ok", "strategy": "canary",
+         "metricsInfo": {"current": {"m": {"url": "u",
+                                           "isIncrease": "maybe"}}}},
+        {"appName": "ok", "strategy": "canary", "podCountURL": 77,
+         "metricsInfo": {"current": {"m": {"url": "u"}}}},
+    ]
+    for body in zoo:
+        code, resp = _req("POST", f"{base_url}/v1/healthcheck/create", body)
+        assert 400 <= code < 500, (body, code, resp)
+        assert isinstance(resp, dict) and isinstance(resp.get("error"), str), (
+            body, resp)
+    # a LITERAL JSON null body (json.dumps(None) -> b"null"): _req's
+    # body=None sends an EMPTY body instead, so post raw bytes here
+    r = urllib.request.Request(
+        f"{base_url}/v1/healthcheck/create", b"null", method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(r, timeout=5) as resp:
+            code, payload = resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        code, payload = e.code, json.loads(e.read())
+    assert 400 <= code < 500 and isinstance(payload.get("error"), str)
+    # unambiguous string/int booleans are ACCEPTED (Go clients marshal
+    # "true"/"false"; JSON clients send 0/1)
+    ok = {"appName": "okflags", "strategy": "canary",
+          "metricsInfo": {"current": {"m": {"url": "u", "isIncrease": "false",
+                                            "isAbsolute": 1}}}}
+    code, resp = _req("POST", f"{base_url}/v1/healthcheck/create", ok)
+    assert code == 200, resp
