@@ -233,3 +233,58 @@ def test_per_pod_normalization_absorbs_taken_scaleups():
     out = hpa.hpa_scores(**kw)
     assert float(out["score"][0]) > 65
     assert float(out["demand_per_pod"][0]) > 40  # ~200/4
+
+
+def test_closed_loop_converges_with_per_pod_normalization():
+    """The autoscaler control loop, simulated end to end: traffic steps to
+    2.5x, each cycle the HPA applies replicas' = ceil(replicas*score/50)
+    and the pod-count series feeds back into the next score. Per-pod
+    normalization must make this CONVERGE (absorbed demand reads neutral);
+    the aggregate score without pod data would keep demanding scale-up
+    every cycle at any replica count (steady-state score stays >65 —
+    measured below), growing replicas without bound until maxReplicas."""
+    import math
+
+    rng = np.random.default_rng(2)
+    T, region_len = 96, 30
+    hist_tps_per_pod = 25.0  # provisioned: 4 pods x 25 = 100 total
+    surge = 2.5
+
+    def score_once(replicas_now, replicas_hist, with_pods=True):
+        tps = np.concatenate([
+            rng.normal(100, 2, T - region_len),  # history at 4 pods
+            rng.normal(100 * surge, 2, region_len),  # the new demand level
+        ]).astype(np.float32)[None]
+        mask = np.ones((1, T), bool)
+        region = np.zeros((1, T), bool)
+        region[:, -region_len:] = True
+        hist_mask = mask & ~region
+        preds = fc.ses_predictions(tps, hist_mask, np.float32([0.3]))
+        sigma = fc.residual_sigma(tps, np.asarray(preds), hist_mask, ~region)
+        sla = rng.normal(5, 0.3, (1, T)).astype(np.float32)
+        kw = {}
+        if with_pods:
+            kw = dict(pods_now=np.float32([replicas_now]),
+                      pods_hist=np.float32([replicas_hist]))
+        out = hpa.hpa_scores(
+            tps, mask, region, np.asarray(preds), np.asarray(sigma),
+            sla, mask, np.float32([50.0]), np.int32([hpa.SLA_DYNAMIC]),
+            np.float32([3.0]), **kw)
+        return float(out["score"][0])
+
+    replicas = 4.0
+    trajectory = [replicas]
+    for _ in range(8):
+        s = score_once(replicas, 4.0)
+        replicas = min(max(math.ceil(replicas * s / 50.0), 1), 64)
+        trajectory.append(replicas)
+    # converges to ~surge * 4 = 10 pods and HOLDS (no runaway, no flap)
+    assert trajectory[-1] == trajectory[-2], trajectory
+    assert 9 <= trajectory[-1] <= 12, trajectory
+    # the final state reads per-pod-neutral
+    s_final = score_once(trajectory[-1], 4.0)
+    assert 40 <= s_final <= 60, s_final
+    # contrast: without pod feedback the same steady state still demands
+    # scale-up forever (the aggregate 2.5x ratio never discharges)
+    s_agg = score_once(trajectory[-1], 4.0, with_pods=False)
+    assert s_agg > 65, s_agg
