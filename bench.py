@@ -430,6 +430,23 @@ def _opportunistic_fallback() -> dict:
         return {}
     if not isinstance(rec, dict) or not rec.get("value"):
         return {}
+    # freshness gate: a leftover artifact from a PRIOR round (older
+    # kernels, older protocol) must never masquerade as this round's
+    # measurement — the docstring's promise is enforced, not assumed.
+    # Rounds run well under 14 h; a missing/unparseable stamp fails shut.
+    max_age_h = _env_float("BENCH_FALLBACK_MAX_AGE_H", 14.0)
+    try:
+        import calendar
+
+        captured = time.strptime(rec.get("captured_at", ""),
+                                 "%Y-%m-%dT%H:%M:%SZ")
+        # timegm, not mktime: the stamp is UTC ("Z"); mktime would read
+        # it as local time and skew the age by the host's UTC offset
+        age_h = (time.time() - calendar.timegm(captured)) / 3600.0
+    except (ValueError, OverflowError):
+        return {}
+    if not (0 <= age_h <= max_age_h):
+        return {}
     rec.pop("metric", None)
     rec.pop("unit", None)
     rec.setdefault("capture_mode", "opportunistic_mid_round")
@@ -563,7 +580,11 @@ def main() -> None:
     # instead of bench_mesh.py's hardcoded prior
     p50 = device.get("p50_s_at_100k")
     rtt = device.get("readback_rtt_floor_s", 0.0)
-    if p50 and not cpu_run:
+    if p50 and not cpu_run and "device_error" not in device:
+        # self-calibration ONLY from this run's own device leg: numbers
+        # folded in by the opportunistic fallback carry provenance the
+        # mesh record would not inherit (bench_mesh falls back to its
+        # documented prior instead)
         # setdefault: an operator-exported BENCH_DEVICE_SCORE_S is a
         # documented override and must win over self-calibration
         os.environ.setdefault(

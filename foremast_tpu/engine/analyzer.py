@@ -992,18 +992,31 @@ class Analyzer:
             rows.append((job_id, tps_it, sla_it))
         if not rows:
             return out
-        # pack length must fit BOTH the tps and sla series (lengths are
-        # data-driven and independent)
-        T = max(
-            bucket_length(
-                min(
-                    it.historical.values.shape[0] + it.current.values.shape[0],
-                    MAX_WINDOW_STEPS,
+        # bucket rows by their OWN pack length (the max of the job's tps
+        # and sla series — lengths are data-driven and independent) like
+        # every other fleet scorer: one global max-T would pad a whole
+        # heterogeneous fleet to its single longest member (a lone
+        # 7-day-history job would inflate every 2-hour job's scan 128x)
+        by_bucket: dict[int, list] = {}
+        for row in rows:
+            T_row = max(
+                bucket_length(
+                    min(
+                        it.historical.values.shape[0]
+                        + it.current.values.shape[0],
+                        MAX_WINDOW_STEPS,
+                    )
                 )
+                for it in (row[1], row[2])
             )
-            for row in rows
-            for it in (row[1], row[2])
-        )
+            by_bucket.setdefault(T_row, []).append(row)
+        for T, bucket_rows in by_bucket.items():
+            out.update(self._score_hpa_bucket(bucket_rows, T))
+        return out
+
+    def _score_hpa_bucket(self, rows, T: int):
+        """Score one pack-length bucket of HPA jobs in chunked launches."""
+        out: dict = {}
 
         def build(it):
             vals, mask, n_h = _concat_trimmed(it.historical, it.current)

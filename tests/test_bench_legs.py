@@ -56,11 +56,24 @@ def test_opportunistic_fallback_folds_banked_artifact(tmp_path, monkeypatch):
     # zero-value artifact (a degraded capture) must NOT masquerade
     art.write_text(_json.dumps({"value": 0.0}) + "\n")
     assert bench._opportunistic_fallback() == {}
-    # real capture folds in with provenance
+    # unstamped artifact fails the freshness gate (fails shut)
+    art.write_text(_json.dumps({"value": 99541.0}) + "\n")
+    assert bench._opportunistic_fallback() == {}
+    # STALE artifact (a prior round's leftover) is rejected: last round's
+    # kernels must never masquerade as this round's measurement
+    import time as _time
+
+    old_stamp = _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                               _time.gmtime(_time.time() - 48 * 3600))
+    art.write_text(_json.dumps({"value": 99541.0,
+                                "captured_at": old_stamp}) + "\n")
+    assert bench._opportunistic_fallback() == {}
+    # fresh real capture folds in with provenance
+    stamp = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
     art.write_text(_json.dumps({
         "metric": "canary_pairs_scored_per_sec_per_chip", "unit": "x",
         "value": 99541.0, "p99_s_at_100k": 0.18, "digest": 1.5,
-        "captured_at": "2026-07-30T12:00:00Z",
+        "captured_at": stamp,
         "capture_mode": "opportunistic_mid_round"}) + "\n")
     got = bench._opportunistic_fallback()
     assert got["value"] == 99541.0

@@ -977,3 +977,40 @@ def test_garbage_pod_count_body_never_fails_the_job():
     assert out["app:demo:hpa"] == J.INITIAL  # scored + requeued
     logs = store.hpalogs_for("app:demo:hpa")
     assert logs and "per-pod" not in logs[0].reason  # aggregate fallback
+
+
+def test_hpa_fleet_with_heterogeneous_history_lengths():
+    """HPA rows bucket by their own pack length: a lone long-history job
+    must not inflate every short job's launch (and both must score)."""
+    fixtures, store = {}, JobStore()
+    now = _mk_hpa_job(store, fixtures, "short:demo:hpa")
+    # a second job with a 7x longer history rides its own bucket
+    rng = np.random.default_rng(9)
+    hist_ts, hist_v = _series(rng, 100.0, 700, spread=3.0)
+    cur_ts = [hist_ts[-1] + STEP + t for t in np.arange(30) * STEP]
+    fixtures["http://prom/long/tps_hist"] = (hist_ts, hist_v)
+    fixtures["http://prom/long/tps_cur"] = (cur_ts,
+                                            rng.normal(240, 5, 30).tolist())
+    s_ts, s_v = _series(rng, 5.0, 700, spread=0.3)
+    fixtures["http://prom/long/sla_hist"] = (s_ts, s_v)
+    fixtures["http://prom/long/sla_cur"] = (cur_ts,
+                                            rng.normal(5, 0.3, 30).tolist())
+    store.create(Document(
+        id="long:demo:hpa", app_name="long", namespace="demo",
+        strategy="hpa", start_time="START_TIME", end_time="END_TIME",
+        metrics={
+            "tps": MetricQueries(historical="http://prom/long/tps_hist",
+                                 current="http://prom/long/tps_cur",
+                                 priority=0),
+            "latency": MetricQueries(historical="http://prom/long/sla_hist",
+                                     current="http://prom/long/sla_cur",
+                                     priority=1),
+        },
+    ))
+    analyzer = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    outcomes = analyzer.run_cycle(now=now)
+    assert outcomes == {"short:demo:hpa": J.INITIAL,
+                        "long:demo:hpa": J.INITIAL}
+    for job in ("short:demo:hpa", "long:demo:hpa"):
+        logs = store.hpalogs_for(job)
+        assert logs and 0.0 <= logs[0].hpascore <= 100.0
