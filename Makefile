@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast native bench bench-smoke demo demo-hpa dryrun clean
+.PHONY: test test-fast native bench bench-smoke bench-watch demo demo-hpa dryrun clean
 
 test:            ## full suite (CPU, 8 virtual devices via conftest)
 	$(PY) -m pytest tests/ -q
@@ -21,6 +21,9 @@ bench:           ## the real benchmark (touches the TPU; one JSON line)
 
 bench-smoke:     ## bench plumbing check on CPU with tiny shapes
 	$(CPU_ENV) BENCH_PAIRS_TOTAL=4000 BENCH_RUNS=20 BENCH_CYCLE_JOBS=500 $(PY) bench.py
+
+bench-watch:     ## background tunnel watcher: banks BENCH_LOCAL_r05.json at first health
+	nohup $(PY) scripts/opportunistic_bench.py > /tmp/opp_bench.log 2>&1 &
 
 demo:            ## hermetic rollback demo (no cluster)
 	$(CPU_ENV) $(PY) -m foremast_tpu demo
