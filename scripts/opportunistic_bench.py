@@ -52,6 +52,18 @@ def run_json(cmd: list, timeout_s: float,
         return None, f"{type(e).__name__}: {e}" + (f" | {tail}" if tail else "")
 
 
+def classify(err: str | None) -> str:
+    """timeout (kill after a silent hang — possible wedge), unavailable
+    (pool-side refusal; observed to last hours and then clear), or other
+    (likely deterministic: import error, bad flag, broken env)."""
+    e = err or ""
+    if e.startswith("TimeoutExpired"):
+        return "timeout"
+    if "UNAVAILABLE" in e:
+        return "unavailable"
+    return "other"
+
+
 def main() -> int:
     out_path = os.path.join(REPO, "BENCH_LOCAL_r05.json")
     if "--out" in sys.argv:
@@ -61,15 +73,26 @@ def main() -> int:
                   file=sys.stderr)
             return 1
         out_path = sys.argv[idx]
-    probe_timeout = float(os.environ.get("OPP_PROBE_TIMEOUT", "90"))
+    # Patient probe by default (round-5 lesson): a healthy-but-slow grant
+    # can take >90 s through the tunnel, and timeout-KILLING a probe that
+    # is merely slow re-wedges the pool for the next ~25 min — a 90 s
+    # probe timeout turned a measured-healthy tunnel back into a wedged
+    # one mid-round. 1800 s also outlasts the pool's definitive
+    # UNAVAILABLE self-report (~25 min, see docs/benchmarks.md round-5
+    # post-mortem), so in the pool-unavailable mode the probe *returns*
+    # instead of being killed — no kill, no fresh wedge.
+    probe_timeout = float(os.environ.get("OPP_PROBE_TIMEOUT", "1800"))
     quiet_sleep = float(os.environ.get("OPP_QUIET_SLEEP", "1500"))
     deadline = time.time() + float(os.environ.get("OPP_DEADLINE", "36000"))
+    log(f"watcher up: probe_timeout={probe_timeout:.0f}s "
+        f"quiet_sleep={quiet_sleep:.0f}s out={out_path}")
 
     probe = [sys.executable, "-c",
              "import json, jax; d = jax.devices(); "
              "print(json.dumps({'n': len(d), "
              "'backend': jax.default_backend()}))"]
     attempt = 0
+    other_leg_failures = 0
     while time.time() < deadline:
         attempt += 1
         rec, err = run_json(probe, probe_timeout)
@@ -78,14 +101,34 @@ def main() -> int:
                 log(f"probe healthy but backend={rec.get('backend')}; abort")
                 return 1
             log(f"probe #{attempt}: tunnel HEALTHY ({rec}) — running device leg")
+            # every leg gets the same patient deadline as the probe: a
+            # kill at ~25 min races the pool's own UNAVAILABLE
+            # self-report and can re-wedge the tunnel (see probe_timeout
+            # rationale); healthy legs finish in minutes regardless
             dev, derr = run_json(
-                [sys.executable, BENCH, "--device-only"], timeout_s=1500)
+                [sys.executable, BENCH, "--device-only"],
+                timeout_s=max(probe_timeout, 1800.0))
             if dev is None:
+                # The probe just passed, so an "other" failure here is
+                # more likely a mid-leg tunnel drop (gRPC socket error,
+                # truncated stdout) than a deterministic bug — retry it
+                # too, but cap consecutive occurrences so a genuinely
+                # broken leg (bad flag, import error) cannot silently
+                # burn the whole deadline.
+                if classify(derr) == "other":
+                    other_leg_failures += 1
+                    if other_leg_failures >= 3:
+                        log(f"device leg failed ({derr}); "
+                            f"3 consecutive non-wedge failures; abort")
+                        return 1
+                else:
+                    other_leg_failures = 0
                 log(f"device leg failed: {derr}; quiet-sleeping")
                 time.sleep(quiet_sleep)
                 continue
             long_rec, lerr = run_json(
-                [sys.executable, BENCH, "--long-only"], timeout_s=900)
+                [sys.executable, BENCH, "--long-only"],
+                timeout_s=max(probe_timeout, 1800.0))
             if long_rec is not None:
                 dev.update(long_rec)
             else:
@@ -106,7 +149,7 @@ def main() -> int:
                     [sys.executable,
                      os.path.join(REPO, "scripts",
                                   "exact_null_device_cost.py")],
-                    timeout_s=600, env=env)
+                    timeout_s=max(probe_timeout, 1800.0), env=env)
                 if rec2 is None:
                     exact_legs[name] = {"error": err2}
                 else:
@@ -121,11 +164,30 @@ def main() -> int:
                 f.write(json.dumps(dev) + "\n")
             log(f"artifact written: {out_path}")
             return 0
-        if not (err or "").startswith("TimeoutExpired"):
-            log(f"probe #{attempt}: deterministic failure: {err}; abort")
-            return 1
-        log(f"probe #{attempt}: wedged (timeout {probe_timeout:.0f}s); "
-            f"quiet-sleeping {quiet_sleep:.0f}s")
+        kind = classify(err)
+        if kind == "other":
+            # transient tunnel deaths surface as non-UNAVAILABLE strings
+            # too (socket errors, truncated stdout) — same 3-strike cap
+            # as the device leg, so one blip can't kill a 10 h watcher
+            # while a genuinely broken env still aborts promptly
+            other_leg_failures += 1
+            if other_leg_failures >= 3:
+                log(f"probe #{attempt}: 3 consecutive non-wedge "
+                    f"failures ({err}); abort")
+                return 1
+            log(f"probe #{attempt}: unclassified failure ({err}); "
+                f"quiet-sleeping {quiet_sleep:.0f}s")
+            time.sleep(quiet_sleep)
+            continue
+        other_leg_failures = 0
+        if kind == "timeout":
+            log(f"probe #{attempt}: wedged (timeout {probe_timeout:.0f}s); "
+                f"quiet-sleeping {quiet_sleep:.0f}s")
+        else:
+            # fast pool-side refusal, not a wedge — keep the real error
+            # so the round post-mortem can tell the two modes apart
+            log(f"probe #{attempt}: pool UNAVAILABLE ({err}); "
+                f"quiet-sleeping {quiet_sleep:.0f}s")
         time.sleep(quiet_sleep)
     log("deadline expired without a healthy probe")
     return 2
