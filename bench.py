@@ -511,7 +511,11 @@ def main() -> None:
     # parse the deadlines FIRST: a malformed env var must not throw away
     # a 15-minute cycle bench later, outside the degrade path
     timeout_s = _env_float("BENCH_DEVICE_TIMEOUT", 1200.0)
-    preflight_timeout_s = _env_float("BENCH_PREFLIGHT_TIMEOUT", 90.0)
+    # 240 s, not 90: a healthy-but-slow grant was measured at ~2 min this
+    # round, and killing a probe that is merely waiting re-wedges the
+    # pool for ~25 min (docs/benchmarks.md post-mortem) — the first kill
+    # must not fire inside the healthy-grant latency band
+    preflight_timeout_s = _env_float("BENCH_PREFLIGHT_TIMEOUT", 240.0)
     preflight_window_s = _env_float("BENCH_PREFLIGHT_WINDOW", 900.0)
     # The device leg runs FIRST: the headline is the round's most
     # important artifact, so nothing may die before it — and its measured

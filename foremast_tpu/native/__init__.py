@@ -19,7 +19,11 @@ __all__ = ["available", "parse_series", "parse_grid", "resample", "lib_path"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "foremast_native.cpp")
-_SO = os.path.join(_DIR, "foremast_native.so")
+# FOREMAST_NATIVE_SO points the loader at an alternate build (the ASAN
+# fuzz leg in tests/test_native_fuzz.py); default is the cached in-package
+# artifact. Read at import: the override is a per-process test seam.
+_SO = (os.environ.get("FOREMAST_NATIVE_SO")
+       or os.path.join(_DIR, "foremast_native.so"))
 
 _lock = threading.Lock()
 _lib = None
@@ -35,7 +39,9 @@ def lib_path() -> str:
 
 def _build() -> bool:
     cxx = os.environ.get("CXX", "g++")
-    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    extra = os.environ.get("FOREMAST_NATIVE_CXXFLAGS", "").split()
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+           *extra, _SRC, "-o", _SO]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True

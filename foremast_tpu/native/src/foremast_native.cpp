@@ -241,14 +241,28 @@ class Scanner {
 // Sort by timestamp and average duplicates in place (same-key accumulation
 // as fetch._avg_series); returns the compacted length.
 long merge_pairs(std::vector<Pair>& pairs) {
-    std::stable_sort(pairs.begin(), pairs.end(),
+    // NaN timestamps CAN reach here: sample() reads ts with strtod, which
+    // accepts "nan" — and a `<` comparator over NaN violates strict weak
+    // ordering, which is undefined behavior in stable_sort (a real crash
+    // vector on hostile bodies). Partition NaNs to the tail and sort only
+    // the finite-ordered prefix; the duplicate loop below keeps each NaN
+    // as its own group (NaN != NaN), mirroring the Python parser where
+    // distinct float('nan') dict keys never merge.
+    auto mid = std::stable_partition(
+        pairs.begin(), pairs.end(),
+        [](const Pair& a) { return !std::isnan(a.ts); });
+    std::stable_sort(pairs.begin(), mid,
                      [](const Pair& a, const Pair& b) { return a.ts < b.ts; });
     long n = (long)pairs.size();
     long m = 0;
     long i = 0;
     while (i < n) {
-        long j = i;
-        double acc = 0.0;
+        // j starts PAST i: for a NaN group the `==` below is false even
+        // at j == i, and a non-advancing j stalled i while m kept
+        // growing — an unbounded write past the vector (heap smash on a
+        // hostile body; found by tests/test_native_fuzz.py).
+        long j = i + 1;
+        double acc = pairs[i].val;
         while (j < n && pairs[j].ts == pairs[i].ts) acc += pairs[j++].val;
         pairs[m].ts = pairs[i].ts;
         pairs[m].val = acc / (double)(j - i);
@@ -314,6 +328,13 @@ long fm_parse_grid(const char* buf, long len, int flavor,
     }
     *out_start = 0;
     if (!any) return 0;
+    // a double -> long cast outside long's range is undefined behavior,
+    // and a hostile body can carry ts = 1e300; clamp the span endpoints
+    // well inside long range (real unix times are ~1.7e9 — anything near
+    // the cap is garbage whose samples the fill loop drops anyway)
+    const double kTsCap = 4.0e18;
+    tmax = std::clamp(tmax, -kTsCap, kTsCap);
+    tmin = std::clamp(tmin, -kTsCap, kTsCap);
     long end = (long)tmax / step * step + step;
     long start = (long)tmin / step * step;
     if (start < end - max_steps * step) start = end - max_steps * step;
