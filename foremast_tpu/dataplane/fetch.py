@@ -42,6 +42,13 @@ class FetchError(Exception):
     pass
 
 
+# Span-endpoint cap for hostile timestamps, shared by grid_from_series and
+# pinned by tests/test_native_fuzz.py — MUST match kTsCap in
+# native/src/foremast_native.cpp (fm_parse_grid) so the python fallback
+# and the native fast path degrade identically on absurd bodies.
+TS_SPAN_CAP = 4.0e18
+
+
 def grid_from_series(ts, vals, step: int = 60,
                      max_steps: int = MAX_WINDOW_STEPS) -> Window:
     """(ts, vals) -> the engine's grid Window: span from the data's own
@@ -49,11 +56,20 @@ def grid_from_series(ts, vals, step: int = 60,
     most recent samples (a query returning >11 days must not produce an
     unbucketable window). np.max/np.min because ts may be a 10k-point
     ndarray off the native parser (builtin max would box every element)."""
-    if len(ts) == 0:
+    ts_arr = np.asarray(ts, np.float64)
+    vals_arr = np.asarray(vals, np.float64)
+    # span from FINITE timestamps only, clamped well inside int range —
+    # json.loads accepts NaN/Infinity tokens where strict JSON forbids
+    # them, and int(nan) raises while int(1e300) builds an absurd window
+    # (resample_to_grid already drops the non-finite samples themselves)
+    finite = ts_arr[np.isfinite(ts_arr)]
+    if finite.size == 0:
         return Window(np.zeros(1, np.float32), np.zeros(1, bool), 0, step)
-    end = align_step(float(np.max(ts)), step) + step
-    start = max(align_step(float(np.min(ts)), step), end - max_steps * step)
-    return resample_to_grid(ts, vals, start, end, step)
+    cap = TS_SPAN_CAP
+    end = align_step(float(np.clip(np.max(finite), -cap, cap)), step) + step
+    start = max(align_step(float(np.clip(np.min(finite), -cap, cap)), step),
+                end - max_steps * step)
+    return resample_to_grid(ts_arr, vals_arr, start, end, step)
 
 
 def _probably_error_body(raw: bytes) -> bool:

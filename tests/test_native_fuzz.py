@@ -186,6 +186,18 @@ def _fuzz_resample(seed: int, n: int) -> None:
 
 
 def _child_main(n_cases: int) -> int:
+    # a child with NO native lib passes every case vacuously (each call
+    # returns None) — that must be a loud failure, not silent green: the
+    # ASAN leg in particular would otherwise report success with zero
+    # sanitizer coverage when the instrumented build fails to compile/load
+    if not native.available():
+        print("FUZZ-FAIL native lib unavailable in child", file=sys.stderr)
+        return 2
+    override = os.environ.get("FOREMAST_NATIVE_SO")
+    if override and native.lib_path() != override:
+        print(f"FUZZ-FAIL loader ignored FOREMAST_NATIVE_SO "
+              f"({native.lib_path()} != {override})", file=sys.stderr)
+        return 2
     idx = -1
     try:
         for idx, buf in enumerate(gen_cases(SEED, n_cases)):
@@ -228,6 +240,38 @@ def test_fuzz_parsers_no_crash():
     assert proc.returncode == 0, (
         f"fuzz child rc={proc.returncode}\nstdout={proc.stdout[-2000:]}\n"
         f"stderr={proc.stderr[-2000:]}")
+
+
+def test_hostile_timestamp_bodies_degrade_not_crash(monkeypatch):
+    """NaN/Infinity/1e300 timestamps must yield a sane Window on BOTH
+    parse paths. json.loads accepts NaN/Infinity tokens (strict JSON does
+    not), and the python span derivation used to raise on them
+    (int(nan) -> ValueError) or build a window anchored at 1e300."""
+    from foremast_tpu.dataplane import fetch
+
+    bodies = [
+        b'{"data":{"result":[{"values":[[NaN,2],[1700000000,"1"],'
+        b'[NaN,3]]}]}}',
+        b'{"data":{"result":[{"values":[[Infinity,2],'
+        b'[1700000000,"1"]]}]}}',
+        b'{"data":{"result":[{"values":[[-Infinity,2],[NaN,"3"]]}]}}',
+        b'{"data":{"result":[{"values":[[1e300,"1"],'
+        b'[1700000000,"2"]]}]}}',
+    ]
+    for forced_python in (False, True):
+        if forced_python:
+            monkeypatch.setattr(fetch.native, "parse_grid",
+                                lambda *a, **k: None)
+            monkeypatch.setattr(fetch.native, "parse_series",
+                                lambda *a, **k: None)
+        for body in bodies:
+            w = fetch.window_from_prometheus_body(body)
+            assert len(w.values) == len(w.mask) >= 1
+            # span endpoints stay inside the shared cap (native kTsCap /
+            # python TS_SPAN_CAP), never anchored at 1e300; the small
+            # slack covers the +step / align rounding past the cap
+            assert abs(w.start) <= fetch.TS_SPAN_CAP * 1.01, \
+                (forced_python, body)
 
 
 def _libasan_path() -> str | None:
