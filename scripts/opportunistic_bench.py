@@ -83,16 +83,33 @@ def main() -> int:
     # instead of being killed — no kill, no fresh wedge.
     probe_timeout = float(os.environ.get("OPP_PROBE_TIMEOUT", "1800"))
     quiet_sleep = float(os.environ.get("OPP_QUIET_SLEEP", "1500"))
+    # the long quiet sleep exists to let a KILL-induced wedge clear; after
+    # a clean pool-side UNAVAILABLE return (no kill happened) only a short
+    # breather is needed — and since a PENDING probe rides the transition
+    # to healthy (the waiting grant request gets served), shrinking the
+    # blind gap between probes is what raises the odds of catching a
+    # short healthy window
+    unavail_sleep = float(os.environ.get("OPP_UNAVAIL_SLEEP", "120"))
+    # "other" failures sleep longer than UNAVAILABLE ones: the 3-strike
+    # abort must outlast a realistic multi-minute transient (socket
+    # blips during a tunnel restart), not trip in 4 minutes
+    other_sleep = float(os.environ.get("OPP_OTHER_SLEEP", "300"))
     deadline = time.time() + float(os.environ.get("OPP_DEADLINE", "36000"))
     log(f"watcher up: probe_timeout={probe_timeout:.0f}s "
-        f"quiet_sleep={quiet_sleep:.0f}s out={out_path}")
+        f"quiet_sleep={quiet_sleep:.0f}s unavail_sleep={unavail_sleep:.0f}s "
+        f"out={out_path}")
 
     probe = [sys.executable, "-c",
              "import json, jax; d = jax.devices(); "
              "print(json.dumps({'n': len(d), "
              "'backend': jax.default_backend()}))"]
     attempt = 0
-    other_leg_failures = 0
+    # separate strike counters: a healthy probe clears PROBE strikes (the
+    # env just proved itself), but must not clear LEG strikes — a
+    # deterministic device-leg failure behind a healthy probe would
+    # otherwise loop forever, each healthy probe resetting the count
+    probe_other_failures = 0
+    leg_other_failures = 0
     while time.time() < deadline:
         attempt += 1
         rec, err = run_json(probe, probe_timeout)
@@ -100,6 +117,7 @@ def main() -> int:
             if rec.get("backend") != "tpu":
                 log(f"probe healthy but backend={rec.get('backend')}; abort")
                 return 1
+            probe_other_failures = 0
             log(f"probe #{attempt}: tunnel HEALTHY ({rec}) — running device leg")
             # every leg gets the same patient deadline as the probe: a
             # kill at ~25 min races the pool's own UNAVAILABLE
@@ -115,16 +133,22 @@ def main() -> int:
                 # too, but cap consecutive occurrences so a genuinely
                 # broken leg (bad flag, import error) cannot silently
                 # burn the whole deadline.
-                if classify(derr) == "other":
-                    other_leg_failures += 1
-                    if other_leg_failures >= 3:
+                kind = classify(derr)
+                if kind == "other":
+                    leg_other_failures += 1
+                    if leg_other_failures >= 3:
                         log(f"device leg failed ({derr}); "
                             f"3 consecutive non-wedge failures; abort")
                         return 1
                 else:
-                    other_leg_failures = 0
-                log(f"device leg failed: {derr}; quiet-sleeping")
-                time.sleep(quiet_sleep)
+                    leg_other_failures = 0
+                # a timed-out leg was KILLED mid-grant (wedge risk) —
+                # long quiet time; clean failures re-try much sooner
+                sleep_s = (quiet_sleep if kind == "timeout"
+                           else unavail_sleep if kind == "unavailable"
+                           else other_sleep)
+                log(f"device leg failed: {derr}; sleeping {sleep_s:.0f}s")
+                time.sleep(sleep_s)
                 continue
             long_rec, lerr = run_json(
                 [sys.executable, BENCH, "--long-only"],
@@ -170,25 +194,29 @@ def main() -> int:
             # too (socket errors, truncated stdout) — same 3-strike cap
             # as the device leg, so one blip can't kill a 10 h watcher
             # while a genuinely broken env still aborts promptly
-            other_leg_failures += 1
-            if other_leg_failures >= 3:
+            probe_other_failures += 1
+            if probe_other_failures >= 3:
                 log(f"probe #{attempt}: 3 consecutive non-wedge "
                     f"failures ({err}); abort")
                 return 1
             log(f"probe #{attempt}: unclassified failure ({err}); "
-                f"quiet-sleeping {quiet_sleep:.0f}s")
-            time.sleep(quiet_sleep)
+                f"sleeping {other_sleep:.0f}s")
+            time.sleep(other_sleep)
             continue
-        other_leg_failures = 0
+        probe_other_failures = 0
         if kind == "timeout":
+            # the probe was KILLED — only this path needs the long
+            # anti-wedge quiet time
             log(f"probe #{attempt}: wedged (timeout {probe_timeout:.0f}s); "
                 f"quiet-sleeping {quiet_sleep:.0f}s")
+            time.sleep(quiet_sleep)
         else:
-            # fast pool-side refusal, not a wedge — keep the real error
-            # so the round post-mortem can tell the two modes apart
+            # clean pool-side refusal, no kill — keep the real error so
+            # the round post-mortem can tell the two modes apart, and
+            # re-probe after a short breather
             log(f"probe #{attempt}: pool UNAVAILABLE ({err}); "
-                f"quiet-sleeping {quiet_sleep:.0f}s")
-        time.sleep(quiet_sleep)
+                f"sleeping {unavail_sleep:.0f}s")
+            time.sleep(unavail_sleep)
     log("deadline expired without a healthy probe")
     return 2
 
