@@ -534,6 +534,20 @@ def main() -> None:
         # jax.devices() even though the run never wanted the TPU. Strip
         # the pool address so CPU smoke runs are hermetic.
         child_env.pop("PALLAS_AXON_POOL_IPS", None)
+        # CPU smoke runs: the real device protocol (150 forced-completion
+        # runs plus a B=100k XLA:CPU compile) cannot finish inside the
+        # child deadline — a default-size `make bench` burned the whole
+        # 1200 s device timeout and recorded only a TimeoutExpired.
+        # Shrink to a completing protocol unless the caller pinned sizes;
+        # the JSON stays self-describing (backend=cpu, runs, pairs_total).
+        child_env.setdefault("BENCH_RUNS", "20")
+        child_env.setdefault("BENCH_PAIRS_TOTAL", "25000")
+        # same for the long-window leg, which a completing device leg now
+        # reaches: the full 10,080-step scan protocol is the exact slow-
+        # compile workload the long-leg deadline exists to contain
+        child_env.setdefault("BENCH_LONG_WINDOW", "2048")
+        child_env.setdefault("BENCH_LONG_BATCH", "64")
+        child_env.setdefault("BENCH_LONG_RUNS", "10")
         healthy, probe_err = True, None
     else:
         healthy, probe_err = _preflight(preflight_timeout_s, preflight_window_s)
