@@ -187,6 +187,40 @@ def main() -> int:
             with open(out_path, "w") as f:
                 f.write(json.dumps(dev) + "\n")
             log(f"artifact written: {out_path}")
+            # bonus leg, AFTER the essential bank so it can't risk it:
+            # the per-kernel component profile (human-readable lines) —
+            # refreshes the docs' kernel table from a committed capture
+            # instead of the unreproduced mid-round-3 measurement
+            prof_path = os.path.join(REPO, "TPU_PROFILE_r05.txt")
+            try:
+                # same patient deadline as every leg: a kill must never
+                # fire inside the grant/compile band (it re-wedges the
+                # pool machine-wide)
+                prof = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "scripts",
+                                  "tpu_component_profile.py")],
+                    capture_output=True, text=True,
+                    timeout=max(probe_timeout, 1800.0), cwd=REPO)
+                with open(prof_path, "w") as f:
+                    f.write(prof.stdout)
+                    if prof.returncode != 0:
+                        f.write(f"\n[rc={prof.returncode}] "
+                                f"{prof.stderr[-2000:]}\n")
+                log(f"component profile written: {prof_path}")
+            except subprocess.TimeoutExpired as e:
+                # keep the per-kernel lines already measured (each prints
+                # with flush=True) — up to 30 min of healthy-window work
+                partial = e.stdout or ""
+                if isinstance(partial, bytes):
+                    partial = partial.decode(errors="replace")
+                with open(prof_path, "w") as f:
+                    f.write(partial)
+                    f.write("\n[timeout: profile killed at deadline]\n")
+                log(f"component profile timed out; partial written: "
+                    f"{prof_path}")
+            except Exception as e:  # noqa: BLE001 — strictly best-effort
+                log(f"component profile skipped: {type(e).__name__}: {e}")
             return 0
         kind = classify(err)
         if kind == "other":
