@@ -100,6 +100,22 @@ _DIRECTED = [
     # duplicate timestamps en masse (merge/average path)
     b'{"data":{"result":[{"values":[' +
     b",".join(b'[1700000000,"%d"]' % i for i in range(500)) + b']}]}}',
+    # UTF-8 BOM prefix (some proxies prepend it; scanner sees a non-JSON
+    # lead byte and must reject cleanly)
+    b'\xef\xbb\xbf{"data":{"result":[{"values":[[1,2]]}]}}',
+    # huge/degenerate exponents inside STRING values (strtod staging)
+    b'{"data":{"result":[{"values":[[1700000000,"1e99999"],'
+    b'[1700000060,"-1e-99999"],[1700000120,"0x1.fp+1021"]]}]}}',
+    # negative zero and exponent-only garbage
+    b'{"data":{"result":[{"values":[[-0.0,"-0.0"],[1700000000,"e5"]]}]}}',
+    # depth-limit straddle (kMaxDepth=64; every level incl. the innermost
+    # scalar costs one value() frame): 62 objects + array + number = 64
+    # frames -> deepest ACCEPTED body; 64 objects + number = 65 -> reject
+    b'{"a":' * 62 + b'[1]' + b'}' * 62,
+    b'{"a":' * 64 + b'1' + b'}' * 64,
+    # target key nested inside a non-target structure and vice versa
+    b'{"values":[[1,2]],"data":{"result":[{"values":[[3,"4"]]}]}}',
+    b'{"data":{"result":[{"deep":{"values":[[5,"6"]]}}]}}',
 ]
 
 _TOKENS = [b"nan", b"NaN", b"inf", b"-inf", b"1e309", b"1e-320", b"null",
