@@ -188,16 +188,25 @@ class Runtime:
         self.stop()
 
 
-def _env_seconds(name: str, default: float) -> float:
-    """Tolerant env float: empty/malformed values fall back to the default
-    (a templated-empty var must not crashloop the pod)."""
+def _env_parse(name: str, cast, default):
+    """Tolerant env knob: empty/malformed values fall back to the default
+    with a log line — a templated-empty or garbage var must not crashloop
+    the pod. One parser so the policy cannot drift between knob families."""
     raw = os.environ.get(name, "")
     try:
-        return float(raw) if raw else default
+        return cast(raw) if raw else default
     except ValueError:
         print(f"[foremast-tpu] ignoring invalid {name}={raw!r}; "
               f"using {default}", flush=True)
         return default
+
+
+def _env_seconds(name: str, default: float) -> float:
+    return _env_parse(name, float, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    return _env_parse(name, int, default)
 
 
 def main():
@@ -236,17 +245,22 @@ def main():
     if proxy:
         from .dataplane.wavefront_sink import WavefrontSink
 
-        host, _, port = proxy.partition(":")
+        host, _, wf_port = proxy.partition(":")
+        try:
+            wf_port_n = int(wf_port) if wf_port else 2878
+        except ValueError:
+            print(f"[foremast-tpu] ignoring invalid WAVEFRONT_PROXY port "
+                  f"{wf_port!r}; using 2878", flush=True)
+            wf_port_n = 2878
         rt.wavefront_sink = WavefrontSink(
-            rt.exporter, host=host, port=int(port or 2878)
+            rt.exporter, host=host, port=wf_port_n
         )
-    port = int(os.environ.get("PORT", "8099"))
-    grpc_port = int(os.environ.get("GRPC_PORT", "0")) or None
-    cycle = float(os.environ.get("CYCLE_SECONDS", "10"))
+    port = _env_int("PORT", 8099)
+    grpc_port = _env_int("GRPC_PORT", 0) or None
+    cycle = _env_seconds("CYCLE_SECONDS", 10.0)
 
     def _env_opt_int(name: str) -> int | None:
-        raw = os.environ.get(name, "")
-        return int(raw) if raw else None
+        return _env_parse(name, int, None)
 
     import signal
 

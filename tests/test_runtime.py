@@ -414,3 +414,21 @@ def test_trigger_run_forever_exits_on_request_stop(tmp_path):
     svc.request_stop()
     t.join(5)
     assert not t.is_alive() and not errors, errors
+
+
+def test_env_knob_parsing_tolerates_garbage(monkeypatch):
+    """Malformed/templated-empty env knobs must fall back with a log line,
+    never crashloop the pod (runtime.py's stated policy, now applied to
+    the int family too — PORT='garbage' used to raise at startup)."""
+    from foremast_tpu.runtime import _env_int, _env_seconds
+
+    monkeypatch.setenv("X_INT", "garbage")
+    monkeypatch.setenv("X_EMPTY", "")
+    monkeypatch.setenv("X_OK", "17")
+    assert _env_int("X_INT", 8099) == 8099
+    assert _env_int("X_EMPTY", 8099) == 8099
+    assert _env_int("X_OK", 8099) == 17
+    monkeypatch.delenv("X_ABSENT", raising=False)
+    assert _env_int("X_ABSENT", 8099) == 8099
+    monkeypatch.setenv("X_SEC", "not-a-float")
+    assert _env_seconds("X_SEC", 30.0) == 30.0
