@@ -73,8 +73,19 @@ class OperatorLoop:
             # a key can vanish because its namespace was un-annotated for
             # monitoring; only a truly deleted deployment gets on_delete
             # (which removes the app's user-managed DeploymentMetadata)
-            if self.kube.get_deployment(ns, name) is None:
-                self.deployments.on_delete(self._depl_snapshot[key])
+            try:
+                if self.kube.get_deployment(ns, name) is None:
+                    self.deployments.on_delete(self._depl_snapshot[key])
+            except Exception as e:  # noqa: BLE001 - per-item isolation,
+                # with RETRY: deletions are one-shot events not even a
+                # restart can replay (the deployment is gone from lists),
+                # so a transient failure here must keep the stale entry in
+                # the snapshot and re-attempt cleanup next tick — never
+                # silently leak the app's DeploymentMetadata
+                seen[key] = self._depl_snapshot[key]
+                self.kube.record_event(
+                    "Deployment", ns, name, "ReconcileError", str(e)
+                )
         self._depl_snapshot = seen
 
     # -- hpas --
@@ -87,10 +98,35 @@ class OperatorLoop:
                 key = (ns, h["metadata"]["name"])
                 seen[key] = copy.deepcopy(h)
                 old = self._hpa_snapshot.get(key)
-                if old != seen[key]:
-                    self.hpas.on_upsert(old, h)
+                try:
+                    if old != seen[key]:
+                        self.hpas.on_upsert(old, h)
+                except Exception as e:  # noqa: BLE001 - one bad HPA must
+                    # not wedge the tick — but the failed stamp RETRIES:
+                    # the snapshot keeps the pre-failure view (old, or no
+                    # key at all for a brand-new HPA) so the same diff
+                    # fires again next tick; a transient apiserver blip
+                    # must not silently disable hpa scoring until restart
+                    if old is not None:
+                        seen[key] = old
+                    else:
+                        del seen[key]
+                    self.kube.record_event(
+                        "HorizontalPodAutoscaler", ns, key[1],
+                        "ReconcileError", str(e)
+                    )
         for key in set(self._hpa_snapshot) - set(seen):
-            self.hpas.on_delete(self._hpa_snapshot[key])
+            try:
+                self.hpas.on_delete(self._hpa_snapshot[key])
+            except Exception as e:  # noqa: BLE001 - retry like the
+                # deployment delete loop: a deleted HPA's key never
+                # reappears, so dropping it here would leave
+                # hpa_score_enabled set on the monitor forever
+                seen[key] = self._hpa_snapshot[key]
+                self.kube.record_event(
+                    "HorizontalPodAutoscaler", key[0], key[1],
+                    "ReconcileError", str(e)
+                )
         self._hpa_snapshot = seen
 
     # -- monitors (remediation on phase flips) --
@@ -105,7 +141,18 @@ class OperatorLoop:
                 if old_phase is not None:
                     prev = copy.deepcopy(m)
                     prev.status.phase = old_phase
-                self.monitors.on_update(prev, m)
+                try:
+                    self.monitors.on_update(prev, m)
+                except Exception as e:  # noqa: BLE001 - a failed
+                    # remediation (apiserver hiccup mid-rollback) must not
+                    # abort the sweep for the other monitors; the phase is
+                    # deliberately NOT advanced, so the flip re-dispatches
+                    # next tick — remediation retries until it applies
+                    self.kube.record_event(
+                        "DeploymentMonitor", m.namespace, m.name,
+                        "RemediationError", str(e)
+                    )
+                    continue
             self._monitor_phases[key] = m.status.phase
 
     def request_stop(self):

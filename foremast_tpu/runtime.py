@@ -188,17 +188,21 @@ class Runtime:
         self.stop()
 
 
-def _env_parse(name: str, cast, default):
-    """Tolerant env knob: empty/malformed values fall back to the default
-    with a log line — a templated-empty or garbage var must not crashloop
-    the pod. One parser so the policy cannot drift between knob families."""
-    raw = os.environ.get(name, "")
+def _tolerant(raw: str, cast, default, label: str):
+    """Tolerant knob parse: empty/malformed values fall back to the
+    default with a log line — a templated-empty or garbage value must not
+    crashloop the pod. ONE implementation so the policy cannot drift
+    between knob families (env vars and compound specs alike)."""
     try:
         return cast(raw) if raw else default
     except ValueError:
-        print(f"[foremast-tpu] ignoring invalid {name}={raw!r}; "
+        print(f"[foremast-tpu] ignoring invalid {label}={raw!r}; "
               f"using {default}", flush=True)
         return default
+
+
+def _env_parse(name: str, cast, default):
+    return _tolerant(os.environ.get(name, ""), cast, default, name)
 
 
 def _env_seconds(name: str, default: float) -> float:
@@ -246,14 +250,9 @@ def main():
         from .dataplane.wavefront_sink import WavefrontSink
 
         host, _, wf_port = proxy.partition(":")
-        try:
-            wf_port_n = int(wf_port) if wf_port else 2878
-        except ValueError:
-            print(f"[foremast-tpu] ignoring invalid WAVEFRONT_PROXY port "
-                  f"{wf_port!r}; using 2878", flush=True)
-            wf_port_n = 2878
         rt.wavefront_sink = WavefrontSink(
-            rt.exporter, host=host, port=wf_port_n
+            rt.exporter, host=host,
+            port=_tolerant(wf_port, int, 2878, "WAVEFRONT_PROXY port"),
         )
     port = _env_int("PORT", 8099)
     grpc_port = _env_int("GRPC_PORT", 0) or None

@@ -854,3 +854,112 @@ def test_remediation_auto_falls_back_to_pause_when_rollback_cannot():
     kube.upsert_monitor(monitor)
     mc.on_update(None, monitor)
     assert kube.get_deployment("default", "demo")["spec"]["paused"] is True
+
+
+# ------------------------------------------------- per-item tick isolation
+
+
+def test_tick_isolates_poisoned_hpa_and_retries(monkeypatch):
+    """One HPA whose handler raises must not wedge the tick: the other
+    HPA is still processed, an event records the failure, the status
+    sweep still runs — and the failed stamp RETRIES next tick (a
+    transient apiserver blip must not silently disable hpa scoring),
+    contained to that one item."""
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata("good"))
+    kube.upsert_metadata(_metadata("poison"))
+    kube.upsert_monitor(DeploymentMonitor(name="good", namespace="default"))
+    kube.upsert_monitor(DeploymentMonitor(name="poison", namespace="default"))
+    kube.hpas[("default", "good")] = _hpa("good")
+    kube.hpas[("default", "poison")] = _hpa("poison")
+    loop = OperatorLoop(kube, ScriptedAnalyst())
+
+    real_upsert = loop.hpas.on_upsert
+
+    calls = []
+
+    def flaky(old, new):
+        calls.append(new["metadata"]["name"])
+        if new["metadata"]["name"] == "poison":
+            raise RuntimeError("boom")
+        return real_upsert(old, new)
+
+    monkeypatch.setattr(loop.hpas, "on_upsert", flaky)
+    loop.tick()
+    assert sorted(calls) == ["good", "poison"]
+    assert kube.get_monitor("default", "good").status.hpa_score_enabled
+    assert any(e["reason"] == "ReconcileError"
+               and e["kind"] == "HorizontalPodAutoscaler"
+               and e["name"] == "poison" for e in kube.events)
+    # the failed stamp retries next tick — contained to that one item
+    # (the healthy HPA, unchanged, does not re-fire)
+    calls.clear()
+    loop.tick()
+    assert calls == ["poison"]
+
+
+def test_monitor_sweep_isolates_failed_remediation_and_retries(monkeypatch):
+    """A failed remediation dispatch must not abort the sweep for other
+    monitors, and the phase flip must re-dispatch next tick (retry until
+    the apiserver accepts)."""
+    kube = FakeKube()
+    for name in ("alpha", "beta"):
+        kube.upsert_metadata(_metadata(name))
+        m = DeploymentMonitor(name=name, namespace="default")
+        m.status.phase = PHASE_UNHEALTHY
+        kube.upsert_monitor(m)
+    loop = OperatorLoop(kube, ScriptedAnalyst())
+
+    dispatched = []
+    fail_once = {"alpha": True}
+
+    def flaky(prev, mon):
+        dispatched.append(mon.name)
+        if fail_once.pop(mon.name, False):
+            raise RuntimeError("apiserver hiccup")
+
+    monkeypatch.setattr(loop.monitors, "on_update", flaky)
+    loop.tick()
+    # both monitors were dispatched despite alpha's failure
+    assert sorted(dispatched) == ["alpha", "beta"]
+    assert any(e["reason"] == "RemediationError" and e["name"] == "alpha"
+               for e in kube.events)
+    # next tick retries ONLY the failed one (beta's phase was recorded)
+    dispatched.clear()
+    loop.tick()
+    assert dispatched == ["alpha"]
+    # and once it succeeds, no further dispatch
+    dispatched.clear()
+    loop.tick()
+    assert dispatched == []
+
+
+def test_hpa_delete_cleanup_retries_on_transient_failure(monkeypatch):
+    """A deleted HPA's key never reappears in list_hpas, so a transient
+    failure in the delete cleanup must keep the stale snapshot entry and
+    retry — or the monitor keeps hpa_score_enabled for a nonexistent HPA
+    forever (not even an operator restart replays deletions)."""
+    kube = FakeKube()
+    kube.upsert_metadata(_metadata())
+    kube.upsert_monitor(DeploymentMonitor(name="demo", namespace="default"))
+    kube.hpas[("default", "demo")] = _hpa()
+    loop = OperatorLoop(kube, ScriptedAnalyst())
+    loop.tick()
+    assert kube.get_monitor("default", "demo").status.hpa_score_enabled
+
+    del kube.hpas[("default", "demo")]
+    real_delete = loop.hpas.on_delete
+    fail_once = {"n": 1}
+
+    def flaky(h):
+        if fail_once["n"]:
+            fail_once["n"] -= 1
+            raise RuntimeError("apiserver hiccup")
+        return real_delete(h)
+
+    monkeypatch.setattr(loop.hpas, "on_delete", flaky)
+    loop.tick()  # cleanup fails transiently
+    assert any(e["reason"] == "ReconcileError" for e in kube.events)
+    assert kube.get_monitor("default", "demo").status.hpa_score_enabled
+    loop.tick()  # retried and applied
+    assert not kube.get_monitor("default", "demo").status.hpa_score_enabled
