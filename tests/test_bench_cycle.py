@@ -76,15 +76,25 @@ def test_concurrent_fetch_overlaps_store_latency():
     # warmup compiles the shared score program so timing isolates fetch
     build_engine(1).run_cycle(now=t_end)
 
-    timings = {}
-    for workers in (1, 16):
-        eng = build_engine(workers)
-        t0 = time.perf_counter()
-        eng.run_cycle(now=t_end)
-        timings[workers] = time.perf_counter() - t0
     # 96 fetches x 2ms = ~0.2s serial floor; 16-wide overlap cuts it ~16x.
-    # Assert a conservative 2x so slow CI boxes still pass.
-    assert timings[16] < timings[1] / 2, timings
+    # Assert a conservative 2x so slow CI boxes still pass — and measure
+    # up to 3 times before failing: this asserts a concurrency BENEFIT,
+    # which transient background load on a shared box can mask in any
+    # single sample (observed flaking during a full-suite run that
+    # overlapped a CPU-heavy bench; passes in isolation).
+    attempts = []
+    for _ in range(3):
+        timings = {}
+        for workers in (1, 16):
+            eng = build_engine(workers)
+            t0 = time.perf_counter()
+            eng.run_cycle(now=t_end)
+            timings[workers] = time.perf_counter() - t0
+        attempts.append(timings)
+        if timings[16] < timings[1] / 2:
+            break
+    else:
+        raise AssertionError(f"no overlap benefit in 3 samples: {attempts}")
 
 
 def test_cycle_bench_small_fleet_is_steady():
