@@ -207,21 +207,42 @@ def _wilcoxon_exact_p(r_plus, n):
     Under the null each rank k in 1..n joins T+ independently with
     probability 1/2, so the pmf of T+ is the normalized coefficient
     vector of prod_k (1 + x^k) — built by a probability-space subset-sum
-    DP (no count overflow): P <- 0.5*P + 0.5*(P shifted by k), one
-    `lax.scan` step per rank over a static (N_max(N_max+1)/2 + 1)-lane
-    vector; the dynamic shift is a roll plus an edge mask, no gathers.
-    Ranks beyond the dynamic n leave P untouched. Two-sided p =
+    DP (no count overflow): P <- 0.5*P + 0.5*(P shifted by k) over a
+    static (N_max(N_max+1)/2 + 1)-lane vector; the dynamic shift is a
+    roll plus an edge mask, no gathers. The DP is data-independent given
+    n, so it runs once over ALL ranks 1..N_max emitting the pmf after
+    each rank as a table row; this pair's pmf is row n. Two-sided p =
     min(1, 2*min(P(T+ <= t), P(T+ >= t))) — scipy's exact convention.
     """
     N = WILCOXON_EXACT_MAX_N
     w = jnp.arange(N * (N + 1) // 2 + 1, dtype=_F)
     p0 = (w == 0.0).astype(_F)
 
+    # The pmf depends on NOTHING but n (<= N distinct values), so the DP
+    # runs ONCE over constants — emitting the pmf after every rank k as
+    # row k-1 of a (N, W) table — and each pair just selects its row.
+    # Inside the vmapped battery the table has no batched inputs, so it
+    # stays un-vmapped (one 50-step scan total, not one per pair); the
+    # per-pair work collapses from a 50-step DP over W lanes to a one-hot
+    # (N,)x(N, W) matvec — an MXU matmul under vmap; measured ~1.5x on
+    # the whole fused family on XLA:CPU (3.76 s -> ~2.5 s at B=12,500).
+    # The row's float history is the exact sequence the old per-pair DP
+    # produced for k <= n (later ranks were where'd to no-ops), and the
+    # one-hot contraction adds only exact 0.0 terms, so p-values are
+    # bit-identical.
     def step(P, k):
         shifted = jnp.where(w >= k, jnp.roll(P, k.astype(jnp.int32)), 0.0)
-        return jnp.where(k <= n, 0.5 * P + 0.5 * shifted, P), None
+        P = 0.5 * P + 0.5 * shifted
+        return P, P
 
-    P, _ = jax.lax.scan(step, p0, jnp.arange(1, N + 1, dtype=_F))
+    _, table = jax.lax.scan(step, p0, jnp.arange(1, N + 1, dtype=_F))
+    one_hot = (jnp.arange(1, N + 1, dtype=_F) == n).astype(_F)
+    # HIGHEST precision: the TPU's default f32 matmul rounds operands to
+    # bf16, which would shave the pmf to 8 mantissa bits and break the
+    # bit-identical / scipy-parity contract on device; with full f32
+    # accumulation the contraction only ever adds exact 0.0 terms
+    P = jnp.matmul(one_hot, table,
+                   precision=jax.lax.Precision.HIGHEST)  # (W,) pmf, row n
     cdf = jnp.sum(jnp.where(w <= r_plus + 0.5, P, 0.0))
     sf = jnp.sum(jnp.where(w >= r_plus - 0.5, P, 0.0))
     return jnp.clip(2.0 * jnp.minimum(cdf, sf), 0.0, 1.0)
