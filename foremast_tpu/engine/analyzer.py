@@ -971,6 +971,96 @@ class Analyzer:
                 for (it, *_), z in zip(chunk, zs.max(axis=1)):
                     yield it, float(z)
 
+    # ------------------------------------------- LSTM model-cache persistence
+    def save_lstm_cache(self, path: str, max_entries: int | None = None) -> int:
+        """Persist trained LSTM-AE models (params + score normalizers) so
+        a restarted runtime warm-starts instead of re-paying the budgeted
+        train-on-miss warm-up for every known app. The reference brain
+        kept its model cache in RAM only (MAX_CACHE_SIZE,
+        foremast-brain/README.md:30) — every restart retrained the fleet.
+
+        One flax msgpack blob, written atomically (tmp + rename, same
+        crash rule as the job snapshot). ``max_entries`` caps the write
+        to the most-recent entries in LRU order; the default (None)
+        persists the whole cache — it is already bounded by
+        MAX_CACHE_SIZE, and a silent lower cap would quietly re-pay the
+        warm-up for every app past it after a restart. Returns the
+        number of entries written."""
+        import json
+
+        import flax.serialization as fser
+        import jax
+
+        items = list(self._lstm_cache.items())
+        if max_entries is not None and len(items) > max_entries:
+            items = items[-max_entries:]
+        cfg = self.config
+        payload = {
+            "format": 1,
+            # architecture fingerprint: params from a different geometry
+            # must never be offered to this engine's modules
+            "arch": {"hidden": cfg.lstm_hidden, "latent": cfg.lstm_latent,
+                     "lstm_window": cfg.lstm_window},
+            "keys": json.dumps(
+                [[k[0], list(k[1]), int(k[2])] for k, _ in items]),
+            "mu": np.asarray([e[1] for _, e in items], np.float64),
+            "sd": np.asarray([e[2] for _, e in items], np.float64),
+        }
+        for idx, (_, e) in enumerate(items):
+            payload[f"p{idx}"] = jax.device_get(e[0])
+        blob = fser.msgpack_serialize(payload)
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        import os
+
+        os.replace(tmp, path)
+        return len(items)
+
+    def load_lstm_cache(self, path: str) -> int:
+        """Load a save_lstm_cache blob into the warm cache. Absent,
+        corrupt, or architecture-mismatched files load 0 entries and
+        never raise — a bad cache file must degrade to the ordinary
+        cold-start warm-up, not crash startup. Returns entries loaded."""
+        import json
+
+        import flax.serialization as fser
+
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return 0
+        cfg = self.config
+        try:
+            payload = fser.msgpack_restore(blob)
+            if payload.get("format") != 1:
+                return 0
+            arch = payload.get("arch") or {}
+            if (int(arch.get("hidden", -1)) != cfg.lstm_hidden
+                    or int(arch.get("latent", -1)) != cfg.lstm_latent
+                    or int(arch.get("lstm_window", -1)) != cfg.lstm_window):
+                return 0
+            keys = json.loads(payload["keys"])
+            mu, sd = payload["mu"], payload["sd"]
+            loaded = 0
+            for idx, k in enumerate(keys):
+                params = payload.get(f"p{idx}")
+                if params is None:
+                    continue
+                key = (str(k[0]), tuple(str(m) for m in k[1]), int(k[2]))
+                self._lstm_param_version += 1
+                self._lstm_cache[key] = (
+                    params, float(mu[idx]), float(sd[idx]),
+                    self._lstm_param_version,
+                )
+                loaded += 1
+            while len(self._lstm_cache) > cfg.max_cache_size:
+                self._lstm_cache.pop(next(iter(self._lstm_cache)))
+            return loaded
+        except Exception:  # noqa: BLE001 — corrupt cache file: cold-start
+            return 0
+
     def _score_hpa(self, items: list[_HpaItem]):
         """Batch HPA items: primary (priority 0 / tps-like) metric drives the
         traffic model; an SLA metric (is_increase & priority>0) the reward."""

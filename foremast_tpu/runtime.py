@@ -13,6 +13,10 @@ Env surface (union of the reference services'):
   QUERY_SERVICE_ENDPOINT metric-store base for the dashboard proxy
                          (foremast-service/cmd/manager/main.go:301-309)
   SNAPSHOT_PATH          job-store checkpoint file (ES's durability role)
+  LSTM_CACHE_PATH        trained LSTM-AE model cache (flax msgpack blob);
+                         loaded at startup, re-written after any cycle
+                         that trained — a restarted pod warm-starts
+                         instead of re-training every known app
   ARCHIVE_PATH           JSONL write-behind archive of terminal jobs/hpalogs
   ES_ENDPOINT            ES-compatible archive instead (reference indices
                          documents/hpalogs); takes precedence over ARCHIVE_PATH
@@ -63,6 +67,7 @@ class Runtime:
         job_retention_seconds: float = 24 * 3600.0,
         adopt_interval_seconds: float = 30.0,
         adopt_skew_margin_seconds: float = 15.0,
+        lstm_cache_path: str | None = None,
     ):
         self.config = config or from_env()
         source = data_source or PrometheusDataSource()
@@ -83,6 +88,17 @@ class Runtime:
         self.analyzer = Analyzer(
             self.config, self.source, self.store, exporter=self.exporter
         )
+        # LSTM model-cache warm-start (LSTM_CACHE_PATH): trained AE params
+        # persist across restarts so a bounced pod skips the budgeted
+        # re-training warm-up for every known app
+        self.lstm_cache_path = lstm_cache_path
+        self._lstm_saved_version = 0
+        if lstm_cache_path:
+            n = self.analyzer.load_lstm_cache(lstm_cache_path)
+            self._lstm_saved_version = self.analyzer._lstm_param_version
+            if n:
+                print(f"[foremast-tpu] warm-started {n} LSTM model(s) "
+                      f"from {lstm_cache_path}", flush=True)
         self.service = ForemastService(
             self.store, exporter=self.exporter, query_endpoint=query_endpoint,
             analyzer=self.analyzer,
@@ -150,6 +166,20 @@ class Runtime:
                 self.analyzer.run_cycle(worker=worker)
                 if self.wavefront_sink is not None:
                     self.wavefront_sink.flush()
+                if (self.lstm_cache_path
+                        and self.analyzer._lstm_param_version
+                        != self._lstm_saved_version):
+                    # only cycles that actually trained write (bounded by
+                    # the per-cycle train budget; LRU reorders don't).
+                    # Own try: an unwritable cache path must not skip the
+                    # gc below every cycle and grow RAM without bound.
+                    try:
+                        self.analyzer.save_lstm_cache(self.lstm_cache_path)
+                        self._lstm_saved_version = \
+                            self.analyzer._lstm_param_version
+                    except Exception as e:  # noqa: BLE001
+                        print(f"[foremast-tpu] lstm cache save failed: "
+                              f"{e}", flush=True)
                 self.store.gc(max_age_seconds=self.job_retention_seconds)
             except Exception as e:  # noqa: BLE001 - worker must survive a bad cycle
                 print(f"[foremast-tpu] cycle error: {e}", flush=True)
@@ -244,6 +274,7 @@ def main():
         job_retention_seconds=_env_seconds("JOB_RETENTION_SECONDS", 24 * 3600.0),
         adopt_interval_seconds=_env_seconds("ARCHIVE_ADOPT_INTERVAL", 30.0),
         adopt_skew_margin_seconds=_env_seconds("ARCHIVE_ADOPT_SKEW_MARGIN", 15.0),
+        lstm_cache_path=os.environ.get("LSTM_CACHE_PATH") or None,
     )
     proxy = os.environ.get("WAVEFRONT_PROXY", "")
     if proxy:

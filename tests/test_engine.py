@@ -1014,3 +1014,62 @@ def test_hpa_fleet_with_heterogeneous_history_lengths():
     for job in ("short:demo:hpa", "long:demo:hpa"):
         logs = store.hpalogs_for(job)
         assert logs and 0.0 <= logs[0].hpascore <= 100.0
+
+
+# ------------------------------------------- LSTM model-cache persistence
+def test_lstm_cache_roundtrip_warm_starts_fresh_analyzer(tmp_path):
+    """Train on one analyzer, save; a FRESH analyzer must, after load,
+    judge the same app WITHOUT training (asserted via the param version,
+    which every training bumps) — the restart warm-start the reference
+    brain cannot do (its model cache was RAM-only)."""
+    fixtures = {}
+    store = JobStore()
+    store.create(_multi_job(fixtures, bad=False))
+    a1 = Analyzer(_lstm_cfg(), FixtureDataSource(fixtures), store)
+    assert a1.run_cycle(now=1_000_000.0)["multi"] == J.COMPLETED_HEALTH
+    path = str(tmp_path / "lstm_cache.msgpack")
+    assert a1.save_lstm_cache(path) == 1
+
+    # warm-start: load -> judged WITHOUT any training (training bumps
+    # _lstm_param_version; it must not move past the loaded entries).
+    # One warm analyzer per scenario: _multi_job writes fixed fixture
+    # keys, so a healthy and a bad job cannot share one fixture dict.
+    for bad, expected in ((False, J.COMPLETED_HEALTH),
+                          (True, J.COMPLETED_UNHEALTH)):
+        fixtures3 = {}
+        store3 = JobStore()
+        store3.create(_multi_job(fixtures3, bad=bad))
+        warm = Analyzer(_lstm_cfg(), FixtureDataSource(fixtures3), store3)
+        assert warm.load_lstm_cache(path) == 1
+        v_loaded = warm._lstm_param_version
+        out = warm.run_cycle(now=1_000_000.0)
+        assert out["multi"] == expected
+        assert warm._lstm_param_version == v_loaded  # no retrain happened
+
+
+def test_lstm_cache_load_rejects_corrupt_and_mismatched(tmp_path):
+    import dataclasses
+
+    fixtures = {}
+    store = JobStore()
+    store.create(_multi_job(fixtures, bad=False))
+    a1 = Analyzer(_lstm_cfg(), FixtureDataSource(fixtures), store)
+    a1.run_cycle(now=1_000_000.0)
+    path = str(tmp_path / "cache.msgpack")
+    a1.save_lstm_cache(path)
+
+    # corrupt bytes: load 0, no raise
+    bad = tmp_path / "corrupt.msgpack"
+    bad.write_bytes(b"\x93\x01\x02 not msgpack really \xff\xfe")
+    fresh = Analyzer(_lstm_cfg(), FixtureDataSource({}), JobStore())
+    assert fresh.load_lstm_cache(str(bad)) == 0
+    assert fresh.load_lstm_cache(str(tmp_path / "absent")) == 0
+
+    # architecture mismatch: a different hidden size must refuse the blob
+    other = Analyzer(
+        dataclasses.replace(_lstm_cfg(), lstm_hidden=16),
+        FixtureDataSource({}), JobStore())
+    assert other.load_lstm_cache(path) == 0
+    # while the matching geometry accepts it
+    match = Analyzer(_lstm_cfg(), FixtureDataSource({}), JobStore())
+    assert match.load_lstm_cache(path) == 1
