@@ -4,7 +4,11 @@ Run when the axon tunnel is healthy:
 To isolate the exact-KS DP's device cost, run once more with
 FOREMAST_KS_EXACT_MAX_T=0 (Stephens-only) and diff the fused line.
 """
-import time, numpy as np, jax, jax.numpy as jnp
+import os, sys, time, numpy as np, jax, jax.numpy as jnp
+
+# runnable as `python scripts/tpu_component_profile.py` without an
+# installed package (sys.path[0] is scripts/, not the repo root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from foremast_tpu.ops import pairwise as pw
 from foremast_tpu.ops import forecast as fc
 from foremast_tpu.parallel import fleet
