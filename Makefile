@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast native bench bench-smoke bench-watch demo demo-hpa dryrun fuzz clean
+.PHONY: test test-fast native bench bench-smoke bench-watch demo demo-hpa dryrun fuzz chaos clean
 
 test:            ## full suite (CPU, 8 virtual devices via conftest)
 	$(PY) -m pytest tests/ -q
@@ -27,6 +27,9 @@ bench-watch:     ## background tunnel watcher: banks BENCH_LOCAL_r05.json at fir
 
 fuzz:            ## extended native-parser fuzz campaign (100k mutations)
 	$(CPU_ENV) $(PY) tests/test_native_fuzz.py --child 100000
+
+chaos:           ## seeded chaos soak: engine cycles under the fault plan
+	$(CPU_ENV) $(PY) -m pytest tests/test_chaos_soak.py -m chaos -q
 
 demo:            ## hermetic rollback demo (no cluster)
 	$(CPU_ENV) $(PY) -m foremast_tpu demo

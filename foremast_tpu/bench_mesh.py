@@ -41,10 +41,10 @@ def run(B_total: int = 8192, T: int = 128, k: int = 8,
         n_runs: int = 15) -> dict:
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from .parallel import fleet
+    from .parallel.fleet import shard_map  # version-compat shim
     from .parallel.mesh import FLEET_AXIS, fleet_mesh
 
     mesh = fleet_mesh()

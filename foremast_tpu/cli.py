@@ -69,8 +69,37 @@ def make_analyst(endpoint: str = "", transport: str = ""):
 def build_operator_loop(args, kube=None):
     """Operator loop from CLI args + env — the shipped configuration path.
 
-    Returns (loop, description); kube is injectable for tests."""
+    Returns (loop, description); kube is injectable for tests. The real
+    KubeClient ships wrapped in the resilience layer (breaker + bounded
+    retry against transport/5xx failures; FOREMAST_CHAOS can inject
+    apiserver faults underneath it) — an injected test kube stays bare."""
     from .operator.loop import OperatorLoop
+
+    if kube is None:
+        from .engine.config import from_env
+        from .resilience import BreakerBoard, ResilientKube, RetryPolicy
+        from .resilience.faults import safe_injectors
+
+        cfg = from_env()
+        kube = _kube()
+        inj = safe_injectors(
+            os.environ.get("FOREMAST_CHAOS", "")).get("kube")
+        if inj is not None:
+            from .resilience.faults import FaultyKube
+
+            kube = FaultyKube(kube, inj)
+        kube = ResilientKube(
+            kube,
+            retry=RetryPolicy(
+                max_attempts=cfg.retry_max_attempts,
+                base_delay=cfg.retry_base_delay,
+                max_delay=cfg.retry_max_delay,
+            ),
+            breakers=BreakerBoard(
+                failure_threshold=cfg.breaker_failure_threshold,
+                recovery_seconds=cfg.breaker_recovery_seconds,
+            ),
+        )
 
     endpoint = args.analyst or os.environ.get("ANALYST_ENDPOINT", "")
     transport = (
@@ -81,7 +110,7 @@ def build_operator_loop(args, kube=None):
     watch = [n.strip() for n in os.environ.get("WATCH_NAMESPACES", "").split(",")
              if n.strip()]
     loop = OperatorLoop(
-        kube if kube is not None else _kube(),
+        kube,
         analyst,
         mode=os.environ.get("MODE", "hpa_and_healthy_monitoring"),
         hpa_strategy=os.environ.get("HPA_STRATEGY", "hpa_exists"),

@@ -24,15 +24,54 @@ from ..utils.promtext import sanitize_metric_name as _sanitize_name
 
 
 class VerdictExporter:
+    # counter key-set ceiling: counter labels derive from job-submitted
+    # query-URL hosts, so without a cap a create flood with unique
+    # endpoints grows process memory and /metrics output without bound
+    # (same flood the BreakerBoard caps with max_keys)
+    MAX_COUNTER_KEYS = 4096
+
     def __init__(self, stale_seconds: float = 3600.0):
         self._lock = threading.Lock()
         self._gauges: dict[tuple, tuple[float, float]] = {}  # key -> (value, at)
+        # counters are monotone and never TIME-staled: a counter that
+        # vanishes mid-scrape makes rate() windows lie. They are bounded
+        # by KEY COUNT instead — at the ceiling, the oldest-inserted key
+        # is dropped (a reset rate() window on a hostile flood beats
+        # unbounded growth).
+        self._counters: dict[tuple, float] = {}
+        # metric name -> (prom type, help text); only metrics registered
+        # here get `# HELP`/`# TYPE` exposition lines (the legacy verdict
+        # gauges stay bare — their scrape contract predates the metadata)
+        self._meta: dict[str, tuple[str, str]] = {}
         self.stale_seconds = stale_seconds
 
     def _set(self, name: str, labels: dict, value: float):
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._gauges[key] = (float(value), time.time())
+
+    def record_gauge(self, name: str, labels: dict, value: float,
+                     help: str = ""):
+        """Public gauge with optional metadata (renders # HELP/# TYPE)."""
+        if help:
+            with self._lock:
+                self._meta.setdefault(name, ("gauge", help))
+        self._set(name, labels, value)
+
+    def record_counter(self, name: str, labels: dict, inc: float = 1.0,
+                       help: str = ""):
+        """Monotone counter sample; rendered with `# TYPE <name> counter`
+        so foremastbrain:*_total series are well-formed exposition."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            if key not in self._counters \
+                    and len(self._counters) >= self.MAX_COUNTER_KEYS:
+                del self._counters[next(iter(self._counters))]
+            self._counters[key] = self._counters.get(key, 0.0) + float(inc)
+            if help:
+                self._meta.setdefault(name, ("counter", help))
+            else:
+                self._meta.setdefault(name, ("counter", ""))
 
     def record_bounds(self, app: str, namespace: str, metric: str,
                       upper: float, lower: float, anomaly: float):
@@ -64,13 +103,38 @@ class VerdictExporter:
                 for (name, labels), (value, at) in self._gauges.items()
             ]
 
+    def counter_samples(self):
+        """[(name, labels-dict, value)] for the counter family (separate
+        from samples(): the Wavefront mirror forwards gauges only)."""
+        with self._lock:
+            return [
+                (name, dict(labels), value)
+                for (name, labels), value in self._counters.items()
+            ]
+
     def render(self) -> str:
-        """Prometheus text exposition (0.0.4)."""
+        """Prometheus text exposition (0.0.4). Samples are grouped per
+        metric name (an exposition requirement once metadata lines exist),
+        with `# HELP`/`# TYPE` emitted for metrics that registered them."""
+        by_name: dict[str, list] = {}
+        for name, labels, value in self.samples() + self.counter_samples():
+            by_name.setdefault(name, []).append((labels, value))
+        with self._lock:
+            meta = dict(self._meta)
         lines = []
-        for name, labels, value in sorted(
-            self.samples(), key=lambda s: (s[0], sorted(s[1].items()))
-        ):
-            lab = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
-            # ':' is legal in prometheus metric names (recording-rule style)
-            lines.append(f"{name}{{{lab}}} {value}")
+        for name in sorted(by_name):
+            kind_help = meta.get(name)
+            if kind_help is not None:
+                kind, help_text = kind_help
+                if help_text:
+                    lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} {kind}")
+            for labels, value in sorted(
+                by_name[name], key=lambda s: sorted(s[0].items())
+            ):
+                lab = ",".join(
+                    f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
+                # ':' is legal in prometheus metric names (recording-rule
+                # style)
+                lines.append(f"{name}{{{lab}}} {value}")
         return "\n".join(lines) + "\n"

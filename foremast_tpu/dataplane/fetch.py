@@ -249,6 +249,17 @@ class FixtureDataSource:
         raise FetchError(f"no fixture for {url}")
 
 
+class _Flight:
+    """One in-progress cache miss: the leader's outcome, shared by waiters."""
+
+    __slots__ = ("done", "result", "exc")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result = None
+        self.exc = None
+
+
 class CachingDataSource:
     """LRU+TTL wrapper, bounded by MAX_CACHE_SIZE — the reference brain's
     in-memory model/window cache (foremast-brain/README.md:30), rebuilt from
@@ -257,7 +268,16 @@ class CachingDataSource:
     The TTL is load-bearing, not an optimization detail: the engine re-fetches
     the SAME current-window URL every cycle until endTime (fail-fast recheck,
     design.md:43). A TTL-less cache would freeze the first — mostly empty —
-    response and judge stale data forever."""
+    response and judge stale data forever.
+
+    Misses are SINGLE-FLIGHT: when many fetch-pool threads miss the same
+    key at once (the every-cycle case — a TTL expiry hits all of a job's
+    duplicate queries in the same instant), only one thread calls the
+    inner source; the rest wait and reuse its result. Without this, TTL
+    expiry stampedes the backend at the exact moment it is least able to
+    take it (every waiter is a would-be concurrent query). A leader's
+    failure is re-raised to its waiters — they arrived inside the same
+    fetch window, so they share its outcome, not a retry storm."""
 
     def __init__(self, inner, max_entries: int = 1024, ttl_seconds: float = 55.0):
         # default just under the 60 s metric step: one fresh fetch per new
@@ -267,8 +287,10 @@ class CachingDataSource:
         self.ttl_seconds = ttl_seconds
         self._cache: OrderedDict[str, tuple] = OrderedDict()  # url -> (res, at)
         self._lock = threading.Lock()
+        self._flights: dict = {}  # key -> _Flight (in-progress miss)
         self.hits = 0
         self.misses = 0
+        self.single_flight_waits = 0  # threads that reused a leader's fetch
 
     def fetch(self, url: str):
         return self._cached(url, self.inner.fetch, url)
@@ -283,6 +305,14 @@ class CachingDataSource:
             return None
         return self._cached(("window", url), fw, url)
 
+    def set_cycle_deadline(self, deadline):
+        """Pass the engine's cycle deadline through to a resilient inner
+        source (no-op over plain sources) — the cache must not hide the
+        deadline plumbing from the analyzer."""
+        sd = getattr(self.inner, "set_cycle_deadline", None)
+        if sd is not None:
+            sd(deadline)
+
     def _cached(self, key, fn, *args):
         now = time.time()
         with self._lock:
@@ -293,10 +323,38 @@ class CachingDataSource:
                     self.hits += 1
                     return res
                 del self._cache[key]
-        res = fn(*args)
-        with self._lock:
-            self.misses += 1
-            self._cache[key] = (res, now)
-            if len(self._cache) > self.max_entries:
-                self._cache.popitem(last=False)
-        return res
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = _Flight()
+                self._flights[key] = flight
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            # another thread is already fetching this key: wait for its
+            # outcome instead of stampeding the backend. The leader sets
+            # the event in a finally, so this wait always terminates.
+            flight.done.wait()
+            with self._lock:
+                self.single_flight_waits += 1
+            if flight.exc is not None:
+                raise flight.exc
+            return flight.result
+        try:
+            flight.result = fn(*args)
+        except BaseException as e:
+            flight.exc = e
+            raise
+        finally:
+            # publish (result or exc already stamped on the flight), drop
+            # the flight entry, THEN wake waiters — a thread arriving after
+            # the pop starts a fresh fetch against the updated cache
+            with self._lock:
+                self._flights.pop(key, None)
+                if flight.exc is None:
+                    self.misses += 1
+                    self._cache[key] = (flight.result, now)
+                    if len(self._cache) > self.max_entries:
+                        self._cache.popitem(last=False)
+            flight.done.set()
+        return flight.result

@@ -44,6 +44,7 @@ from ..ops.windowing import (
     pack_windows,
 )
 from ..parallel import fleet as fl
+from ..resilience.policy import Deadline
 from ..utils import tracing
 from ..utils.timeutils import from_rfc3339
 from . import jobs as J
@@ -1199,7 +1200,19 @@ class Analyzer:
     def run_cycle(self, worker: str = "worker-0", now: float | None = None) -> dict:
         """One engine cycle. Returns {job_id: new_status} for observability."""
         with tracing.span("engine.cycle", worker=worker):
-            return self._run_cycle(worker, now)
+            # resilience: arm a per-cycle fetch deadline so retry/backoff
+            # trains inside a ResilientDataSource can never overrun the
+            # cycle budget (every fetch thread shares the one Deadline;
+            # plain sources have no set_cycle_deadline and skip this)
+            sd = getattr(self.source, "set_cycle_deadline", None)
+            budget = self.config.fetch_cycle_deadline_seconds
+            if sd is not None:
+                sd(Deadline.after(budget) if budget > 0 else None)
+            try:
+                return self._run_cycle(worker, now)
+            finally:
+                if sd is not None:
+                    sd(None)
 
     def _run_cycle(self, worker: str, now: float | None) -> dict:
         now = time.time() if now is None else now

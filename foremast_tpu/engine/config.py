@@ -141,6 +141,24 @@ class EngineConfig:
     # absolute. Guards ML_SLA_LIMIT=250(ms) from silently becoming
     # 250*mean under the wire flag's bare default.
     sla_limit_relative: bool = False  # ML_SLA_LIMIT_RELATIVE
+    # -- resilience layer (resilience/; docs/resilience.md) --
+    # retry train per fetch: attempts, exponential-backoff base/cap
+    retry_max_attempts: int = 3  # RETRY_MAX_ATTEMPTS
+    retry_base_delay: float = 0.2  # RETRY_BASE_DELAY (seconds)
+    retry_max_delay: float = 5.0  # RETRY_MAX_DELAY (seconds)
+    # per-window retry budget shared across every fetch: a dead backend
+    # sees bounded TOTAL load (first attempts + budget), never
+    # first-attempts x max_attempts. <= 0 removes the cap.
+    retry_budget: int = 64  # RETRY_BUDGET
+    retry_budget_window_seconds: float = 60.0  # RETRY_BUDGET_WINDOW
+    # circuit breaker per endpoint host: consecutive failures to trip,
+    # seconds open before a half-open probe
+    breaker_failure_threshold: int = 5  # BREAKER_FAILURE_THRESHOLD
+    breaker_recovery_seconds: float = 30.0  # BREAKER_RECOVERY_SECONDS
+    # per-cycle fetch deadline: retries (and their backoff sleeps) must
+    # finish inside this budget so a flapping backend cannot stretch the
+    # cycle past its cadence. 0 disables.
+    fetch_cycle_deadline_seconds: float = 8.0  # FETCH_CYCLE_DEADLINE
     policies: dict = field(default_factory=lambda: dict(DEFAULT_POLICIES))
 
     def policy_for(self, metric_name: str) -> MetricPolicy:
@@ -268,5 +286,13 @@ def from_env(env=None) -> EngineConfig:
         sla_mode=env.get("ML_SLA_MODE", "dynamic").strip().lower(),
         sla_limit=_env_float(env, "ML_SLA_LIMIT", 0.0),
         sla_limit_relative=_env_bool(env, "ML_SLA_LIMIT_RELATIVE", False),
+        retry_max_attempts=_env_int(env, "RETRY_MAX_ATTEMPTS", 3),
+        retry_base_delay=_env_float(env, "RETRY_BASE_DELAY", 0.2),
+        retry_max_delay=_env_float(env, "RETRY_MAX_DELAY", 5.0),
+        retry_budget=_env_int(env, "RETRY_BUDGET", 64),
+        retry_budget_window_seconds=_env_float(env, "RETRY_BUDGET_WINDOW", 60.0),
+        breaker_failure_threshold=_env_int(env, "BREAKER_FAILURE_THRESHOLD", 5),
+        breaker_recovery_seconds=_env_float(env, "BREAKER_RECOVERY_SECONDS", 30.0),
+        fetch_cycle_deadline_seconds=_env_float(env, "FETCH_CYCLE_DEADLINE", 8.0),
         policies=policies,
     )

@@ -132,6 +132,35 @@ def simulate_series(app, gens: list, minutes: int, t0: float,
     return ts, vals
 
 
+def _maybe_chaos_source(source, exporter):
+    """FOREMAST_CHAOS seam for the hermetic demos: when the spec names a
+    fetch plan, the fixture source gets the chaos wrapper underneath the
+    full resilience stack — the same composition the runtime ships — so
+    `FOREMAST_CHAOS="seed=7;fetch.error=0.3" foremast-tpu demo` shows the
+    engine degrading gracefully with zero code changes."""
+    import os
+
+    spec = os.environ.get("FOREMAST_CHAOS", "")
+    if not spec:
+        return source
+    from ..resilience import (
+        FaultyDataSource,
+        ResilientDataSource,
+        RetryPolicy,
+    )
+    from ..resilience.faults import safe_injectors
+
+    inj = safe_injectors(spec, context="foremast-tpu demo").get("fetch")
+    if inj is None:
+        return source
+    return ResilientDataSource(
+        FaultyDataSource(source, inj),
+        # demo loops are compressed: keep retries snappy
+        retry=RetryPolicy(max_attempts=3, base_delay=0.01, max_delay=0.1),
+        exporter=exporter,
+    )
+
+
 def run_demo(unhealthy: bool = True, history_minutes: int = 120,
              watch_minutes: int = 15, now: float | None = None) -> dict:
     """Full L1→L6 loop, hermetically:
@@ -191,8 +220,8 @@ def run_demo(unhealthy: bool = True, history_minutes: int = 120,
     # -- engine + service (L3-L5, one process) --
     store = JobStore()
     exporter = VerdictExporter()
-    analyzer = Analyzer(EngineConfig(), FixtureDataSource(resolver=resolve),
-                        store, exporter)
+    source = _maybe_chaos_source(FixtureDataSource(resolver=resolve), exporter)
+    analyzer = Analyzer(EngineConfig(), source, store, exporter)
     service = ForemastService(store, exporter=exporter)
 
     # -- 2. the cluster (L6) --

@@ -12,11 +12,14 @@ SURVEY.md §5 checkpoint/resume).
 from __future__ import annotations
 
 import copy
+import logging
 import time
 
 from .barrelman import Barrelman
 from .controllers import DeploymentController, HpaController, MonitorController
 from .types import PHASE_UNHEALTHY
+
+log = logging.getLogger("foremast_tpu.operator")
 
 
 class OperatorLoop:
@@ -162,13 +165,34 @@ class OperatorLoop:
         a mid-wait signal could deadlock on."""
         self._stop_requested = True
 
+    # ceiling for the consecutive-failure backoff below; also caps the
+    # exponent so 2**n can never overflow into a silly float
+    MAX_TICK_BACKOFF = 300.0
+
+    def _tick_delay(self, consecutive_failures: int, interval: float) -> float:
+        """Delay until the next tick: the plain interval while healthy,
+        doubling per CONSECUTIVE failure (capped) while the apiserver is
+        down — a dead control plane must not be polled at full rate."""
+        if consecutive_failures <= 0:
+            return interval
+        return min(self.MAX_TICK_BACKOFF,
+                   interval * (2.0 ** min(consecutive_failures, 10)))
+
     def run_forever(self, interval: float = 10.0):
+        consecutive_failures = 0
         while not self._stop_requested:
             t0 = time.time()
             try:
                 self.tick()
-            except Exception as e:  # noqa: BLE001 - operator must survive
-                print(f"[foremast-tpu operator] tick error: {e}", flush=True)
+                consecutive_failures = 0
+            except Exception:  # noqa: BLE001 - operator must survive
+                consecutive_failures += 1
+                log.exception(
+                    "operator tick failed (consecutive=%d, next in %.0fs)",
+                    consecutive_failures,
+                    self._tick_delay(consecutive_failures, interval),
+                )
+            delay = self._tick_delay(consecutive_failures, interval)
             while (not self._stop_requested
-                   and time.time() - t0 < interval):
-                time.sleep(min(0.2, interval))
+                   and time.time() - t0 < delay):
+                time.sleep(min(0.2, delay))

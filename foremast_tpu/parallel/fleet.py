@@ -25,7 +25,15 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at top level (check_vma spelling)
+    from jax import shard_map
+except ImportError:  # older jax: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, /, **kw):
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return _shard_map_legacy(f, **kw)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import forecast as fc

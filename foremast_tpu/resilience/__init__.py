@@ -1,0 +1,47 @@
+"""Unified resilience layer: retry/backoff, circuit breakers, deadlines,
+and a deterministic fault-injection harness.
+
+The brain sits between three unreliable dependencies — the metrics backend
+(Prometheus/Wavefront), the durable job archive (ES/file), and the kube
+apiserver — and its whole value proposition is judging OTHER apps' health,
+so it must itself degrade gracefully when those dependencies flap. This
+package makes the failure floor explicit:
+
+  * policy.py  — RetryPolicy (exponential backoff, full jitter, seedable
+    RNG, per-window retry budget) and the Deadline helper that keeps
+    retries from overrunning the engine cycle.
+  * breaker.py — thread-safe CircuitBreaker (closed/open/half-open) and a
+    per-key BreakerBoard (one breaker per endpoint host).
+  * sources.py — ResilientDataSource / ResilientArchive / ResilientKube:
+    breaker+retry+deadline composed around each external boundary. An
+    open breaker raises BreakerOpenError (a FetchError), so the
+    analyzer's existing fetch-retry path parks the job instead of
+    hammering a dead backend.
+  * faults.py  — deterministic, seedable FaultInjector + wrappers
+    (FaultyDataSource/FaultyArchive/FaultyKube) driven by the
+    FOREMAST_CHAOS spec string (docs/resilience.md), so soak runs and
+    the demo can turn chaos on without code changes.
+"""
+from .breaker import (  # noqa: F401
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    BreakerBoard,
+    CircuitBreaker,
+)
+from .faults import (  # noqa: F401
+    FaultInjector,
+    FaultPlan,
+    FaultyArchive,
+    FaultyDataSource,
+    FaultyKube,
+    parse_chaos_spec,
+)
+from .policy import Deadline, RetryBudget, RetryPolicy  # noqa: F401
+from .sources import (  # noqa: F401
+    BreakerOpenError,
+    ResilientArchive,
+    ResilientDataSource,
+    ResilientKube,
+    host_key,
+)

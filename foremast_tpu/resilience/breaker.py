@@ -1,0 +1,210 @@
+"""Thread-safe circuit breakers, keyed per endpoint host.
+
+Closed (normal) -> open after `failure_threshold` CONSECUTIVE failures;
+open fast-fails every call for `recovery_seconds`; then half-open admits
+`half_open_max_calls` probes — one success closes, one failure re-opens.
+
+Counting consecutive (not windowed) failures matches the engine's traffic
+shape: every cycle hammers the same few backends with hundreds of
+identically-fated requests, so a flapping backend alternates breakers
+between closed and open instead of pinning a rate estimator.
+
+State-change hooks fire OUTSIDE the lock (a metrics hook that re-enters a
+breaker — e.g. an exporter flushing through the same source — must not
+deadlock), in transition order per breaker.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+STATE_CLOSED = "closed"
+STATE_HALF_OPEN = "half_open"
+STATE_OPEN = "open"
+
+# numeric encoding for the foremastbrain:breaker_state gauge — ordered by
+# "how broken": dashboards can alert on max(breaker_state) > 0
+STATE_VALUES = {STATE_CLOSED: 0.0, STATE_HALF_OPEN: 1.0, STATE_OPEN: 2.0}
+
+
+class CircuitBreaker:
+    def __init__(self, name: str = "", failure_threshold: int = 5,
+                 recovery_seconds: float = 30.0,
+                 half_open_max_calls: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.recovery_seconds = float(recovery_seconds)
+        self.half_open_max_calls = max(1, int(half_open_max_calls))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._failures = 0  # consecutive failures while closed
+        self._opened_at = 0.0
+        self._half_open_inflight = 0
+        self._hooks: list[Callable[[str, str, str], None]] = []
+        self.trips = 0  # closed/half-open -> open transitions
+        self.rejections = 0  # calls fast-failed while open
+
+    def subscribe(self, hook: Callable[[str, str, str], None]):
+        """hook(name, old_state, new_state) after every transition."""
+        self._hooks.append(hook)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            fired = self._tick()
+            state = self._state
+        if fired:
+            self._fire(*fired)
+        return state
+
+    def _tick(self):
+        """Lock held: lazily move open -> half-open once recovery elapsed.
+        Returns the transition to fire (outside the lock), or None."""
+        if (self._state == STATE_OPEN
+                and self._clock() - self._opened_at >= self.recovery_seconds):
+            self._state = STATE_HALF_OPEN
+            self._half_open_inflight = 0
+            return (STATE_OPEN, STATE_HALF_OPEN)
+        return None
+
+    def allow(self) -> bool:
+        """True = the caller may attempt; False = fast-fail now.
+
+        A True from a half-open breaker reserves a probe slot — the caller
+        MUST follow with record_success() or record_failure()."""
+        with self._lock:
+            fired = self._tick()
+            state = self._state
+            if state == STATE_CLOSED:
+                allowed = True
+            elif state == STATE_OPEN:
+                self.rejections += 1
+                allowed = False
+            else:  # half-open: bounded probes only
+                if self._half_open_inflight < self.half_open_max_calls:
+                    self._half_open_inflight += 1
+                    allowed = True
+                else:
+                    self.rejections += 1
+                    allowed = False
+        if fired:
+            self._fire(*fired)
+        return allowed
+
+    def release(self):
+        """Release an allow()-reserved probe slot with NO health verdict —
+        for calls that turn out to be neutral (e.g. a fetch_window that
+        answers "this source has no byte path"). State is untouched; a
+        half-open breaker simply gets its probe slot back."""
+        with self._lock:
+            if self._state == STATE_HALF_OPEN:
+                self._half_open_inflight = max(0, self._half_open_inflight - 1)
+
+    def record_success(self):
+        fired = None
+        with self._lock:
+            if self._state == STATE_HALF_OPEN:
+                self._half_open_inflight = max(0, self._half_open_inflight - 1)
+                fired = (self._state, STATE_CLOSED)
+                self._state = STATE_CLOSED
+            self._failures = 0
+        if fired:
+            self._fire(*fired)
+
+    def record_failure(self):
+        fired = None
+        with self._lock:
+            if self._state == STATE_HALF_OPEN:
+                # probe failed: straight back to open, fresh recovery clock
+                self._half_open_inflight = max(0, self._half_open_inflight - 1)
+                fired = (self._state, STATE_OPEN)
+                self._state = STATE_OPEN
+                self._opened_at = self._clock()
+                self.trips += 1
+            elif self._state == STATE_CLOSED:
+                self._failures += 1
+                if self._failures >= self.failure_threshold:
+                    fired = (self._state, STATE_OPEN)
+                    self._state = STATE_OPEN
+                    self._opened_at = self._clock()
+                    self.trips += 1
+        if fired:
+            self._fire(*fired)
+
+    def _fire(self, old: str, new: str):
+        for hook in self._hooks:
+            try:
+                hook(self.name, old, new)
+            except Exception:  # noqa: BLE001 - hooks are observability only
+                pass
+
+
+class BreakerBoard:
+    """Per-key breakers (one per endpoint host) created on demand with one
+    shared config; new breakers inherit the board's subscribed hooks."""
+
+    def __init__(self, failure_threshold: int = 5,
+                 recovery_seconds: float = 30.0,
+                 half_open_max_calls: int = 1,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_keys: int = 1024):
+        self.failure_threshold = failure_threshold
+        self.recovery_seconds = recovery_seconds
+        self.half_open_max_calls = half_open_max_calls
+        self._clock = clock
+        # keys derive from job-submitted query URLs: bound them so a
+        # hostile create flood cannot grow the board without limit
+        self.max_keys = max_keys
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+        self._hooks: list[Callable[[str, str, str], None]] = []
+
+    def subscribe(self, hook: Callable[[str, str, str], None]):
+        with self._lock:
+            self._hooks.append(hook)
+            existing = list(self._breakers.values())
+        for br in existing:
+            br.subscribe(hook)
+
+    def for_key(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                if len(self._breakers) >= self.max_keys:
+                    # evict a CLOSED breaker if any exists: dropping an
+                    # open one would silently re-admit traffic to a dead
+                    # backend (a recreated breaker starts closed). Losing
+                    # a closed breaker only forgets a failure streak.
+                    victim = next(
+                        (k for k, b in self._breakers.items()
+                         if b._state == STATE_CLOSED),
+                        next(iter(self._breakers)),
+                    )
+                    self._breakers.pop(victim)
+                br = CircuitBreaker(
+                    name=key,
+                    failure_threshold=self.failure_threshold,
+                    recovery_seconds=self.recovery_seconds,
+                    half_open_max_calls=self.half_open_max_calls,
+                    clock=self._clock,
+                )
+                for hook in self._hooks:
+                    br.subscribe(hook)
+                self._breakers[key] = br
+            return br
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return {br.name: br.state for br in breakers}
+
+    def counters(self) -> dict[str, dict]:
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return {
+            br.name: {"trips": br.trips, "rejections": br.rejections}
+            for br in breakers
+        }

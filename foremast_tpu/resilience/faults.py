@@ -1,0 +1,334 @@
+"""Deterministic fault injection: the FOREMAST_CHAOS harness.
+
+Nothing in a resilience layer is real until something can break on
+command. This module injects faults at the three external boundaries with
+a SEEDED RNG and call-count-deterministic windows, so a failing soak run
+replays bit-identically from its seed.
+
+FOREMAST_CHAOS grammar (full reference: docs/resilience.md):
+
+    spec    := clause (';' clause)*
+    clause  := 'seed=' INT
+             | target '.' fault '=' value
+    target  := 'fetch' | 'archive' | 'kube'
+    fault   := 'error'   '=' PROB            random injected error
+             | 'latency' '=' PROB ':' SECS   random added latency
+             | 'timeout' '=' PROB ':' SECS   latency then error (slow fail)
+             | 'garbage' '=' PROB            truncated/garbage body
+                                             (fetch target only)
+             | 'flap'    '=' UP ':' DOWN     healthy UP calls, dead DOWN
+                                             calls, repeating
+             | 'outage'  '=' FROM '..' TO    every call in [FROM, TO)
+                                             (0-based call index) fails —
+                                             the "error burst" primitive
+
+    example: "seed=42;fetch.error=0.3;fetch.latency=0.2:0.05;archive.outage=40..80"
+
+Each target draws from its own RNG stream (seed xor a stable per-target
+hash), so adding a kube clause cannot shift the fetch stream's decisions.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+
+from ..dataplane.fetch import FetchError
+from ..operator.kube import KubeError
+
+# injected-garbage response bodies, cycled deterministically: a truncated
+# JSON document, valid JSON of the wrong shape, and raw non-JSON bytes —
+# each exercises a different layer of the real parse path
+GARBAGE_BODIES = (
+    b'{"status":"success","data":{"result":[{"values":[[160',
+    b'{"status":"success","data":"not-a-result-map"}',
+    b"\x00\xffgarbage\x9c not json at all",
+)
+
+
+class InjectedError(Exception):
+    """Marker base so tests can tell injected faults from real bugs."""
+
+
+class InjectedFetchError(FetchError, InjectedError):
+    pass
+
+
+class InjectedArchiveError(InjectedError):
+    pass
+
+
+class InjectedKubeError(KubeError, InjectedError):
+    def __init__(self, message: str):
+        KubeError.__init__(self, message, status=0)
+
+
+@dataclass
+class FaultPlan:
+    """Per-target fault plan (all fields optional; zero = off)."""
+
+    error_rate: float = 0.0
+    latency_rate: float = 0.0
+    latency_seconds: float = 0.0
+    timeout_rate: float = 0.0
+    timeout_seconds: float = 0.0
+    garbage_rate: float = 0.0
+    flap_up: int = 0
+    flap_down: int = 0
+    outages: list = field(default_factory=list)  # [(from_call, to_call)]
+
+    def active(self) -> bool:
+        return bool(
+            self.error_rate or self.latency_rate or self.timeout_rate
+            or self.garbage_rate or self.flap_down or self.outages
+        )
+
+
+def _parse_pair(value: str, what: str) -> tuple[float, float]:
+    a, sep, b = value.partition(":")
+    if not sep:
+        raise ValueError(f"{what} needs PROB:SECONDS, got {value!r}")
+    return float(a), float(b)
+
+
+def parse_chaos_spec(spec: str) -> tuple[int, dict[str, FaultPlan]]:
+    """FOREMAST_CHAOS string -> (seed, {target: FaultPlan}). Raises
+    ValueError on malformed clauses (callers decide whether a bad spec is
+    fatal: the runtime logs-and-ignores, tests assert)."""
+    seed = 0
+    plans: dict[str, FaultPlan] = {}
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        key, sep, value = clause.partition("=")
+        if not sep:
+            raise ValueError(f"chaos clause {clause!r} has no '='")
+        key = key.strip()
+        value = value.strip()
+        if key == "seed":
+            seed = int(value)
+            continue
+        target, dot, fault = key.partition(".")
+        if not dot or target not in ("fetch", "archive", "kube"):
+            raise ValueError(f"chaos clause {clause!r}: unknown target")
+        plan = plans.setdefault(target, FaultPlan())
+        if fault == "error":
+            plan.error_rate = float(value)
+        elif fault == "latency":
+            plan.latency_rate, plan.latency_seconds = _parse_pair(value, fault)
+        elif fault == "timeout":
+            plan.timeout_rate, plan.timeout_seconds = _parse_pair(value, fault)
+        elif fault == "garbage":
+            if target != "fetch":
+                raise ValueError("garbage applies to the fetch target only")
+            plan.garbage_rate = float(value)
+        elif fault == "flap":
+            up, _, down = value.partition(":")
+            plan.flap_up, plan.flap_down = int(up), int(down)
+        elif fault == "outage":
+            lo, sep2, hi = value.partition("..")
+            if not sep2:
+                raise ValueError(f"outage needs FROM..TO, got {value!r}")
+            plan.outages.append((int(lo), int(hi)))
+        else:
+            raise ValueError(f"chaos clause {clause!r}: unknown fault {fault!r}")
+    return seed, plans
+
+
+# decision tokens returned by FaultInjector.decide()
+OK, ERROR, GARBAGE = "ok", "error", "garbage"
+
+
+class FaultInjector:
+    """One target's seeded fault stream. Deterministic: decisions depend
+    only on (plan, seed, call index) — latency sleeps are side effects and
+    never consume randomness when their rate is 0."""
+
+    def __init__(self, plan: FaultPlan, seed: int = 0, target: str = "",
+                 sleep=time.sleep):
+        self.plan = plan
+        self.target = target
+        # independent stream per target: adding one target's clauses must
+        # not shift another's decisions
+        self._rng = random.Random(seed ^ zlib.crc32(target.encode()))
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.injected_errors = 0
+        self.injected_latency = 0
+        self.injected_garbage = 0
+
+    def decide(self) -> str:
+        """Advance one call: maybe sleep (latency), then return OK / ERROR
+        / GARBAGE. Deterministic windows (outage, flap) are evaluated on
+        the call index BEFORE any randomness is drawn."""
+        p = self.plan
+        with self._lock:
+            i = self.calls
+            self.calls += 1
+            # deterministic windows first: they consume no randomness
+            for lo, hi in p.outages:
+                if lo <= i < hi:
+                    self.injected_errors += 1
+                    return ERROR
+            if p.flap_down > 0:
+                period = max(1, p.flap_up + p.flap_down)
+                if (i % period) >= p.flap_up:
+                    self.injected_errors += 1
+                    return ERROR
+            # randomized faults, drawn in a fixed order so the stream is
+            # stable under a fixed plan
+            delay = 0.0
+            outcome = OK
+            if p.timeout_rate > 0 and self._rng.random() < p.timeout_rate:
+                delay = p.timeout_seconds
+                outcome = ERROR
+            elif p.error_rate > 0 and self._rng.random() < p.error_rate:
+                outcome = ERROR
+            elif p.garbage_rate > 0 and self._rng.random() < p.garbage_rate:
+                outcome = GARBAGE
+            if outcome == OK and p.latency_rate > 0 \
+                    and self._rng.random() < p.latency_rate:
+                delay = p.latency_seconds
+            if outcome == ERROR:
+                self.injected_errors += 1
+            elif outcome == GARBAGE:
+                self.injected_garbage += 1
+            if delay > 0:
+                self.injected_latency += 1
+        if delay > 0:
+            self._sleep(delay)  # outside the lock: latency must not serialize
+        return outcome
+
+    def garbage_body(self) -> bytes:
+        with self._lock:
+            body = GARBAGE_BODIES[self.injected_garbage % len(GARBAGE_BODIES)]
+        return body
+
+
+class FaultyDataSource:
+    """Chaos wrapper for a data source: injected errors raise
+    InjectedFetchError; garbage feeds a corrupted body through the REAL
+    parse path (the production failure is a proxy's 200-with-junk, not a
+    clean exception)."""
+
+    def __init__(self, inner, injector: FaultInjector):
+        self.inner = inner
+        self.injector = injector
+
+    def _act(self, fn, url: str, garbage_fn):
+        act = self.injector.decide()
+        if act == ERROR:
+            raise InjectedFetchError(f"chaos: injected fetch error for {url}")
+        if act == GARBAGE:
+            return garbage_fn(self.injector.garbage_body())
+        return fn(url)
+
+    def fetch(self, url: str):
+        from ..dataplane.fetch import parse_prometheus_body
+
+        return self._act(self.inner.fetch, url, parse_prometheus_body)
+
+    def fetch_window(self, url: str):
+        fw = getattr(self.inner, "fetch_window", None)
+        if fw is None:
+            return None
+        from ..dataplane.fetch import window_from_prometheus_body
+
+        return self._act(fw, url, window_from_prometheus_body)
+
+
+class FaultyArchive:
+    """Chaos wrapper for an archive: injected failures mimic the real
+    best-effort contract (False/None/[] sentinels), never exceptions —
+    EsArchive itself swallows transport errors, so callers must survive
+    sentinels, and the chaos layer tests exactly that."""
+
+    def __init__(self, inner, injector: FaultInjector):
+        self.inner = inner
+        self.injector = injector
+        self._injected_failures = 0
+
+    @property
+    def errors(self):
+        """LIVE view: injected failures + the inner archive's own error
+        count. A property (not a snapshot) so ResilientArchive's
+        errors-delta failure detection still sees REAL swallowed
+        transport errors while chaos is active."""
+        return self._injected_failures + getattr(self.inner, "errors", 0)
+
+    def _act(self, name, sentinel, *args, **kw):
+        if self.injector.decide() == OK:
+            return getattr(self.inner, name)(*args, **kw)
+        self._injected_failures += 1  # mirror EsArchive's contract
+        return sentinel
+
+    def index_job(self, doc):
+        return self._act("index_job", False, doc)
+
+    def index_hpalog(self, log):
+        return self._act("index_hpalog", False, log)
+
+    def index_state(self, key, value, updated_at):
+        return self._act("index_state", False, key, value, updated_at)
+
+    def get(self, job_id):
+        return self._act("get", None, job_id)
+
+    def get_state(self, key):
+        return self._act("get_state", None, key)
+
+    def search(self, *args, **kw):
+        return self._act("search", [], *args, **kw)
+
+
+class FaultyKube:
+    """Chaos wrapper for a kube client: injected failures raise
+    InjectedKubeError (status 0 — a transport-level failure)."""
+
+    def __init__(self, inner, injector: FaultInjector):
+        self.inner = inner
+        self.injector = injector
+
+    def __getattr__(self, name: str):
+        inner = self.__dict__.get("inner")
+        if inner is None:
+            raise AttributeError(name)
+        attr = getattr(inner, name)
+        if name.startswith("_") or not callable(attr):
+            return attr
+
+        def call(*args, **kw):
+            if self.injector.decide() == OK:
+                return attr(*args, **kw)
+            raise InjectedKubeError(f"chaos: injected kube error in {name}")
+
+        return call
+
+
+def injectors_from_spec(spec: str, sleep=time.sleep) -> dict[str, FaultInjector]:
+    """Spec string -> {target: FaultInjector} for the active targets."""
+    seed, plans = parse_chaos_spec(spec)
+    return {
+        target: FaultInjector(plan, seed=seed, target=target, sleep=sleep)
+        for target, plan in plans.items()
+        if plan.active()
+    }
+
+
+def safe_injectors(spec: str,
+                   context: str = "foremast-tpu") -> dict[str, FaultInjector]:
+    """injectors_from_spec with log-and-ignore on a malformed spec — the
+    ONE implementation of the runtime/CLI/demo contract that a bad
+    FOREMAST_CHAOS value must never crashloop a pod. Empty/unset specs
+    return {} silently."""
+    if not spec:
+        return {}
+    try:
+        return injectors_from_spec(spec)
+    except ValueError as e:
+        print(f"[{context}] ignoring invalid FOREMAST_CHAOS: {e}", flush=True)
+        return {}

@@ -1,0 +1,152 @@
+"""Retry policy: exponential backoff with full jitter, budgets, deadlines.
+
+Design constraints (ISSUE 1 / PAPERS "Think Before You Grid-Search" floor
+triage):
+
+  * Jitter comes from a SEEDABLE RNG so soak runs replay bit-identically —
+    the chaos harness (faults.py) and the retry path must never disagree
+    about what "the same run" means.
+  * Retries consume a per-window BUDGET shared across call sites: a dead
+    backend must see bounded total load (first attempts + budget), not
+    first-attempts x max_attempts. Without the budget, retry amplification
+    triples the load on a backend at the exact moment it is least able to
+    take it.
+  * A Deadline clips every backoff sleep so retrying can never overrun the
+    engine cycle that asked for the data.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+
+class Deadline:
+    """Monotonic-clock deadline threaded through a fetch and its retries.
+
+    Immutable after construction, so one instance is safely shared by every
+    worker thread of a cycle (analyzer sets one per cycle; each retry loop
+    only reads it)."""
+
+    __slots__ = ("at", "_clock")
+
+    def __init__(self, at: float, clock: Callable[[], float] = time.monotonic):
+        self.at = float(at)
+        self._clock = clock
+
+    @classmethod
+    def after(cls, seconds: float,
+              clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls(clock() + float(seconds), clock)
+
+    def remaining(self) -> float:
+        return self.at - self._clock()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def clip(self, delay: float) -> float:
+        """Largest sleep <= delay that still wakes before the deadline."""
+        return max(0.0, min(float(delay), self.remaining()))
+
+
+class RetryBudget:
+    """Sliding-window retry budget: at most `max_retries` RETRIES (first
+    attempts are free) per `window_seconds`, across every caller sharing
+    the instance. Thread-safe; denials are counted for observability."""
+
+    def __init__(self, max_retries: int = 64, window_seconds: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_retries = max_retries
+        self.window_seconds = window_seconds
+        self._clock = clock
+        self._spent: deque[float] = deque()
+        self._lock = threading.Lock()
+        self.denials = 0
+
+    def try_spend(self) -> bool:
+        """Reserve one retry; False = budget exhausted for this window."""
+        if self.max_retries <= 0:
+            return True  # 0/negative = unlimited (breaker still bounds load)
+        now = self._clock()
+        with self._lock:
+            horizon = now - self.window_seconds
+            while self._spent and self._spent[0] <= horizon:
+                self._spent.popleft()
+            if len(self._spent) >= self.max_retries:
+                self.denials += 1
+                return False
+            self._spent.append(now)
+            return True
+
+
+class RetryPolicy:
+    """Exponential backoff with FULL jitter (sleep ~ U[0, min(cap, base*2^n)]).
+
+    Full jitter (the AWS architecture-blog result the reference ecosystem
+    standardized on) decorrelates a thundering herd better than equal
+    jitter at the same expected delay. The RNG is seedable so a fixed-seed
+    soak reproduces its exact sleep schedule."""
+
+    def __init__(self, max_attempts: int = 3, base_delay: float = 0.2,
+                 max_delay: float = 5.0, seed: int | None = None,
+                 budget: RetryBudget | None = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.budget = budget
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()  # RNG + counters shared across threads
+        self.attempts_total = 0
+        self.retries_total = 0
+        self.deadline_clips = 0
+
+    def backoff(self, attempt: int) -> float:
+        """Jittered delay before retry number `attempt+1` (0-based)."""
+        cap = min(self.max_delay, self.base_delay * (2.0 ** attempt))
+        with self._lock:
+            return self._rng.uniform(0.0, cap)
+
+    def call(self, fn: Callable, *args,
+             deadline: Deadline | None = None,
+             no_retry: tuple = (),
+             on_retry: Callable[[BaseException], None] | None = None,
+             **kwargs):
+        """Run fn with retries. `no_retry` exceptions propagate immediately
+        (an open breaker must fast-fail, not burn attempts); `on_retry` is
+        invoked once per retry actually scheduled (metrics hook)."""
+        last: BaseException | None = None
+        for attempt in range(self.max_attempts):
+            with self._lock:
+                self.attempts_total += 1
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - boundary wrapper
+                if no_retry and isinstance(e, no_retry):
+                    raise
+                last = e
+            if attempt + 1 >= self.max_attempts:
+                break
+            if deadline is not None and deadline.expired():
+                break  # no time left: surrender the remaining attempts
+            if self.budget is not None and not self.budget.try_spend():
+                break  # window budget spent: fail now, don't multiply load
+            delay = self.backoff(attempt)
+            if deadline is not None:
+                clipped = deadline.clip(delay)
+                if clipped < delay:
+                    with self._lock:
+                        self.deadline_clips += 1
+                delay = clipped
+            with self._lock:
+                self.retries_total += 1
+            if on_retry is not None:
+                on_retry(last)
+            if delay > 0.0:
+                self._sleep(delay)
+        assert last is not None
+        raise last

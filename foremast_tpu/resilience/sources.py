@@ -1,0 +1,352 @@
+"""Resilient wrappers for the three external boundaries.
+
+  * ResilientDataSource — breaker+retry+deadline around any data source's
+    fetch/fetch_window. An open breaker raises BreakerOpenError, a
+    FetchError subclass, so the analyzer's existing fetch-retry path
+    (engine/analyzer.py prep_many) parks the job instead of hammering.
+  * ResilientArchive — breaker around a write-behind archive. Archives are
+    best-effort by contract (EsArchive swallows its own transport errors
+    and returns False/None/[]), so failures are detected via the
+    archive's own `errors` counter delta and an open breaker short-
+    circuits to the same sentinel returns without touching the network.
+  * ResilientKube — breaker+retry around the operator's kube client.
+    Only transport errors and 5xx count as failures; 4xx (not-found,
+    conflict) are API answers, not backend health.
+
+All wrappers share one metrics surface: counters/gauges are recorded into
+any object exposing record_counter/record_gauge (the VerdictExporter), as
+  foremastbrain:fetch_retries_total{host=...}
+  foremastbrain:breaker_state{host=...}            0 closed / 1 half / 2 open
+  foremastbrain:breaker_transitions_total{host=..., to=...}
+  foremastbrain:breaker_rejections_total{host=...}
+"""
+from __future__ import annotations
+
+from urllib.parse import urlparse
+
+from ..dataplane.fetch import FetchError
+from ..operator.kube import KubeError
+from .breaker import STATE_VALUES, BreakerBoard
+from .policy import Deadline, RetryPolicy
+
+
+class BreakerOpenError(FetchError):
+    """Fast failure: the breaker for this endpoint is open. Subclasses
+    FetchError so every consumer that already survives a fetch failure
+    (job parking, pod-window best-effort) handles it unchanged — just
+    in microseconds instead of a connect timeout."""
+
+
+def host_key(url: str) -> str:
+    """Breaker key for a query URL: the endpoint host. Queries fan out per
+    job but share a handful of backends; keying per host means one dead
+    Prometheus opens ONE breaker for all its queries while an unrelated
+    Wavefront endpoint stays live."""
+    try:
+        netloc = urlparse(url).netloc
+    except ValueError:
+        netloc = ""
+    return netloc or (url or "unknown")
+
+
+class _Metrics:
+    """Null-safe adapter over the exporter's counter/gauge surface. The
+    breaker series are SHARED across boundaries — the `host` label (an
+    endpoint host, or the literal "archive"/"kube") tells them apart."""
+
+    def __init__(self, exporter):
+        self.exporter = exporter
+
+    def count(self, name: str, labels: dict, inc: float = 1.0, help: str = ""):
+        if self.exporter is not None:
+            self.exporter.record_counter(
+                f"foremastbrain:{name}", labels, inc, help=help)
+
+    def gauge(self, name: str, labels: dict, value: float, help: str = ""):
+        if self.exporter is not None:
+            self.exporter.record_gauge(
+                f"foremastbrain:{name}", labels, value, help=help)
+
+
+class _ResilientBase:
+    """Shared breaker-board wiring + state-gauge export."""
+
+    def __init__(self, retry: RetryPolicy | None,
+                 breakers: BreakerBoard | None, exporter=None):
+        self.retry = retry or RetryPolicy()
+        self.breakers = breakers or BreakerBoard()
+        self._metrics = _Metrics(exporter)
+        self.breakers.subscribe(self._on_breaker_change)
+
+    def _on_breaker_change(self, name: str, old: str, new: str):
+        self._metrics.gauge(
+            "breaker_state", {"host": name}, STATE_VALUES[new],
+            help="dependency circuit state: 0 closed, 1 half-open, 2 open")
+        self._metrics.count(
+            "breaker_transitions_total", {"host": name, "to": new},
+            help="circuit state changes by destination state")
+
+    def refresh_metrics(self):
+        """Re-stamp every breaker's state gauge. Called at scrape time
+        (service /metrics): transitions only fire on CALLS, so a breaker
+        left open with no traffic (every job targeting it already parked)
+        would otherwise age past the exporter's stale-eviction horizon
+        and vanish from dashboards while still open."""
+        for key, state in self.breakers.states().items():
+            self._metrics.gauge(
+                "breaker_state", {"host": key}, STATE_VALUES[state],
+                help="dependency circuit state: 0 closed, 1 half-open, 2 open")
+
+    def snapshot(self) -> dict:
+        """Live resilience view for /status: breaker states + counters."""
+        return {
+            "breakers": self.breakers.states(),
+            "breaker_counters": self.breakers.counters(),
+            "retries_total": self.retry.retries_total,
+            "attempts_total": self.retry.attempts_total,
+            "retry_budget_denials": (
+                self.retry.budget.denials if self.retry.budget else 0),
+            "deadline_clips": self.retry.deadline_clips,
+        }
+
+
+class ResilientDataSource(_ResilientBase):
+    """Breaker + retry + deadline composed around fetch/fetch_window.
+
+    The cycle deadline is SET by the analyzer at cycle start
+    (set_cycle_deadline) and shared read-only by every fetch thread of
+    that cycle; per-fetch `deadline_seconds` bounds a single fetch's
+    retry train when no cycle deadline is active."""
+
+    def __init__(self, inner, retry: RetryPolicy | None = None,
+                 breakers: BreakerBoard | None = None,
+                 deadline_seconds: float = 0.0, exporter=None):
+        super().__init__(retry, breakers, exporter)
+        self.inner = inner
+        self.deadline_seconds = deadline_seconds
+        self._cycle_deadline: Deadline | None = None
+
+    # -- deadline plumbing (engine cycle boundary) --
+    def set_cycle_deadline(self, deadline: Deadline | None):
+        self._cycle_deadline = deadline
+
+    def _deadline(self) -> Deadline | None:
+        if self._cycle_deadline is not None:
+            return self._cycle_deadline
+        if self.deadline_seconds > 0:
+            return Deadline.after(self.deadline_seconds)
+        return None
+
+    # -- data-source surface --
+    def fetch(self, url: str):
+        return self._call(self.inner.fetch, url)
+
+    def fetch_window(self, url: str):
+        fw = getattr(self.inner, "fetch_window", None)
+        if fw is None:
+            return None  # engine falls back to fetch(), like CachingDataSource
+        return self._call(fw, url)
+
+    def _call(self, fn, url: str):
+        key = host_key(url)
+        br = self.breakers.for_key(key)
+        labels = {"host": key}
+
+        def attempt():
+            # re-consult the breaker on EVERY attempt: a concurrent thread
+            # may have tripped it mid-retry, and a half-open breaker hands
+            # out one bounded probe slot at a time
+            if not br.allow():
+                self._metrics.count(
+                    "breaker_rejections_total", labels,
+                    help="fetches fast-failed while the circuit was open")
+                raise BreakerOpenError(f"breaker open for {key}")
+            try:
+                res = fn(url)
+            except BreakerOpenError:
+                raise
+            except Exception:
+                br.record_failure()
+                raise
+            if res is None:
+                # a None fetch_window means "this source has no byte-level
+                # path" — NOT backend-health evidence. Recording it as a
+                # success would reset the consecutive-failure count before
+                # every real fetch and the breaker could never trip.
+                br.release()
+                return None
+            br.record_success()
+            return res
+
+        def on_retry(_exc):
+            self._metrics.count(
+                "fetch_retries_total", labels,
+                help="fetch attempts beyond the first, by endpoint host")
+
+        try:
+            return self.retry.call(
+                attempt, deadline=self._deadline(),
+                no_retry=(BreakerOpenError,), on_retry=on_retry)
+        except FetchError:
+            raise
+        except Exception as e:  # noqa: BLE001 - garbage 200 bodies raise
+            # parse errors (JSONDecodeError); surfacing them as FetchError
+            # parks the JOB (the analyzer's contract) instead of killing
+            # the whole cycle's preprocess stage
+            raise FetchError(f"fetch failed after retries: {e}") from e
+
+
+# archive method -> sentinel returned when the breaker is open (the same
+# shapes EsArchive returns on a swallowed transport error)
+_ARCHIVE_FAILS = {
+    "index_job": False, "index_hpalog": False, "index_state": False,
+    "get": None, "get_state": None, "search": [],
+}
+
+
+class ResilientArchive(_ResilientBase):
+    """Breaker around a best-effort archive.
+
+    No retry loop: JobStore's mirror path already parks failed docs in a
+    doubling per-doc backoff (engine/jobs.py), so the wrapper's job is
+    purely to stop EVERY archive call from eating a connect timeout while
+    the backend is down — the breaker converts a dead ES into sub-ms
+    sentinel returns, and half-open probes notice recovery."""
+
+    _KEY = "archive"
+
+    def __init__(self, inner, breakers: BreakerBoard | None = None,
+                 exporter=None):
+        super().__init__(None, breakers, exporter)
+        self.inner = inner
+        # bind the archive surface ONCE (instance attrs shadow nothing —
+        # there are no class-level methods of these names): the mirror
+        # write path fires per job state change, and per-call closure
+        # rebuilds + breaker-board lookups would be pure overhead
+        for name, sentinel in _ARCHIVE_FAILS.items():
+            if hasattr(inner, name):
+                setattr(self, name, self._wrapped(name, sentinel))
+
+    def __getattr__(self, name: str):
+        # non-wrapped attributes (errors counter, indices, path) pass
+        # through so observability surfaces keep working. __dict__ guard:
+        # __getattr__ must never recurse while __init__ is still running
+        inner = self.__dict__.get("inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+    def _wrapped(self, name: str, sentinel):
+        fn = getattr(self.inner, name)
+        br = self.breakers.for_key(self._KEY)
+
+        def call(*args, **kw):
+            if not br.allow():
+                self._metrics.count(
+                    "breaker_rejections_total", {"host": self._KEY},
+                    help="archive calls fast-failed while the circuit was open")
+                return sentinel
+            before = getattr(self.inner, "errors", 0)
+            try:
+                res = fn(*args, **kw)
+            except Exception:
+                br.record_failure()
+                raise
+            # best-effort archives swallow transport errors: detect them
+            # via the errors-counter delta (FileArchive has none -> 0)
+            if getattr(self.inner, "errors", 0) > before or res is False:
+                br.record_failure()
+            else:
+                br.record_success()
+            return res
+
+        return call
+
+
+class KubeBreakerOpenError(KubeError):
+    """Fast failure: the apiserver breaker is open. A KubeError (status 0)
+    so every controller's per-item isolation path handles it unchanged."""
+
+    def __init__(self, message: str):
+        super().__init__(message, status=0)
+
+
+def _kube_backend_failure(e: BaseException) -> bool:
+    """Transport errors (status 0) and 5xx are backend health signals;
+    4xx are API answers (not-found drives controller logic)."""
+    status = getattr(e, "status", 0)
+    return not isinstance(e, KubeError) or status == 0 or status >= 500
+
+
+class ResilientKube(_ResilientBase):
+    """Breaker + retry around the operator's kube client.
+
+    4xx responses pass through untouched and count as breaker SUCCESSES
+    (the apiserver answered); transport errors and 5xx count as failures
+    and are retried under the shared policy."""
+
+    _KEY = "kube"
+
+    def __init__(self, inner, retry: RetryPolicy | None = None,
+                 breakers: BreakerBoard | None = None, exporter=None):
+        super().__init__(retry, breakers, exporter)
+        self.inner = inner
+
+    def __getattr__(self, name: str):
+        inner = self.__dict__.get("inner")
+        if inner is None:
+            raise AttributeError(name)
+        attr = getattr(inner, name)
+        if name.startswith("_") or not callable(attr):
+            return attr
+        wrapped = self._wrap(attr)
+        # cache on the instance: later lookups bypass __getattr__ (and
+        # the per-call closure rebuild) entirely
+        self.__dict__[name] = wrapped
+        return wrapped
+
+    def _wrap(self, fn):
+        br = self.breakers.for_key(self._KEY)
+
+        def once(*args, **kw):
+            if not br.allow():
+                self._metrics.count(
+                    "breaker_rejections_total", {"host": self._KEY},
+                    help="kube calls fast-failed while the circuit was open")
+                raise KubeBreakerOpenError(f"breaker open for {self._KEY}")
+            try:
+                res = fn(*args, **kw)
+            except KubeBreakerOpenError:
+                raise
+            except Exception as e:
+                if _kube_backend_failure(e):
+                    br.record_failure()
+                    raise
+                br.record_success()  # 4xx: the apiserver answered
+                raise _NoRetry(e) from e
+            br.record_success()
+            return res
+
+        def call(*args, **kw):
+            def on_retry(_exc):
+                self._metrics.count(
+                    "kube_retries_total", {"host": self._KEY},
+                    help="kube API attempts beyond the first")
+
+            try:
+                return self.retry.call(
+                    once, *args,
+                    no_retry=(_NoRetry, KubeBreakerOpenError),
+                    on_retry=on_retry, **kw)
+            except _NoRetry as e:
+                raise e.inner
+
+        return call
+
+
+class _NoRetry(Exception):
+    """Internal marker: a 4xx KubeError that must propagate un-retried."""
+
+    def __init__(self, inner: BaseException):
+        super().__init__(str(inner))
+        self.inner = inner

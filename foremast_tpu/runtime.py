@@ -37,6 +37,13 @@ Env surface (union of the reference services'):
                          shallow enough to finish within deadlines)
   WAVEFRONT_PROXY        host[:port] of a Wavefront proxy to mirror the
                          verdict series to (custom.iks.foremast.*)
+  RETRY_* / BREAKER_* /  resilience knobs: retry train, per-window retry
+  FETCH_CYCLE_DEADLINE   budget, breaker trip/recovery, per-cycle fetch
+                         deadline (engine/config.py, docs/resilience.md)
+  FOREMAST_CHAOS         deterministic fault-injection spec wrapping the
+                         raw fetch/archive boundaries — soak runs and the
+                         demo turn chaos on without code changes
+                         (docs/resilience.md for the grammar)
 """
 from __future__ import annotations
 
@@ -68,9 +75,74 @@ class Runtime:
         adopt_interval_seconds: float = 30.0,
         adopt_skew_margin_seconds: float = 15.0,
         lstm_cache_path: str | None = None,
+        resilient: bool | None = None,
+        chaos_spec: str | None = None,
     ):
         self.config = config or from_env()
+        self.exporter = VerdictExporter()
         source = data_source or PrometheusDataSource()
+        # -- chaos layer (FOREMAST_CHAOS): deterministic fault injection
+        # wraps the RAW boundaries, so the resilience layer above it is
+        # exercised exactly as it would be by a real outage --
+        if chaos_spec is None:
+            chaos_spec = os.environ.get("FOREMAST_CHAOS", "")
+        self.chaos_injectors = {}
+        if chaos_spec:
+            from .resilience import FaultyArchive, FaultyDataSource
+            from .resilience.faults import safe_injectors
+
+            self.chaos_injectors = safe_injectors(chaos_spec)
+            inj = self.chaos_injectors.get("fetch")
+            if inj is not None:
+                source = FaultyDataSource(source, inj)
+            inj = self.chaos_injectors.get("archive")
+            if inj is not None and archive is not None:
+                archive = FaultyArchive(archive, inj)
+        # -- resilience layer: breaker + retry + deadline around every
+        # external boundary. Default: on for the production path (no
+        # injected data_source) and whenever chaos is active; explicitly
+        # injected test sources stay bare unless asked (retrying a
+        # fixture miss would only slow the suite down) --
+        if resilient is None:
+            resilient = data_source is None or bool(self.chaos_injectors)
+        self.resilience = None
+        if resilient:
+            from .resilience import (
+                BreakerBoard,
+                ResilientArchive,
+                ResilientDataSource,
+                RetryBudget,
+                RetryPolicy,
+            )
+
+            cfg = self.config
+            source = ResilientDataSource(
+                source,
+                retry=RetryPolicy(
+                    max_attempts=cfg.retry_max_attempts,
+                    base_delay=cfg.retry_base_delay,
+                    max_delay=cfg.retry_max_delay,
+                    budget=RetryBudget(
+                        max_retries=cfg.retry_budget,
+                        window_seconds=cfg.retry_budget_window_seconds,
+                    ),
+                ),
+                breakers=BreakerBoard(
+                    failure_threshold=cfg.breaker_failure_threshold,
+                    recovery_seconds=cfg.breaker_recovery_seconds,
+                ),
+                exporter=self.exporter,
+            )
+            self.resilience = source
+            if archive is not None:
+                archive = ResilientArchive(
+                    archive,
+                    breakers=BreakerBoard(
+                        failure_threshold=cfg.breaker_failure_threshold,
+                        recovery_seconds=cfg.breaker_recovery_seconds,
+                    ),
+                    exporter=self.exporter,
+                )
         if cache:
             source = CachingDataSource(source, max_entries=self.config.max_cache_size)
         self.source = source
@@ -84,7 +156,6 @@ class Runtime:
         # peer's job is adopted (docs/operations.md "Clock skew")
         self.adopt_skew_margin_seconds = adopt_skew_margin_seconds
         self._last_adopt = 0.0
-        self.exporter = VerdictExporter()
         self.analyzer = Analyzer(
             self.config, self.source, self.store, exporter=self.exporter
         )
@@ -101,8 +172,9 @@ class Runtime:
                       f"from {lstm_cache_path}", flush=True)
         self.service = ForemastService(
             self.store, exporter=self.exporter, query_endpoint=query_endpoint,
-            analyzer=self.analyzer,
+            analyzer=self.analyzer, resilience=self.resilience,
         )
+        self.service.chaos_active = bool(self.chaos_injectors)
         self.wavefront_sink = wavefront_sink
         self._stop = threading.Event()
         self._stop_requested = False  # signal-handler seam (request_stop)

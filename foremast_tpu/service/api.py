@@ -6,6 +6,8 @@ Endpoints (reference: foremast-service/cmd/manager/main.go:326-346):
   GET  /alert/<app>/<namespace>/<strategy>   recent HPA logs for the app
   GET  /api/v1/<queryproxy>?...        CORS proxy to the metric store
   GET  /metrics                        foremastbrain:* verdict series
+  GET  /status                         degradation view: job counts +
+                                       breaker states + retry counters
   GET  /healthz                        liveness
 
 Behavior contracts preserved:
@@ -258,13 +260,17 @@ class ForemastService:
     """Route handlers over the shared store/exporter."""
 
     def __init__(self, store: JobStore, exporter: VerdictExporter | None = None,
-                 query_endpoint: str = "", analyzer=None):
+                 query_endpoint: str = "", analyzer=None, resilience=None):
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.query_endpoint = query_endpoint  # metric-store base for the proxy
         # optional engine handle: lets /metrics surface analyzer-side
         # counters (LSTM budget skips, stack rebuilds) next to the store's
         self.analyzer = analyzer
+        # optional resilience handle (ResilientDataSource): /status reports
+        # live breaker states + retry counters from its snapshot()
+        self.resilience = resilience
+        self.chaos_active = False  # stamped by the runtime when chaos is on
         # set by make_server: () -> the HTTP admission gate's shed counter
         self.http_shed_count = None
 
@@ -385,6 +391,13 @@ class ForemastService:
     def metrics(self):
         from ..utils.tracing import tracer
 
+        # re-stamp breaker-state gauges at scrape time: an idle open
+        # breaker fires no transitions, and a stale-evicted state gauge
+        # would clear dashboards while the circuit is still open
+        for holder in (self.resilience, getattr(self.store, "archive", None)):
+            refresh = getattr(holder, "refresh_metrics", None)
+            if refresh is not None:
+                refresh()
         # verdict series + host-side span aggregates + engine self-gauges
         # in one scrape (the reference brain likewise self-reported on its
         # :8000 /metrics, foremast-brain.yaml:85-122)
@@ -457,6 +470,23 @@ class ForemastService:
         self_gauges = "\n".join(lines) + "\n"
         return 200, self.exporter.render() + tracer.render_metrics() + self_gauges
 
+    def status_summary(self):
+        """GET /status — operator-facing degradation view: job-state
+        counts plus the resilience layer's live breaker states and retry
+        counters. The answer to "is the brain healthy, and if not, which
+        dependency is it protecting itself from?" in one request."""
+        out = {
+            "status": "ok",
+            "jobs": self.store.status_counts(),
+            "chaos_active": self.chaos_active,
+        }
+        if self.resilience is not None:
+            snap = self.resilience.snapshot()
+            out["resilience"] = snap
+            if any(state != "closed" for state in snap["breakers"].values()):
+                out["status"] = "degraded"
+        return 200, out
+
     def debug_traces(self, limit: int = 50):
         from ..utils.tracing import tracer
 
@@ -501,6 +531,8 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
             try:
                 if parsed.path == "/healthz":
                     self._send(200, {"status": "ok"})
+                elif parsed.path == "/status":
+                    self._send(*service.status_summary())
                 elif parsed.path in ("/", "/dashboard") or parsed.path.startswith(
                     "/dashboard/"
                 ):

@@ -1,0 +1,921 @@
+"""Resilience primitives: breaker transitions, retry determinism, budgets,
+deadlines, the resilient boundary wrappers, the chaos spec parser, and the
+single-flight cache (ISSUE 1 tentpole + satellites)."""
+import threading
+import time
+
+import pytest
+
+from foremast_tpu.dataplane.exporter import VerdictExporter
+from foremast_tpu.dataplane.fetch import CachingDataSource, FetchError
+from foremast_tpu.resilience import (
+    BreakerBoard,
+    BreakerOpenError,
+    CircuitBreaker,
+    Deadline,
+    FaultInjector,
+    FaultyArchive,
+    FaultyDataSource,
+    FaultyKube,
+    ResilientArchive,
+    ResilientDataSource,
+    ResilientKube,
+    RetryBudget,
+    RetryPolicy,
+    host_key,
+    parse_chaos_spec,
+)
+from foremast_tpu.resilience.breaker import (
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+)
+from foremast_tpu.resilience.faults import (
+    ERROR,
+    OK,
+    InjectedFetchError,
+    InjectedKubeError,
+    injectors_from_spec,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ------------------------------------------------------------- breaker
+def test_breaker_full_lifecycle():
+    clock = FakeClock()
+    br = CircuitBreaker("prom", failure_threshold=3, recovery_seconds=10.0,
+                        clock=clock)
+    transitions = []
+    br.subscribe(lambda name, old, new: transitions.append((old, new)))
+    assert br.state == STATE_CLOSED
+    br.record_failure()
+    br.record_failure()
+    assert br.state == STATE_CLOSED  # below threshold
+    br.record_failure()
+    assert br.state == STATE_OPEN
+    assert not br.allow()
+    # recovery elapses -> half-open, ONE probe slot
+    clock.t = 11.0
+    assert br.state == STATE_HALF_OPEN
+    assert br.allow()
+    assert not br.allow()  # second probe rejected while one is in flight
+    br.record_success()
+    assert br.state == STATE_CLOSED
+    assert transitions == [
+        (STATE_CLOSED, STATE_OPEN),
+        (STATE_OPEN, STATE_HALF_OPEN),
+        (STATE_HALF_OPEN, STATE_CLOSED),
+    ]
+    assert br.trips == 1
+
+
+def test_breaker_half_open_failure_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, recovery_seconds=5.0, clock=clock)
+    br.record_failure()
+    assert br.state == STATE_OPEN
+    clock.t = 6.0
+    assert br.allow()  # half-open probe
+    br.record_failure()
+    assert br.state == STATE_OPEN  # probe failed: fresh recovery clock
+    assert not br.allow()
+    clock.t = 10.0  # 4s after the reopen: still open
+    assert br.state == STATE_OPEN
+    clock.t = 11.5
+    assert br.state == STATE_HALF_OPEN
+    assert br.trips == 2
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(failure_threshold=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # streak broken
+    br.record_failure()
+    br.record_failure()
+    assert br.state == STATE_CLOSED  # consecutive, not windowed
+
+
+def test_breaker_board_keys_and_hooks():
+    board = BreakerBoard(failure_threshold=1, recovery_seconds=60.0)
+    seen = []
+    board.subscribe(lambda name, old, new: seen.append((name, new)))
+    board.for_key("a").record_failure()
+    board.for_key("b").record_failure()
+    assert board.states() == {"a": STATE_OPEN, "b": STATE_OPEN}
+    assert set(seen) == {("a", STATE_OPEN), ("b", STATE_OPEN)}
+    assert board.counters()["a"]["trips"] == 1
+
+
+def test_breaker_thread_safety_under_contention():
+    br = CircuitBreaker(failure_threshold=50, recovery_seconds=0.01)
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(500):
+                if br.allow():
+                    br.record_failure()
+                br.state  # noqa: B018 - exercise the lazy transition path
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert br.state in (STATE_CLOSED, STATE_OPEN, STATE_HALF_OPEN)
+
+
+# --------------------------------------------------------------- retry
+def test_retry_jitter_deterministic_under_fixed_seed():
+    a = RetryPolicy(seed=1234, base_delay=0.1, max_delay=10.0)
+    b = RetryPolicy(seed=1234, base_delay=0.1, max_delay=10.0)
+    assert [a.backoff(i) for i in range(8)] == [b.backoff(i) for i in range(8)]
+    c = RetryPolicy(seed=99, base_delay=0.1, max_delay=10.0)
+    assert [a.backoff(i) for i in range(8)] != [c.backoff(i) for i in range(8)]
+
+
+def test_retry_backoff_exponential_envelope():
+    pol = RetryPolicy(seed=7, base_delay=0.5, max_delay=4.0)
+    for attempt in range(10):
+        cap = min(4.0, 0.5 * 2 ** attempt)
+        for _ in range(20):
+            assert 0.0 <= pol.backoff(attempt) <= cap
+
+
+def test_retry_call_retries_then_raises():
+    sleeps = []
+    pol = RetryPolicy(max_attempts=3, base_delay=0.01, seed=0,
+                      sleep=sleeps.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise FetchError("down")
+
+    with pytest.raises(FetchError):
+        pol.call(flaky)
+    assert len(calls) == 3
+    assert len(sleeps) <= 2  # zero-delay jitter draws skip the sleep call
+    assert pol.retries_total == 2
+
+
+def test_retry_succeeds_midway():
+    pol = RetryPolicy(max_attempts=5, base_delay=0.0, seed=0,
+                      sleep=lambda s: None)
+    state = {"n": 0}
+
+    def eventually():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise FetchError("flap")
+        return "ok"
+
+    assert pol.call(eventually) == "ok"
+    assert state["n"] == 3
+
+
+def test_retry_no_retry_exceptions_propagate_immediately():
+    pol = RetryPolicy(max_attempts=5, base_delay=0.0, sleep=lambda s: None)
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise BreakerOpenError("open")
+
+    with pytest.raises(BreakerOpenError):
+        pol.call(boom, no_retry=(BreakerOpenError,))
+    assert len(calls) == 1
+
+
+def test_retry_budget_bounds_total_attempts_against_dead_backend():
+    """Acceptance: retry counts against a dead backend respect the budget —
+    bounded TOTAL attempts per window (first attempts + budget), never
+    first-attempts x max_attempts."""
+    clock = FakeClock()
+    budget = RetryBudget(max_retries=5, window_seconds=60.0, clock=clock)
+    pol = RetryPolicy(max_attempts=4, base_delay=0.0, seed=0, budget=budget,
+                      sleep=lambda s: None)
+    attempts = []
+
+    def dead():
+        attempts.append(1)
+        raise FetchError("dead")
+
+    n_calls = 20
+    for _ in range(n_calls):
+        with pytest.raises(FetchError):
+            pol.call(dead)
+    # total attempts = one first attempt per call + at most the budget
+    assert len(attempts) == n_calls + 5
+    assert budget.denials > 0
+    # a new window refills the budget
+    clock.t = 61.0
+    with pytest.raises(FetchError):
+        pol.call(dead)
+    assert len(attempts) == n_calls + 5 + 4  # full retry train again
+
+
+def test_retry_budget_sliding_window_evicts():
+    clock = FakeClock()
+    b = RetryBudget(max_retries=2, window_seconds=10.0, clock=clock)
+    assert b.try_spend() and b.try_spend() and not b.try_spend()
+    clock.t = 10.5  # first two spent at t=0 age out
+    assert b.try_spend()
+
+
+# ------------------------------------------------------------ deadline
+def test_deadline_clips_backoff_sleep():
+    clock = FakeClock()
+    dl = Deadline(5.0, clock=clock)
+    assert dl.remaining() == 5.0
+    assert dl.clip(10.0) == 5.0  # clipped to what's left
+    assert dl.clip(2.0) == 2.0
+    clock.t = 5.1
+    assert dl.expired()
+    assert dl.clip(2.0) == 0.0
+
+
+def test_deadline_stops_retry_train():
+    clock = FakeClock()
+    dl = Deadline(0.35, clock=clock)
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock.t += max(s, 0.2)  # each attempt costs at least 0.2s
+
+    pol = RetryPolicy(max_attempts=10, base_delay=0.3, max_delay=0.3,
+                      seed=3, sleep=fake_sleep)
+    attempts = []
+
+    def dead():
+        attempts.append(1)
+        clock.t += 0.1
+        raise FetchError("dead")
+
+    with pytest.raises(FetchError):
+        pol.call(dead, deadline=dl)
+    # far fewer than max_attempts: the deadline cut the train short
+    assert len(attempts) < 5
+    # every sleep fit inside the remaining budget at its moment
+    assert all(s <= 0.35 for s in sleeps)
+
+
+# -------------------------------------------------- resilient data source
+class DeadSource:
+    def __init__(self, exc=None):
+        self.calls = 0
+        self.exc = exc or FetchError("connection refused")
+
+    def fetch(self, url):
+        self.calls += 1
+        raise self.exc
+
+
+class SlowDeadSource(DeadSource):
+    def fetch(self, url):
+        self.calls += 1
+        time.sleep(0.25)
+        raise self.exc
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("base_delay", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+def test_breaker_open_error_is_fetch_error():
+    assert issubclass(BreakerOpenError, FetchError)
+
+
+def test_host_key_extraction():
+    assert host_key("http://prom:9090/api/v1/query?x=1") == "prom:9090"
+    assert host_key("") == "unknown"
+    assert host_key("not a url") == "not a url"
+
+
+def test_resilient_source_opens_breaker_and_fast_fails():
+    """Acceptance: with the breaker open, fetch returns in <10ms with no
+    network attempt."""
+    inner = SlowDeadSource()
+    rs = ResilientDataSource(
+        inner, retry=_fast_policy(),
+        breakers=BreakerBoard(failure_threshold=2, recovery_seconds=300.0),
+    )
+    url = "http://prom:9090/api/v1/query"
+    with pytest.raises(FetchError):
+        rs.fetch(url)  # 2 attempts -> 2 consecutive failures -> trips
+    calls_before = inner.calls
+    t0 = time.perf_counter()
+    with pytest.raises(BreakerOpenError):
+        rs.fetch(url)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.010, f"open breaker took {elapsed*1000:.1f}ms"
+    assert inner.calls == calls_before  # no network attempt
+
+
+def test_resilient_source_breaker_keys_are_per_host():
+    inner = DeadSource()
+    rs = ResilientDataSource(
+        inner, retry=_fast_policy(),
+        breakers=BreakerBoard(failure_threshold=2, recovery_seconds=300.0),
+    )
+    with pytest.raises(FetchError):
+        rs.fetch("http://dead:9090/q")
+    assert rs.breakers.states()["dead:9090"] == STATE_OPEN
+
+    class Live:
+        def fetch(self, url):
+            return ([1.0], [2.0])
+
+    rs.inner = Live()
+    assert rs.fetch("http://live:9090/q") == ([1.0], [2.0])  # unaffected
+
+
+def test_resilient_source_recovers_through_half_open():
+    clock = FakeClock()
+    inner = DeadSource()
+    rs = ResilientDataSource(
+        inner, retry=_fast_policy(),
+        breakers=BreakerBoard(failure_threshold=2, recovery_seconds=5.0,
+                              clock=clock),
+    )
+    url = "http://prom:9090/q"
+    with pytest.raises(FetchError):
+        rs.fetch(url)
+    assert rs.breakers.states()["prom:9090"] == STATE_OPEN
+
+    class Healed:
+        def fetch(self, url):
+            return ([1.0], [1.0])
+
+    rs.inner = Healed()
+    clock.t = 6.0  # recovery elapsed: next call is the half-open probe
+    assert rs.fetch(url) == ([1.0], [1.0])
+    assert rs.breakers.states()["prom:9090"] == STATE_CLOSED
+
+
+def test_resilient_source_wraps_parse_errors_as_fetch_error():
+    class Garbage:
+        def fetch(self, url):
+            raise ValueError("Expecting value: line 1 column 1 (char 0)")
+
+    rs = ResilientDataSource(Garbage(), retry=_fast_policy())
+    with pytest.raises(FetchError, match="fetch failed after retries"):
+        rs.fetch("http://prom:9090/q")
+
+
+def test_resilient_source_exports_metrics():
+    exp = VerdictExporter()
+    rs = ResilientDataSource(
+        DeadSource(), retry=_fast_policy(max_attempts=3),
+        breakers=BreakerBoard(failure_threshold=2, recovery_seconds=300.0),
+        exporter=exp,
+    )
+    with pytest.raises(FetchError):
+        rs.fetch("http://prom:9090/q")
+    text = exp.render()
+    assert "# TYPE foremastbrain:fetch_retries_total counter" in text
+    assert "# TYPE foremastbrain:breaker_state gauge" in text
+    assert 'foremastbrain:breaker_state{host="prom:9090"} 2.0' in text
+    assert ('foremastbrain:breaker_transitions_total'
+            '{host="prom:9090",to="open"} 1.0') in text
+
+
+def test_resilient_source_none_fetch_window_is_breaker_neutral():
+    """A None from fetch_window means "no byte-level path", not backend
+    health: it must neither reset the consecutive-failure count (a reset
+    before every real fetch would make the breaker untrippable for
+    series-level sources) nor leak a half-open probe slot."""
+
+    class SeriesOnly:  # has fetch_window, but its inner has no byte path
+        def __init__(self):
+            self.exc = FetchError("down")
+
+        def fetch_window(self, url):
+            return None
+
+        def fetch(self, url):
+            raise self.exc
+
+    rs = ResilientDataSource(
+        SeriesOnly(), retry=_fast_policy(max_attempts=1),
+        breakers=BreakerBoard(failure_threshold=3, recovery_seconds=300.0),
+    )
+    url = "http://prom:9090/q"
+    for _ in range(3):
+        assert rs.fetch_window(url) is None  # neutral: no state change
+        with pytest.raises(FetchError):
+            rs.fetch(url)
+    # 3 consecutive real failures trip the breaker despite the interleaved
+    # neutral fetch_window calls
+    assert rs.breakers.states()["prom:9090"] == STATE_OPEN
+
+
+def test_breaker_release_returns_half_open_probe_slot():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, recovery_seconds=5.0,
+                        clock=clock)
+    br.record_failure()
+    clock.t = 6.0
+    assert br.allow()  # probe slot taken
+    br.release()  # neutral outcome: slot returned, state unchanged
+    assert br.state == STATE_HALF_OPEN
+    assert br.allow()  # slot available again
+
+
+def test_resilient_source_cycle_deadline_plumbing():
+    rs = ResilientDataSource(DeadSource(), retry=_fast_policy())
+    dl = Deadline(0.0, clock=lambda: 1.0)  # already expired
+    rs.set_cycle_deadline(dl)
+    assert rs._deadline() is dl
+    rs.set_cycle_deadline(None)
+    assert rs._deadline() is None
+    # and through the cache wrapper (the runtime composition)
+    cached = CachingDataSource(rs)
+    cached.set_cycle_deadline(dl)
+    assert rs._deadline() is dl
+
+
+# ---------------------------------------------------- resilient archive
+class CountingArchive:
+    """EsArchive-shaped double: swallows failures, counts .errors."""
+
+    def __init__(self):
+        self.errors = 0
+        self.fail = False
+        self.calls = 0
+
+    def index_job(self, doc):
+        self.calls += 1
+        if self.fail:
+            self.errors += 1
+            return False
+        return True
+
+    def index_hpalog(self, log):
+        return self.index_job(log)
+
+    def index_state(self, key, value, updated_at):
+        return self.index_job(None)
+
+    def get(self, job_id):
+        self.calls += 1
+        if self.fail:
+            self.errors += 1
+            return None
+        return {"id": job_id}
+
+    def get_state(self, key):
+        return None
+
+    def search(self, *a, **kw):
+        self.calls += 1
+        return []
+
+
+def test_resilient_archive_breaker_short_circuits():
+    inner = CountingArchive()
+    ra = ResilientArchive(
+        inner, breakers=BreakerBoard(failure_threshold=3,
+                                     recovery_seconds=300.0))
+    inner.fail = True
+    for _ in range(3):
+        assert ra.index_job({"id": "x"}) is False
+    assert ra.breakers.states()["archive"] == STATE_OPEN
+    calls_before = inner.calls
+    # open: sentinel returns with NO inner calls
+    assert ra.index_job({"id": "x"}) is False
+    assert ra.get("x") is None
+    assert ra.search() == []
+    assert inner.calls == calls_before
+
+
+def test_resilient_archive_detects_swallowed_errors_and_recovers():
+    clock = FakeClock()
+    inner = CountingArchive()
+    ra = ResilientArchive(
+        inner, breakers=BreakerBoard(failure_threshold=2,
+                                     recovery_seconds=5.0, clock=clock))
+    inner.fail = True
+    ra.get("a")
+    ra.get("b")  # errors-counter delta marks both as failures
+    assert ra.breakers.states()["archive"] == STATE_OPEN
+    inner.fail = False
+    clock.t = 6.0
+    assert ra.get("c") == {"id": "c"}  # half-open probe succeeds
+    assert ra.breakers.states()["archive"] == STATE_CLOSED
+
+
+def test_resilient_archive_passes_attrs_through():
+    inner = CountingArchive()
+    ra = ResilientArchive(inner)
+    assert ra.errors == 0  # observability attr delegated
+
+
+# ------------------------------------------------------- resilient kube
+class FlakyKube:
+    def __init__(self, failures: int = 0, status: int = 0):
+        from foremast_tpu.operator.kube import KubeError
+
+        self._exc = KubeError("boom", status=status)
+        self.failures = failures
+        self.calls = 0
+
+    def list_namespaces(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self._exc
+        return ["default"]
+
+
+def test_resilient_kube_retries_transport_errors():
+    k = ResilientKube(FlakyKube(failures=2, status=0),
+                      retry=_fast_policy(max_attempts=3))
+    assert k.list_namespaces() == ["default"]
+    assert k.inner.calls == 3
+
+
+def test_resilient_kube_does_not_retry_4xx():
+    k = ResilientKube(FlakyKube(failures=99, status=404),
+                      retry=_fast_policy(max_attempts=5))
+    from foremast_tpu.operator.kube import KubeError
+
+    with pytest.raises(KubeError):
+        k.list_namespaces()
+    assert k.inner.calls == 1  # API answer, not backend health
+    assert k.breakers.states().get("kube") != STATE_OPEN
+
+
+def test_resilient_kube_breaker_opens_on_5xx():
+    from foremast_tpu.operator.kube import KubeError
+
+    k = ResilientKube(
+        FlakyKube(failures=99, status=503),
+        retry=_fast_policy(max_attempts=2),
+        breakers=BreakerBoard(failure_threshold=2, recovery_seconds=300.0),
+    )
+    with pytest.raises(KubeError):
+        k.list_namespaces()
+    assert k.breakers.states()["kube"] == STATE_OPEN
+    calls = k.inner.calls
+    with pytest.raises(KubeError):
+        k.list_namespaces()  # fast-fail, no inner call
+    assert k.inner.calls == calls
+
+
+# ----------------------------------------------------------- chaos spec
+def test_parse_chaos_spec_full_grammar():
+    seed, plans = parse_chaos_spec(
+        "seed=42; fetch.error=0.3; fetch.latency=0.2:0.05;"
+        "fetch.garbage=0.1; archive.outage=5..10; kube.flap=3:2;"
+        "kube.timeout=0.5:1.5"
+    )
+    assert seed == 42
+    f = plans["fetch"]
+    assert f.error_rate == 0.3
+    assert (f.latency_rate, f.latency_seconds) == (0.2, 0.05)
+    assert f.garbage_rate == 0.1
+    assert plans["archive"].outages == [(5, 10)]
+    k = plans["kube"]
+    assert (k.flap_up, k.flap_down) == (3, 2)
+    assert (k.timeout_rate, k.timeout_seconds) == (0.5, 1.5)
+
+
+@pytest.mark.parametrize("bad", [
+    "fetch.error",  # no '='
+    "disk.error=0.5",  # unknown target
+    "fetch.explode=1",  # unknown fault
+    "archive.garbage=0.5",  # garbage is fetch-only
+    "fetch.outage=5",  # malformed window
+    "fetch.latency=0.5",  # missing seconds
+])
+def test_parse_chaos_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_chaos_spec(bad)
+
+
+def test_fault_injector_deterministic_per_seed_and_target():
+    _, plans = parse_chaos_spec("fetch.error=0.4")
+    runs = []
+    for _ in range(2):
+        inj = FaultInjector(plans["fetch"], seed=7, target="fetch")
+        runs.append([inj.decide() for _ in range(100)])
+    assert runs[0] == runs[1]
+    other = FaultInjector(plans["fetch"], seed=8, target="fetch")
+    assert [other.decide() for _ in range(100)] != runs[0]
+
+
+def test_fault_injector_outage_and_flap_windows_exact():
+    _, plans = parse_chaos_spec("fetch.outage=2..4;")
+    inj = FaultInjector(plans["fetch"], seed=0, target="fetch")
+    assert [inj.decide() for _ in range(6)] == [OK, OK, ERROR, ERROR, OK, OK]
+    _, plans = parse_chaos_spec("kube.flap=2:1")
+    inj = FaultInjector(plans["kube"], seed=0, target="kube")
+    assert [inj.decide() for _ in range(6)] == [OK, OK, ERROR, OK, OK, ERROR]
+
+
+def test_faulty_data_source_injects_errors_and_garbage():
+    _, plans = parse_chaos_spec("fetch.error=1.0")
+    inj = FaultInjector(plans["fetch"], seed=0, target="fetch")
+
+    class Fine:
+        def fetch(self, url):
+            return ([1.0], [1.0])
+
+    fs = FaultyDataSource(Fine(), inj)
+    with pytest.raises(InjectedFetchError):
+        fs.fetch("http://x/q")
+    # garbage goes through the REAL parse path: a truncated body either
+    # raises (python json fallback) or parses to an EMPTY series (the
+    # tolerant native scanner) — both degrade the job, never the cycle
+    _, plans = parse_chaos_spec("fetch.garbage=1.0")
+    inj = FaultInjector(plans["fetch"], seed=0, target="fetch")
+    fs = FaultyDataSource(Fine(), inj)
+    for _ in range(3):  # all three garbage bodies
+        try:
+            ts, vals = fs.fetch("http://x/q")
+        except Exception:  # noqa: BLE001 - parse-dependent
+            continue
+        assert len(ts) == 0 and len(vals) == 0
+    assert inj.injected_garbage == 3
+
+
+def test_faulty_archive_returns_sentinels():
+    _, plans = parse_chaos_spec("archive.error=1.0")
+    inj = FaultInjector(plans["archive"], seed=0, target="archive")
+    fa = FaultyArchive(CountingArchive(), inj)
+    assert fa.index_job({}) is False
+    assert fa.get("x") is None
+    assert fa.search() == []
+    assert fa.errors == 3
+
+
+def test_faulty_kube_raises_kube_errors():
+    _, plans = parse_chaos_spec("kube.error=1.0")
+    inj = FaultInjector(plans["kube"], seed=0, target="kube")
+    fk = FaultyKube(FlakyKube(), inj)
+    with pytest.raises(InjectedKubeError):
+        fk.list_namespaces()
+
+
+def test_injectors_from_spec_only_active_targets():
+    injs = injectors_from_spec("seed=1;fetch.error=0.5")
+    assert set(injs) == {"fetch"}
+
+
+# --------------------------------------------- exporter counters / TYPE
+def test_exporter_counter_rendering_well_formed():
+    exp = VerdictExporter()
+    exp.record_counter("foremastbrain:fetch_retries_total",
+                       {"host": "prom:9090"}, 2, help="retries by host")
+    exp.record_counter("foremastbrain:fetch_retries_total",
+                       {"host": "prom:9090"}, 1)
+    exp.record_gauge("foremastbrain:breaker_state", {"host": "prom:9090"},
+                     2.0, help="circuit state")
+    text = exp.render()
+    lines = text.strip().splitlines()
+    assert "# HELP foremastbrain:fetch_retries_total retries by host" in lines
+    assert "# TYPE foremastbrain:fetch_retries_total counter" in lines
+    assert "# TYPE foremastbrain:breaker_state gauge" in lines
+    assert 'foremastbrain:fetch_retries_total{host="prom:9090"} 3.0' in lines
+    # metadata lines precede their metric's samples (exposition contract)
+    type_i = lines.index("# TYPE foremastbrain:fetch_retries_total counter")
+    sample_i = lines.index(
+        'foremastbrain:fetch_retries_total{host="prom:9090"} 3.0')
+    assert type_i < sample_i
+
+
+def test_exporter_counters_survive_stale_eviction():
+    exp = VerdictExporter(stale_seconds=0.0)  # everything gauge-stale
+    exp.record_bounds("a", "ns", "m", 1, 0, 0)
+    exp.record_counter("foremastbrain:x_total", {}, 1)
+    assert exp.samples() == []  # gauges evicted (existing contract)
+    assert exp.counter_samples() == [("foremastbrain:x_total", {}, 1.0)]
+    assert "foremastbrain:x_total" in exp.render()
+
+
+def test_exporter_counter_key_set_is_bounded():
+    """Counter labels derive from job-submitted query-URL hosts: a create
+    flood with unique endpoints must not grow /metrics without bound."""
+    exp = VerdictExporter()
+    cap = VerdictExporter.MAX_COUNTER_KEYS
+    for i in range(cap + 10):
+        exp.record_counter("foremastbrain:x_total", {"host": f"h{i}"}, 1)
+    assert len(exp.counter_samples()) == cap
+    # existing keys still increment in place at the ceiling
+    exp.record_counter("foremastbrain:x_total", {"host": f"h{cap + 9}"}, 1)
+    vals = {labels["host"]: v for _, labels, v in exp.counter_samples()}
+    assert vals[f"h{cap + 9}"] == 2.0
+
+
+def test_exporter_plain_gauges_render_without_metadata():
+    exp = VerdictExporter()
+    exp.record_bounds("a", "ns", "m", 1, 0, 0)
+    for line in exp.render().strip().splitlines():
+        assert not line.startswith("#")
+
+
+# ------------------------------------------------- single-flight cache
+def test_caching_source_single_flight_on_concurrent_miss():
+    calls = []
+    release = threading.Event()
+
+    class Slow:
+        def fetch(self, url):
+            calls.append(url)
+            release.wait(2.0)
+            return ([1.0], [2.0])
+
+    cache = CachingDataSource(Slow(), ttl_seconds=100.0)
+    results = [None] * 6
+    threads = [
+        threading.Thread(target=lambda i=i: results.__setitem__(
+            i, cache.fetch("http://x/q")))
+        for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # let every thread reach the miss
+    release.set()
+    for t in threads:
+        t.join(5.0)
+    assert len(calls) == 1  # only the leader hit the backend
+    assert all(r == ([1.0], [2.0]) for r in results)
+    assert cache.single_flight_waits == 5
+    assert cache.hits == 0 and cache.misses == 1
+
+
+def test_caching_source_single_flight_leader_failure_shared():
+    class Failing:
+        def __init__(self):
+            self.calls = 0
+
+        def fetch(self, url):
+            self.calls += 1
+            time.sleep(0.05)
+            raise FetchError("down")
+
+    inner = Failing()
+    cache = CachingDataSource(inner, ttl_seconds=100.0)
+    errors = []
+
+    def go():
+        try:
+            cache.fetch("u")
+        except FetchError as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=go) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5.0)
+    assert len(errors) == 4  # every waiter saw the leader's failure
+    assert inner.calls == 1
+    # the failure is NOT cached: the next call fetches again
+    with pytest.raises(FetchError):
+        cache.fetch("u")
+    assert inner.calls == 2
+
+
+def test_caching_source_distinct_keys_fly_independently():
+    calls = []
+
+    class Rec:
+        def fetch(self, url):
+            calls.append(url)
+            return ([1.0], [1.0])
+
+    cache = CachingDataSource(Rec(), ttl_seconds=100.0)
+    cache.fetch("a")
+    cache.fetch("b")
+    cache.fetch("a")  # hit
+    assert calls == ["a", "b"]
+    assert cache.hits == 1 and cache.misses == 2
+
+
+def test_resilient_source_refresh_metrics_resurrects_stale_state_gauge():
+    """An idle OPEN breaker fires no transitions; the scrape-time refresh
+    must re-stamp its state gauge so it cannot stale-evict away while the
+    circuit is still open."""
+    exp = VerdictExporter(stale_seconds=0.05)
+    rs = ResilientDataSource(
+        DeadSource(), retry=_fast_policy(),
+        breakers=BreakerBoard(failure_threshold=1, recovery_seconds=300.0),
+        exporter=exp)
+    with pytest.raises(FetchError):
+        rs.fetch("http://h:1/q")
+    time.sleep(0.1)  # past the stale horizon, breaker untouched
+    assert "breaker_state" not in exp.render()
+    rs.refresh_metrics()
+    assert 'foremastbrain:breaker_state{host="h:1"} 2.0' in exp.render()
+
+
+def test_breaker_board_eviction_prefers_closed_breakers():
+    board = BreakerBoard(failure_threshold=1, recovery_seconds=300.0,
+                         max_keys=2)
+    board.for_key("open-one").record_failure()
+    board.for_key("closed-one")
+    board.for_key("new-key")  # at capacity: must evict the CLOSED entry
+    states = board.states()
+    assert states["open-one"] == STATE_OPEN  # protection survives
+    assert "closed-one" not in states
+
+
+def test_faulty_archive_errors_counter_stays_live():
+    """Chaos must not blind the errors-delta failure detection: the
+    wrapper's .errors is injected + the inner archive's LIVE count."""
+    from foremast_tpu.resilience.faults import FaultPlan
+
+    class SwallowingEs:
+        def __init__(self):
+            self.errors = 0
+
+        def get(self, job_id):
+            self.errors += 1  # real swallowed transport error
+            return None
+
+    fa = FaultyArchive(SwallowingEs(),
+                       FaultInjector(FaultPlan(), seed=0, target="archive"))
+    fa.get("x")
+    assert fa.errors == 1
+    ra = ResilientArchive(
+        fa, breakers=BreakerBoard(failure_threshold=2,
+                                  recovery_seconds=300.0))
+    ra.get("a")
+    ra.get("b")
+    assert ra.breakers.states()["archive"] == STATE_OPEN
+
+
+# ------------------------------------------------------- operator loop
+def test_operator_tick_backoff_schedule():
+    from foremast_tpu.operator.loop import OperatorLoop
+
+    loop = OperatorLoop.__new__(OperatorLoop)  # delay math only
+    assert loop._tick_delay(0, 10.0) == 10.0
+    assert loop._tick_delay(1, 10.0) == 20.0
+    assert loop._tick_delay(2, 10.0) == 40.0
+    assert loop._tick_delay(5, 10.0) == 300.0  # capped
+    assert loop._tick_delay(50, 10.0) == 300.0  # exponent clamped too
+
+
+def test_operator_run_forever_logs_and_backs_off(caplog):
+    import logging
+
+    from foremast_tpu.operator.loop import OperatorLoop
+
+    loop = OperatorLoop.__new__(OperatorLoop)
+    loop._stop_requested = False
+    ticks = {"n": 0}
+
+    def bad_tick(now=None):
+        ticks["n"] += 1
+        if ticks["n"] >= 3:
+            loop.request_stop()
+        raise RuntimeError("apiserver down")
+
+    loop.tick = bad_tick
+    with caplog.at_level(logging.ERROR, logger="foremast_tpu.operator"):
+        t0 = time.time()
+        loop.run_forever(interval=0.01)
+        elapsed = time.time() - t0
+    assert ticks["n"] == 3
+    msgs = [r.message for r in caplog.records]
+    assert any("operator tick failed" in m for m in msgs)
+    assert any("consecutive=2" in m for m in msgs)
+    # backoff happened: 0.01 + 0.02+0.04 floors (minus the final stop)
+    assert elapsed >= 0.02
+
+
+# ------------------------------------------------------ service /status
+def test_service_status_endpoint_reports_breakers():
+    from foremast_tpu.engine.jobs import JobStore
+    from foremast_tpu.service.api import ForemastService
+
+    rs = ResilientDataSource(
+        DeadSource(), retry=_fast_policy(),
+        breakers=BreakerBoard(failure_threshold=1, recovery_seconds=300.0))
+    svc = ForemastService(JobStore(), resilience=rs)
+    code, body = svc.status_summary()
+    assert code == 200 and body["status"] == "ok"
+    with pytest.raises(FetchError):
+        rs.fetch("http://dead:1/q")
+    code, body = svc.status_summary()
+    assert body["status"] == "degraded"
+    assert body["resilience"]["breakers"]["dead:1"] == STATE_OPEN
+    assert body["resilience"]["retries_total"] >= 1
