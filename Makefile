@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast native bench bench-smoke bench-watch demo demo-hpa dryrun fuzz chaos clean
+.PHONY: test test-fast native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos clean
 
 test:            ## full suite (CPU, 8 virtual devices via conftest)
 	$(PY) -m pytest tests/ -q
@@ -24,6 +24,12 @@ bench-smoke:     ## bench plumbing check on CPU with tiny shapes
 
 bench-watch:     ## background tunnel watcher: banks BENCH_LOCAL_r05.json at first health
 	nohup $(PY) scripts/opportunistic_bench.py > /tmp/opp_bench.log 2>&1 &
+
+prewarm:         ## compile the scoring-program grid into COMPILE_CACHE_PATH (default /tmp/foremast-compile-cache)
+	$(CPU_ENV) COMPILE_CACHE_PATH=$${COMPILE_CACHE_PATH:-/tmp/foremast-compile-cache} $(PY) -m foremast_tpu prewarm
+
+perf:            ## perf regression gates (zero steady-state recompiles, pipeline determinism)
+	$(CPU_ENV) $(PY) -m pytest tests/ -m perf -q
 
 fuzz:            ## extended native-parser fuzz campaign (100k mutations)
 	$(CPU_ENV) $(PY) tests/test_native_fuzz.py --child 100000

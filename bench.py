@@ -171,6 +171,11 @@ def _cycle_bench() -> dict:
         # train-free; the one-time warm-up cost is recorded separately
         extra["cycle_mixed_warmup_cycles"] = rec.get("warmup_cycles")
         extra["cycle_mixed_lstm_train_warmup_s"] = rec.get("lstm_train_warmup_s")
+        # pipeline-stage decomposition + compile counters (ISSUE 2): the
+        # overlap story per cycle, and proof steady state never compiles
+        extra["cycle_mixed_stage_s"] = rec.get("stage_s_per_cycle")
+        extra["cycle_mixed_compiles_warmup"] = rec.get("compiles_warmup")
+        extra["cycle_mixed_compiles_steady"] = rec.get("compiles_steady_state")
     else:
         extra["cycle_mixed_error"] = err
     return extra

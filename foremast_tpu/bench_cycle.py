@@ -160,25 +160,38 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
         fam_counts["pair"] = n_jobs
         docs = [pair_doc(i) for i in range(n_jobs)]
 
+    from .engine.pipeline import CompileCounter
+
     with tempfile.TemporaryDirectory() as tmp:
         store = J.JobStore(snapshot_path=os.path.join(tmp, "jobs.json"))
         for d in docs:
             store.create(d)
-        engine = Analyzer(EngineConfig(), source, store)
+        # pinned EngineConfig defaults for run-over-run comparability;
+        # SCORE_PIPELINE passes through so the driver can A/B the
+        # pipelined vs. barriered cycle on identical fleets
+        from .engine.config import _env_bool as _eb
 
-        out = engine.run_cycle(now=t_end)  # warmup: jit compile + caches
-        not_requeued = sum(1 for s in out.values() if s != J.INITIAL)
-        # warm the LSTM train-on-miss cache to steady state before timing:
-        # a bounded-identity fleet trains each identity ONCE (budgeted over
-        # the first ceil(identities/budget) cycles) and then scores from
-        # cache forever — that steady state is what the throughput figure
-        # means. Warm-up training cost is reported separately below
-        # (lstm_train_warmup_*); the timed cycles then carry only the
-        # residual (usually zero) train cost, decomposed as before.
-        warmup_cycles = 1
-        while mix and engine._lstm_trained_this_cycle > 0 and warmup_cycles < 12:
-            engine.run_cycle(now=t_end)
-            warmup_cycles += 1
+        engine = Analyzer(
+            EngineConfig(score_pipeline=_eb(os.environ, "SCORE_PIPELINE",
+                                            True)),
+            source, store)
+
+        with CompileCounter() as cc_warm:
+            out = engine.run_cycle(now=t_end)  # warmup: jit compile + caches
+            not_requeued = sum(1 for s in out.values() if s != J.INITIAL)
+            # warm the LSTM train-on-miss cache to steady state before
+            # timing: a bounded-identity fleet trains each identity ONCE
+            # (budgeted over the first ceil(identities/budget) cycles) and
+            # then scores from cache forever — that steady state is what
+            # the throughput figure means. Warm-up training cost is
+            # reported separately below (lstm_train_warmup_*); the timed
+            # cycles then carry only the residual (usually zero) train
+            # cost, decomposed as before.
+            warmup_cycles = 1
+            while (mix and engine._lstm_trained_this_cycle > 0
+                   and warmup_cycles < 12):
+                engine.run_cycle(now=t_end)
+                warmup_cycles += 1
         warm_tr = tracing.tracer.stats().get("engine.lstm_train", {})
         warmup_fields = {
             "warmup_cycles": warmup_cycles,
@@ -189,8 +202,12 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
         source.requests.clear()
 
         t0 = time.perf_counter()
-        for _ in range(cycles):
-            engine.run_cycle(now=t_end)
+        # steady-state compile counter: the rung/bucket design promises
+        # ZERO fresh XLA programs once warm (tests/test_pipeline.py
+        # enforces it); a nonzero count here means a shape leaked
+        with CompileCounter() as cc_steady:
+            for _ in range(cycles):
+                engine.run_cycle(now=t_end)
         wall = time.perf_counter() - t0
 
     stats = tracing.tracer.stats()
@@ -230,12 +247,27 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
             tr.get("total_seconds", 0.0) / cycles, 4)
         mix_fields["lstm_trains_per_cycle"] = round(
             tr.get("count", 0) / cycles, 2)
+    # pipeline-stage decomposition (engine.stage.* timing accumulators):
+    # preprocess = fetch-wait, dispatch = pack + async launch, collect =
+    # device wait + merge + the lstm family, fold = verdict writes.
+    # Overlap is visible as dispatch landing INSIDE the preprocess span's
+    # wall time — the separate stage numbers sum close to the cycle wall
+    # only when the pipeline had nothing to overlap.
+    stage_fields = {
+        "stage_s_per_cycle": {
+            s: per_cycle(f"engine.stage.{s}")
+            for s in ("preprocess", "dispatch", "collect", "fold")
+        },
+        "compiles_warmup": cc_warm.compiles,
+        "compiles_steady_state": cc_steady.compiles,
+    }
     return {
         "metric": "engine_cycle_jobs_per_sec",
         "value": round(n_jobs * cycles / wall, 1),
         "unit": "jobs/s",
         **host_fields,
         **mix_fields,
+        **stage_fields,
         "native": native.available(),
         "jobs": n_jobs,
         "cycles": cycles,

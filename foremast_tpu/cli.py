@@ -1,4 +1,4 @@
-"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | demo.
+"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | prewarm | demo.
 
 One entrypoint covers the reference's process zoo and kubectl plugins:
 
@@ -14,6 +14,10 @@ One entrypoint covers the reference's process zoo and kubectl plugins:
             plugins (bin/kubectl-watch:3 in the reference patched the CRD
             with kubectl; here we speak to the API server directly).
   status <app>            print the monitor's phase / job / anomaly.
+  prewarm   compile the (family x rung x T-bucket) scoring grid — into
+            the persistent compile cache when COMPILE_CACHE_PATH is set —
+            so runtime pods start without the first-cycle compile storm
+            (engine/pipeline.py, docs/performance.md).
   demo      self-contained local loop: chaos app + fake metric source +
             engine, no cluster (examples/demo_app.py).
 
@@ -218,6 +222,38 @@ def cmd_trigger(args) -> int:
     return 0
 
 
+def cmd_prewarm(args) -> int:
+    """Compile the standard (family x rung x T-bucket) scoring grid.
+
+    With COMPILE_CACHE_PATH set the compiled programs land in the
+    persistent cache, so every runtime pointed at the same cache dir
+    (ReadWriteMany volume in the shipped manifests) starts warm; without
+    it this is a dry-run that prints what a cold start would compile.
+    """
+    from .engine.config import from_env
+    from .engine.pipeline import enable_compile_cache, prewarm
+
+    cfg = from_env()
+    cache_on = bool(cfg.compile_cache_path) and enable_compile_cache(
+        cfg.compile_cache_path)
+    if cfg.compile_cache_path and not cache_on:
+        print("warning: this jax build has no persistent compilation "
+              "cache; prewarm only warms THIS process", file=sys.stderr)
+    try:
+        rungs = tuple(int(r) for r in args.rungs.split(",") if r.strip())
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+        families = tuple(f.strip() for f in args.families.split(",")
+                         if f.strip())
+    except ValueError as e:
+        print(f"invalid prewarm grid: {e}", file=sys.stderr)
+        return 2
+    info = prewarm(cfg, families=families, rungs=rungs, t_buckets=buckets)
+    # report the cache as active only when the knob actually took
+    info["compile_cache"] = cfg.compile_cache_path if cache_on else None
+    print(json.dumps(info, indent=2))
+    return 0
+
+
 def cmd_demo(args) -> int:
     if args.hpa:
         from .examples.demo_app import run_demo_hpa
@@ -258,6 +294,19 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("app")
         sp.add_argument("-n", "--namespace", default="default")
         sp.set_defaults(func=fn)
+    pw = sub.add_parser(
+        "prewarm",
+        help="compile the scoring-program grid (into COMPILE_CACHE_PATH "
+             "when set) so runtimes start without the compile storm",
+    )
+    pw.add_argument("--families", default="pair,band,bivariate,hpa",
+                    help="comma-separated model families to warm")
+    pw.add_argument("--rungs", default="16,64,256,1024",
+                    help="comma-separated batch rungs (clamped to the "
+                         "engine's rung ladder)")
+    pw.add_argument("--buckets", default="128,256",
+                    help="comma-separated T (window-length) buckets")
+    pw.set_defaults(func=cmd_prewarm)
     d = sub.add_parser("demo", help="local end-to-end demo, no cluster")
     variant = d.add_mutually_exclusive_group()
     variant.add_argument("--healthy", action="store_true",

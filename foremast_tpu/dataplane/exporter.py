@@ -81,6 +81,25 @@ class VerdictExporter:
         self._set(f"foremastbrain:{metric}_lower", labels, lower)
         self._set(f"foremastbrain:{metric}_anomaly", labels, anomaly)
 
+    def record_cycle_stages(self, stages: dict, families: dict):
+        """Per-stage cycle timing gauges, fed from the engine's tracing
+        stage accumulators every cycle: how the last cycle's wall time
+        split across preprocess (fetch wait), dispatch (pack + async
+        launch), collect (device wait + merge) and fold (verdict
+        writing), plus per-model-family scoring seconds. The overlap
+        story in two series: at full pipeline efficiency
+        sum(cycle_stage_seconds) is well under the cycle wall clock."""
+        for stage, secs in stages.items():
+            self.record_gauge(
+                "foremastbrain:cycle_stage_seconds", {"stage": stage},
+                round(float(secs), 6),
+                help="Seconds spent per engine-cycle stage (last cycle).")
+        for family, secs in families.items():
+            self.record_gauge(
+                "foremastbrain:cycle_family_score_seconds",
+                {"family": family}, round(float(secs), 6),
+                help="Per-model-family scoring seconds (last cycle).")
+
     def record_hpa_score(self, app: str, namespace: str, score: float):
         self._set(
             "foremastbrain:namespace_app_per_pod:hpa_score",

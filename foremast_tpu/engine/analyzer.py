@@ -229,6 +229,9 @@ class Analyzer:
         # job after a batch failure.
         self.lstm_budget_skips = 0
         self._lstm_budget_skipped_ids: set = set()
+        # last cycle's stage/family timing decomposition (served on
+        # /status; gauges on /metrics) — empty until the first cycle
+        self.last_cycle_stages: dict = {}
 
     # ------------------------------------------------------------------ fetch
     def _fetch_window(self, url: str, now: float) -> Window | None:
@@ -358,9 +361,9 @@ class Analyzer:
                 return b
         return C
 
-    def _score_chunks(self, fn, arrays: list) -> dict:
-        """Row-chunk packed (B, ...) arrays into FIXED batch buckets, call
-        fn per chunk, and concatenate the output dicts.
+    def _launch_chunks(self, fn, arrays: list) -> list:
+        """Row-chunk packed (B, ...) arrays into FIXED batch buckets and
+        call fn per chunk WITHOUT materializing the outputs.
 
         XLA specializes every jitted program on the batch dimension, so
         launching the raw fleet size compiles a fresh program whenever the
@@ -372,10 +375,16 @@ class Analyzer:
         smallest rung that fits — never to the full chunk — with edge
         padding (repeat of the last row — always semantically valid
         inputs); padded rows are trimmed on merge.
+
+        Returns [(out_dict, n_valid_rows)] in row order. The out dicts
+        hold whatever fn returned — for jitted scorers these are
+        async-dispatch device values; nothing blocks until
+        `_collect_chunks` materializes them, so the caller can keep
+        packing the next bucket while the device drains this one.
         """
         B = arrays[0].shape[0]
         C = self._bucket_rows(B)
-        outs = []
+        launches = []
         for i in range(0, B, C):
             sl = [a[i:i + C] for a in arrays]
             n = sl[0].shape[0]
@@ -383,33 +392,56 @@ class Analyzer:
             if n < target:
                 sl = [np.pad(a, ((0, target - n),) + ((0, 0),) * (a.ndim - 1),
                              mode="edge") for a in sl]
-            out = fn(*sl)
-            outs.append({k: np.asarray(v)[:n] for k, v in out.items()})
+            launches.append((fn(*sl), n))
+        return launches
+
+    @staticmethod
+    def _collect_chunks(launches: list) -> dict:
+        """Materialize `_launch_chunks` output: block on the device values,
+        trim padded rows, concatenate chunks back into one (B, ...) dict."""
+        outs = [
+            {k: np.asarray(v)[:n] for k, v in out.items()}
+            for out, n in launches
+        ]
         if len(outs) == 1:
             return outs[0]
         return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
 
-    def _score_period_partitions(self, band_fn, args, xv, xm, regions) -> dict:
-        """Run a band scorer, partitioned by detected seasonal period.
+    def _score_chunks(self, fn, arrays: list) -> dict:
+        """Synchronous launch+collect (the pre-pipeline contract)."""
+        return self._collect_chunks(self._launch_chunks(fn, arrays))
+
+    def _launch_period_partitions(self, band_fn, args, xv, xm, regions) -> list:
+        """Launch a band scorer, partitioned by detected seasonal period.
 
         The HW/seasonal-trend scan needs a STATIC period (the season buffer
         length is a compiled shape), so per-series detected periods cannot
         ride one launch. Candidate sets are tiny (operational cycles), so
         the fleet splits into at most a handful of sub-batches — each still
         chunked into the fixed rungs — and outputs merge back in original
-        order. No-period algorithms and auto-off fall through to one call.
+        order at collect time. No-period algorithms and auto-off fall
+        through to one partition. Detection itself materializes (the chosen
+        periods steer host-side batching), but the scoring launches stay
+        async. Returns [(row_idx | None, chunk launches)].
         """
         chosen = self._detect_periods(xv, xm, regions)
         if chosen is None:
-            return self._score_chunks(band_fn, args)
-        out: dict | None = None
-        B = xv.shape[0]
+            return [(None, self._launch_chunks(band_fn, args))]
+        parts = []
         for p in np.unique(chosen):
             idx = np.nonzero(chosen == p)[0]
-            sub = self._score_chunks(
+            parts.append((idx, self._launch_chunks(
                 lambda *a, _p=int(p): band_fn(*a, _period=_p),
                 [a[idx] for a in args],
-            )
+            )))
+        return parts
+
+    def _collect_period_partitions(self, parts: list, B: int) -> dict:
+        if len(parts) == 1 and parts[0][0] is None:
+            return self._collect_chunks(parts[0][1])
+        out: dict | None = None
+        for idx, launches in parts:
+            sub = self._collect_chunks(launches)
             if out is None:
                 out = {
                     k: np.empty((B,) + v.shape[1:], v.dtype)
@@ -419,59 +451,86 @@ class Analyzer:
                 out[k][idx] = v
         return out
 
+    # ------------------------------------------------ family launch/collect
+    # Each batch family (pair, band, bivariate, hpa) is split into a
+    # `_launch_*` half (pack + async device dispatch; returns an opaque
+    # state tuple whose [0] is the claim-ordered entry list) and a
+    # `_collect_*` half (materialize + per-item postprocess). The
+    # synchronous `_score_*` entry points — the pre-pipeline contract, and
+    # the per-job retry path of the `_isolate` blast-radius fallback — are
+    # launch + immediate collect over the same code, so the two paths
+    # cannot drift.
+
+    @staticmethod
+    def _pair_T(it: _PairItem) -> int:
+        return bucket_length(
+            max(it.baseline.values.shape[0], it.current.values.shape[0])
+        )
+
+    @staticmethod
+    def _by_bucket(items, key) -> dict:
+        by: dict[int, list] = {}
+        for it in items:
+            by.setdefault(key(it), []).append(it)
+        return by
+
+    def _launch_pairs(self, group: list, T: int):
+        cfg = self.config
+        bvals, bm = pack_windows([it.baseline for it in group], pad_to=T)
+        cv, cm = pack_windows([it.current for it in group], pad_to=T)
+        B = len(group)
+        launches = self._launch_chunks(fl.score_pairs, [
+            bvals, bm, cv, cm,
+            np.full(B, cfg.pairwise_threshold, np.float32),
+            np.full(B, cfg.enabled_tests(), np.int32),
+            np.full(
+                B,
+                fl.COMBINE_ALL if cfg.pairwise_combine_all else fl.COMBINE_ANY,
+                np.int32,
+            ),
+            np.full(B, cfg.ma_window, np.int32),
+            np.asarray([it.policy.threshold for it in group], np.float32),
+            np.asarray([it.policy.bound for it in group], np.int32),
+            np.asarray([it.policy.min_lower_bound for it in group], np.float32),
+            np.tile(
+                np.asarray(
+                    [
+                        cfg.min_mann_whitney_points,
+                        cfg.min_wilcoxon_points,
+                        cfg.min_kruskal_points,
+                        cfg.min_friedman_points,
+                    ],
+                    np.int32,
+                ),
+                (B, 1),
+            ),
+        ])
+        return (group, launches)
+
+    def _collect_pairs(self, state) -> dict:
+        group, launches = state
+        out = self._collect_chunks(launches)
+        results = {}
+        unhealthy = out["unhealthy"]
+        min_p = out["min_p"]
+        pw = out["pairwise_unhealthy"]
+        band = out["band_unhealthy"]
+        band_count = out["band_count"]
+        for i, it in enumerate(group):
+            results[(it.job_id, it.metric, "pair")] = {
+                "unhealthy": bool(unhealthy[i]),
+                "min_p": float(min_p[i]),
+                "pairwise_unhealthy": bool(pw[i]),
+                "band_unhealthy": bool(band[i]),
+                "band_count": int(band_count[i]),
+            }
+        return results
+
     def _score_pairs(self, items: list[_PairItem]):
         """Batch all pairwise items (bucketed by window length)."""
         results = {}
-        by_bucket: dict[int, list[_PairItem]] = {}
-        for it in items:
-            T = bucket_length(
-                max(it.baseline.values.shape[0], it.current.values.shape[0])
-            )
-            by_bucket.setdefault(T, []).append(it)
-        cfg = self.config
-        for T, group in by_bucket.items():
-            bv, bm = pack_windows([it.baseline for it in group], pad_to=T)
-            cv, cm = pack_windows([it.current for it in group], pad_to=T)
-            B = len(group)
-            out = self._score_chunks(fl.score_pairs, [
-                bv, bm, cv, cm,
-                np.full(B, cfg.pairwise_threshold, np.float32),
-                np.full(B, cfg.enabled_tests(), np.int32),
-                np.full(
-                    B,
-                    fl.COMBINE_ALL if cfg.pairwise_combine_all else fl.COMBINE_ANY,
-                    np.int32,
-                ),
-                np.full(B, cfg.ma_window, np.int32),
-                np.asarray([it.policy.threshold for it in group], np.float32),
-                np.asarray([it.policy.bound for it in group], np.int32),
-                np.asarray([it.policy.min_lower_bound for it in group], np.float32),
-                np.tile(
-                    np.asarray(
-                        [
-                            cfg.min_mann_whitney_points,
-                            cfg.min_wilcoxon_points,
-                            cfg.min_kruskal_points,
-                            cfg.min_friedman_points,
-                        ],
-                        np.int32,
-                    ),
-                    (B, 1),
-                ),
-            ])
-            unhealthy = out["unhealthy"]
-            min_p = out["min_p"]
-            pw = out["pairwise_unhealthy"]
-            band = out["band_unhealthy"]
-            band_count = out["band_count"]
-            for i, it in enumerate(group):
-                results[(it.job_id, it.metric, "pair")] = {
-                    "unhealthy": bool(unhealthy[i]),
-                    "min_p": float(min_p[i]),
-                    "pairwise_unhealthy": bool(pw[i]),
-                    "band_unhealthy": bool(band[i]),
-                    "band_count": int(band_count[i]),
-                }
+        for T, group in self._by_bucket(items, self._pair_T).items():
+            results.update(self._collect_pairs(self._launch_pairs(group, T)))
         return results
 
     def _needs_period(self) -> bool:
@@ -512,12 +571,13 @@ class Analyzer:
                  period_override: int | None = None):
         """Forecaster dispatch on config.algorithm (history-only fit).
 
-        `data_steps` is the UNPADDED series length: the long-window gate
-        must see real data size, not the bucket the batch was padded to,
-        or padding alone would flip the kernel choice. `period_override`
-        carries a detected seasonal period (already support-gated against
-        the series length by detect_period); without it the static
-        HW_PERIOD config is clamped to the window.
+        `data_steps` steers the long-window kernel gate; the band path
+        passes its bucket T so the choice is a pure function of the
+        compiled bucket — identical for every chunking of the same
+        bucket (streamed vs. barriered launches must agree bit-for-bit).
+        `period_override` carries a detected seasonal period (already
+        support-gated against the series length by detect_period);
+        without it the static HW_PERIOD config is clamped to the window.
         """
         algo = self.config.algorithm
         hist_mask = xm & ~region
@@ -552,71 +612,87 @@ class Analyzer:
             preds = fc.moving_average_predictions(xv, hist_mask, self.config.ma_window)
         return np.asarray(preds), hist_mask
 
+    @staticmethod
+    def _band_T(it: _BandItem) -> int:
+        return bucket_length(
+            min(
+                it.historical.values.shape[0] + it.current.values.shape[0],
+                MAX_WINDOW_STEPS,
+            )
+        )
+
+    def _launch_bands(self, group: list, T: int):
+        concats = []
+        regions = np.zeros((len(group), T), bool)
+        n_hs = []
+        for i, it in enumerate(group):
+            h, c = it.historical, it.current
+            vals, mask, n_h = _concat_trimmed(h, c)
+            n_hs.append(n_h)
+            concats.append(Window(vals, mask, h.start, h.step))
+            regions[i, n_h : vals.shape[0]] = True
+        xv, xm = pack_windows(concats, pad_to=T)
+
+        def band_fn(xv_c, xm_c, reg_c, thr_c, bnd_c, mlb_c, _period=None):
+            # the long-window kernel gate is a function of the BUCKET (T),
+            # not of the rows sharing a chunk: a data-dependent gate (max
+            # real length in the chunk) would make a row's smoother choice
+            # depend on its chunk-mates, so streamed launches (different
+            # chunk boundaries) could flip a borderline band verdict vs.
+            # the barriered path. T is already what the program compiles
+            # on; buckets only reach 4096 when their members are >2048
+            # points, where the assoc scan is the right kernel anyway.
+            preds, hist_mask = self._predict(
+                xv_c, xm_c, reg_c, T, period_override=_period)
+            sigma = np.asarray(
+                fc.residual_sigma(xv_c, preds, hist_mask, ~reg_c))
+            return fc.band_anomalies(
+                xv_c, xm_c, reg_c, preds, sigma, thr_c, bnd_c, mlb_c)
+
+        args = [
+            xv, xm, regions,
+            np.asarray([it.policy.threshold for it in group], np.float32),
+            np.asarray([it.policy.bound for it in group], np.int32),
+            np.asarray([it.policy.min_lower_bound for it in group], np.float32),
+        ]
+        parts = self._launch_period_partitions(band_fn, args, xv, xm, regions)
+        return (group, parts, xv, regions, n_hs)
+
+    def _collect_bands(self, state) -> dict:
+        group, parts, xv, regions, n_hs = state
+        out = self._collect_period_partitions(parts, len(group))
+        results = {}
+        counts = out["count"]
+        firsts = out["first_index"]
+        uppers = out["upper"]
+        lowers = out["lower"]
+        flags = out["flags"]
+        checked = out["checked"]
+        for i, it in enumerate(group):
+            n_h = n_hs[i]
+            anomalous_idx = np.nonzero(flags[i])[0]
+            anomaly_pairs = []
+            for j in anomalous_idx[:50]:
+                anomaly_pairs += [_concat_ts(it.current, n_h, int(j)),
+                                  float(xv[i, j])]
+            region_sel = regions[i]
+            first = int(firsts[i])
+            results[(it.job_id, it.metric, "band")] = {
+                "count": int(counts[i]),
+                "unhealthy": int(counts[i]) >= self._gate(checked[i]),
+                "first_ts": (
+                    _concat_ts(it.current, n_h, first) if first >= 0 else -1.0
+                ),
+                "upper": float(np.mean(uppers[i][region_sel])),
+                "lower": float(np.mean(lowers[i][region_sel])),
+                "anomaly_pairs": anomaly_pairs,
+            }
+        return results
+
     def _score_bands(self, items: list[_BandItem]):
         results = {}
-        by_bucket: dict[int, list[_BandItem]] = {}
-        for it in items:
-            T = bucket_length(
-                min(
-                    it.historical.values.shape[0] + it.current.values.shape[0],
-                    MAX_WINDOW_STEPS,
-                )
-            )
-            by_bucket.setdefault(T, []).append(it)
-        for T, group in by_bucket.items():
-            concats = []
-            regions = np.zeros((len(group), T), bool)
-            trimmed_n_h = {}
-            for i, it in enumerate(group):
-                h, c = it.historical, it.current
-                vals, mask, n_h = _concat_trimmed(h, c)
-                trimmed_n_h[id(it)] = n_h
-                concats.append(Window(vals, mask, h.start, h.step))
-                regions[i, n_h : vals.shape[0]] = True
-            xv, xm = pack_windows(concats, pad_to=T)
-            data_steps = max(w.values.shape[0] for w in concats)
-
-            def band_fn(xv_c, xm_c, reg_c, thr_c, bnd_c, mlb_c,
-                        _steps=data_steps, _period=None):
-                preds, hist_mask = self._predict(
-                    xv_c, xm_c, reg_c, _steps, period_override=_period)
-                sigma = np.asarray(
-                    fc.residual_sigma(xv_c, preds, hist_mask, ~reg_c))
-                return fc.band_anomalies(
-                    xv_c, xm_c, reg_c, preds, sigma, thr_c, bnd_c, mlb_c)
-
-            args = [
-                xv, xm, regions,
-                np.asarray([it.policy.threshold for it in group], np.float32),
-                np.asarray([it.policy.bound for it in group], np.int32),
-                np.asarray([it.policy.min_lower_bound for it in group], np.float32),
-            ]
-            out = self._score_period_partitions(band_fn, args, xv, xm, regions)
-            counts = out["count"]
-            firsts = out["first_index"]
-            uppers = out["upper"]
-            lowers = out["lower"]
-            flags = out["flags"]
-            checked = out["checked"]
-            for i, it in enumerate(group):
-                n_h = trimmed_n_h[id(it)]
-                anomalous_idx = np.nonzero(flags[i])[0]
-                anomaly_pairs = []
-                for j in anomalous_idx[:50]:
-                    anomaly_pairs += [_concat_ts(it.current, n_h, int(j)),
-                                      float(xv[i, j])]
-                region_sel = regions[i]
-                first = int(firsts[i])
-                results[(it.job_id, it.metric, "band")] = {
-                    "count": int(counts[i]),
-                    "unhealthy": int(counts[i]) >= self._gate(checked[i]),
-                    "first_ts": (
-                        _concat_ts(it.current, n_h, first) if first >= 0 else -1.0
-                    ),
-                    "upper": float(np.mean(uppers[i][region_sel])),
-                    "lower": float(np.mean(lowers[i][region_sel])),
-                    "anomaly_pairs": anomaly_pairs,
-                }
+        for T, group in self._by_bucket(items, self._band_T).items():
+            results.update(self._collect_bands(self._launch_bands(group, T)))
         return results
 
     def _gate(self, checked) -> float:
@@ -627,80 +703,93 @@ class Analyzer:
             self.config.band_violation_fraction * float(checked),
         )
 
+    @staticmethod
+    def _bi_prep(it: _BiItem):
+        """((x, m, n_h, n_c) joint grid, T bucket) for one bivariate item."""
+        pre = _joint_grid(list(it.hist), list(it.cur))
+        return pre, bucket_length(pre[0].shape[1])
+
+    def _launch_bivariate(self, entries: list, T: int):
+        """entries: [(item, joint-grid prep)] — one launch state per bucket."""
+        B = len(entries)
+        x1 = np.zeros((B, T), np.float32)
+        x2 = np.zeros((B, T), np.float32)
+        m1 = np.zeros((B, T), bool)
+        m2 = np.zeros((B, T), bool)
+        region = np.zeros((B, T), bool)
+        thr = np.empty(B, np.float32)
+        mlb1 = np.empty(B, np.float32)
+        mlb2 = np.empty(B, np.float32)
+        bm1 = np.empty(B, np.int32)
+        bm2 = np.empty(B, np.int32)
+        for i, (it, (x, m, n_h, n_c)) in enumerate(entries):
+            n = x.shape[1]
+            x1[i, :n], x2[i, :n] = x[0], x[1]
+            m1[i, :n], m2[i, :n] = m[0], m[1]
+            region[i, n_h:n] = True
+            # the pair shares one ellipse: use the stricter (smaller)
+            # radius of the two metric policies
+            thr[i] = min(it.policies[0].threshold, it.policies[1].threshold)
+            mlb1[i] = it.policies[0].min_lower_bound
+            mlb2[i] = it.policies[1].min_lower_bound
+            bm1[i] = it.policies[0].bound
+            bm2[i] = it.policies[1].bound
+        launches = self._launch_chunks(bv.bivariate_normal_anomalies, [
+            x1, m1, x2, m2, region, thr, mlb1, mlb2, bm1, bm2,
+        ])
+        return (entries, launches, region)
+
+    def _collect_bivariate(self, state) -> dict:
+        entries, launches, region = state
+        out = self._collect_chunks(launches)
+        results = {}
+        counts = np.asarray(out["count"])
+        firsts = np.asarray(out["first_index"])
+        checked = np.asarray(out["checked"])
+        flags = np.asarray(out["flags"])
+        upper1 = np.asarray(out["upper1"])
+        lower1 = np.asarray(out["lower1"])
+        upper2 = np.asarray(out["upper2"])
+        lower2 = np.asarray(out["lower2"])
+        for i, (it, (x, m, n_h, n_c)) in enumerate(entries):
+            cur0 = it.cur[0]
+            first = int(firsts[i])
+            anomalous_idx = np.nonzero(flags[i])[0]
+            anomaly_pairs = []
+            for j in anomalous_idx[:50]:
+                anomaly_pairs += [_concat_ts(cur0, n_h, int(j)),
+                                  float(x[0, int(j)])]
+            sel = region[i]
+            results[(it.job_id, "&".join(it.metrics), "bivariate")] = {
+                "count": int(counts[i]),
+                "unhealthy": int(counts[i]) >= self._gate(checked[i]),
+                "first_ts": (
+                    _concat_ts(cur0, n_h, first) if first >= 0 else -1.0
+                ),
+                "anomaly_pairs": anomaly_pairs,
+                "bounds": {
+                    it.metrics[0]: (
+                        float(np.mean(upper1[i][sel])),
+                        float(np.mean(lower1[i][sel])),
+                    ),
+                    it.metrics[1]: (
+                        float(np.mean(upper2[i][sel])),
+                        float(np.mean(lower2[i][sel])),
+                    ),
+                },
+            }
+        return results
+
     def _score_bivariate(self, items: list[_BiItem]):
         """Joint 2-metric scoring: one bivariate-normal program per bucket."""
         results = {}
         by_bucket: dict[int, list] = {}
-        prepped = {}
         for it in items:
-            x, m, n_h, n_c = _joint_grid(list(it.hist), list(it.cur))
-            T = bucket_length(x.shape[1])
-            prepped[id(it)] = (x, m, n_h, n_c)
-            by_bucket.setdefault(T, []).append(it)
-        for T, group in by_bucket.items():
-            B = len(group)
-            x1 = np.zeros((B, T), np.float32)
-            x2 = np.zeros((B, T), np.float32)
-            m1 = np.zeros((B, T), bool)
-            m2 = np.zeros((B, T), bool)
-            region = np.zeros((B, T), bool)
-            thr = np.empty(B, np.float32)
-            mlb1 = np.empty(B, np.float32)
-            mlb2 = np.empty(B, np.float32)
-            bm1 = np.empty(B, np.int32)
-            bm2 = np.empty(B, np.int32)
-            for i, it in enumerate(group):
-                x, m, n_h, n_c = prepped[id(it)]
-                n = x.shape[1]
-                x1[i, :n], x2[i, :n] = x[0], x[1]
-                m1[i, :n], m2[i, :n] = m[0], m[1]
-                region[i, n_h:n] = True
-                # the pair shares one ellipse: use the stricter (smaller)
-                # radius of the two metric policies
-                thr[i] = min(it.policies[0].threshold, it.policies[1].threshold)
-                mlb1[i] = it.policies[0].min_lower_bound
-                mlb2[i] = it.policies[1].min_lower_bound
-                bm1[i] = it.policies[0].bound
-                bm2[i] = it.policies[1].bound
-            out = self._score_chunks(bv.bivariate_normal_anomalies, [
-                x1, m1, x2, m2, region, thr, mlb1, mlb2, bm1, bm2,
-            ])
-            counts = np.asarray(out["count"])
-            firsts = np.asarray(out["first_index"])
-            checked = np.asarray(out["checked"])
-            flags = np.asarray(out["flags"])
-            upper1 = np.asarray(out["upper1"])
-            lower1 = np.asarray(out["lower1"])
-            upper2 = np.asarray(out["upper2"])
-            lower2 = np.asarray(out["lower2"])
-            for i, it in enumerate(group):
-                x, m, n_h, n_c = prepped[id(it)]
-                cur0 = it.cur[0]
-                first = int(firsts[i])
-                anomalous_idx = np.nonzero(flags[i])[0]
-                anomaly_pairs = []
-                for j in anomalous_idx[:50]:
-                    anomaly_pairs += [_concat_ts(cur0, n_h, int(j)),
-                                      float(x[0, int(j)])]
-                sel = region[i]
-                results[(it.job_id, "&".join(it.metrics), "bivariate")] = {
-                    "count": int(counts[i]),
-                    "unhealthy": int(counts[i]) >= self._gate(checked[i]),
-                    "first_ts": (
-                        _concat_ts(cur0, n_h, first) if first >= 0 else -1.0
-                    ),
-                    "anomaly_pairs": anomaly_pairs,
-                    "bounds": {
-                        it.metrics[0]: (
-                            float(np.mean(upper1[i][sel])),
-                            float(np.mean(lower1[i][sel])),
-                        ),
-                        it.metrics[1]: (
-                            float(np.mean(upper2[i][sel])),
-                            float(np.mean(lower2[i][sel])),
-                        ),
-                    },
-                }
+            pre, T = self._bi_prep(it)
+            by_bucket.setdefault(T, []).append((it, pre))
+        for T, entries in by_bucket.items():
+            results.update(
+                self._collect_bivariate(self._launch_bivariate(entries, T)))
         return results
 
     def _lstm_model(self, F: int, unroll: int = 8):
@@ -1062,13 +1151,14 @@ class Analyzer:
         except Exception:  # noqa: BLE001 — corrupt cache file: cold-start
             return 0
 
-    def _score_hpa(self, items: list[_HpaItem]):
-        """Batch HPA items: primary (priority 0 / tps-like) metric drives the
-        traffic model; an SLA metric (is_increase & priority>0) the reward."""
+    @staticmethod
+    def _hpa_rows(items: list[_HpaItem]) -> list:
+        """[(job_id, tps_item, sla_item)] — primary (priority 0 / tps-like)
+        metric drives the traffic model; an SLA metric (is_increase &
+        priority>0) the reward."""
         by_job: dict[str, list[_HpaItem]] = {}
         for it in items:
             by_job.setdefault(it.job_id, []).append(it)
-        out = {}
         rows = []
         for job_id, group in by_job.items():
             group.sort(key=lambda it: it.priority)
@@ -1081,39 +1171,46 @@ class Analyzer:
             else:
                 sla_it = group[1] if len(group) > 1 else group[0]
             rows.append((job_id, tps_it, sla_it))
-        if not rows:
-            return out
-        # bucket rows by their OWN pack length (the max of the job's tps
-        # and sla series — lengths are data-driven and independent) like
-        # every other fleet scorer: one global max-T would pad a whole
-        # heterogeneous fleet to its single longest member (a lone
-        # 7-day-history job would inflate every 2-hour job's scan 128x)
-        by_bucket: dict[int, list] = {}
-        for row in rows:
-            T_row = max(
-                bucket_length(
-                    min(
-                        it.historical.values.shape[0]
-                        + it.current.values.shape[0],
-                        MAX_WINDOW_STEPS,
-                    )
+        return rows
+
+    @staticmethod
+    def _hpa_row_T(row) -> int:
+        """Pack-length bucket for one HPA row: the max of the job's OWN tps
+        and sla series (lengths are data-driven and independent) like every
+        other fleet scorer — one global max-T would pad a whole
+        heterogeneous fleet to its single longest member (a lone
+        7-day-history job would inflate every 2-hour job's scan 128x)."""
+        return max(
+            bucket_length(
+                min(
+                    it.historical.values.shape[0]
+                    + it.current.values.shape[0],
+                    MAX_WINDOW_STEPS,
                 )
-                for it in (row[1], row[2])
             )
-            by_bucket.setdefault(T_row, []).append(row)
+            for it in (row[1], row[2])
+        )
+
+    def _score_hpa(self, items: list[_HpaItem]):
+        out = {}
+        by_bucket: dict[int, list] = {}
+        for row in self._hpa_rows(items):
+            by_bucket.setdefault(self._hpa_row_T(row), []).append(row)
         for T, bucket_rows in by_bucket.items():
-            out.update(self._score_hpa_bucket(bucket_rows, T))
+            out.update(self._collect_hpa(self._launch_hpa(bucket_rows, T)))
         return out
 
-    def _score_hpa_bucket(self, rows, T: int):
-        """Score one pack-length bucket of HPA jobs in chunked launches."""
-        out: dict = {}
+    def _launch_hpa(self, rows, T: int):
+        """Pack + launch one pack-length bucket of HPA jobs."""
 
         def build(it):
             vals, mask, n_h = _concat_trimmed(it.historical, it.current)
             region = np.zeros(T, bool)
             region[n_h : vals.shape[0]] = True
-            return Window(vals, mask, it.historical.start), region
+            # carry the series' own step: a non-default-step job must not
+            # silently snap back to the 60 s DEFAULT_STEP
+            return Window(vals, mask, it.historical.start,
+                          it.historical.step), region
 
         tps_w, regions = zip(*[build(t) for _, t, _ in rows])
         sla_w = [build(s)[0] for _, _, s in rows]
@@ -1174,11 +1271,17 @@ class Analyzer:
                 pods_now=pn_c, pods_hist=ph_c, sla_absolute=abs_c,
             )
 
-        res = self._score_chunks(
+        launches = self._launch_chunks(
             hpa_fn,
             [tv, tm, reg, sv, sm, limits, modes, absolutes,
              pods_now, pods_hist],
         )
+        return (rows, launches, had_pods)
+
+    def _collect_hpa(self, state) -> dict:
+        rows, launches, had_pods = state
+        res = self._collect_chunks(launches)
+        out: dict = {}
         for i, (job_id, tps_it, sla_it) in enumerate(rows):
             out[job_id] = {
                 "raw_score": float(res["score"][i]),
@@ -1214,7 +1317,44 @@ class Analyzer:
                 if sd is not None:
                     sd(None)
 
+    def _stream_prep(self, claimed: list, now: float):
+        """Yield (doc_id, items, failed) per job, in claim order, as the
+        fetch pool completes chunks.
+
+        Per-job fetches overlap on a bounded pool: fetch is network-bound
+        in production (and the native parser releases the GIL during its C
+        scan), so cycle time tracks store latency, not fleet size. Jobs are
+        mapped in CHUNKS (several per worker for tail-balance) — at 10k+
+        fleet sizes, per-job task dispatch costs more GIL time than the
+        preprocess itself. ex.map preserves submission order, and chunks
+        are cut in claim order, so the yielded stream — and with it bucket
+        packing and verdict folding — stays deterministic; consuming it
+        incrementally is what lets the pipeline dispatch bucket N while
+        bucket N+1 is still fetching.
+        """
+        def prep_many(chunk):
+            out = []
+            for doc in chunk:
+                try:
+                    out.append((doc.id, self._preprocess(doc, now), ""))
+                except FetchError as e:
+                    out.append((doc.id, None, str(e)))
+            return out
+
+        workers = min(max(self.config.fetch_concurrency, 1), len(claimed) or 1)
+        if workers <= 1:
+            yield from prep_many(claimed)
+            return
+        step = max(1, -(-len(claimed) // (workers * 8)))
+        chunks = [claimed[i:i + step]
+                  for i in range(0, len(claimed), step)]
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            for rs in ex.map(prep_many, chunks):
+                yield from rs
+
     def _run_cycle(self, worker: str, now: float | None) -> dict:
+        from .pipeline import CyclePipeline
+
         now = time.time() if now is None else now
         with tracing.span("engine.claim"):
             claimed = self.store.claim_open_jobs(
@@ -1228,47 +1368,32 @@ class Analyzer:
         all_bis: list[_BiItem] = []
         all_multis: list[_MultiItem] = []
         all_hpas: list[_HpaItem] = []
+        self._lstm_trained_this_cycle = 0
+        self._lstm_budget_skipped_ids = set()
+        pipe = CyclePipeline(self) if self.config.score_pipeline else None
+        stages = {"preprocess": 0.0, "dispatch": 0.0, "collect": 0.0,
+                  "fold": 0.0}
         with tracing.span("engine.preprocess", jobs=len(claimed)):
             for doc in claimed:
                 states[doc.id] = _JobState(doc)
-
-            def prep_many(chunk):
-                out = []
-                for doc in chunk:
-                    try:
-                        out.append((doc.id, self._preprocess(doc, now), ""))
-                    except FetchError as e:
-                        out.append((doc.id, None, str(e)))
-                return out
-
-            # per-job fetches overlap on a bounded pool: fetch is
-            # network-bound in production (and the native parser releases
-            # the GIL during its C scan), so cycle time tracks store
-            # latency, not fleet size. Jobs are mapped in CHUNKS (several
-            # per worker for tail-balance) — at 10k+ fleet sizes, per-job
-            # task dispatch costs more GIL time than the preprocess itself.
-            # ex.map preserves submission order, and chunks are cut in claim
-            # order, so item lists — and with them bucket packing and
-            # verdict folding — stay deterministic.
-            workers = min(max(self.config.fetch_concurrency, 1), len(claimed) or 1)
-            if workers <= 1:
-                results = prep_many(claimed)
-            else:
-                step = max(1, -(-len(claimed) // (workers * 8)))
-                chunks = [claimed[i:i + step]
-                          for i in range(0, len(claimed), step)]
-                with ThreadPoolExecutor(max_workers=workers) as ex:
-                    results = [r for rs in ex.map(prep_many, chunks) for r in rs]
-            for doc_id, items, failed in results:
+            t_wait = time.perf_counter()
+            for doc_id, items, failed in self._stream_prep(claimed, now):
+                stages["preprocess"] += time.perf_counter() - t_wait
                 if failed:
                     states[doc_id].failed = failed
-                    continue
-                pairs, bands, bis, multis, hpas = items
-                all_pairs += pairs
-                all_bands += bands
-                all_bis += bis
-                all_multis += multis
-                all_hpas += hpas
+                else:
+                    pairs, bands, bis, multis, hpas = items
+                    all_pairs += pairs
+                    all_bands += bands
+                    all_bis += bis
+                    all_multis += multis
+                    all_hpas += hpas
+                    if pipe is not None:
+                        # streamed dispatch: full bucket rungs launch here,
+                        # overlapping the remaining fetches (the pipeline
+                        # accounts its own dispatch time)
+                        pipe.feed(pairs, bands, bis, multis, hpas)
+                t_wait = time.perf_counter()
         for doc_id, st in states.items():
             if st.failed:
                 if st.doc.strategy in CONTINUOUS_STRATEGIES:
@@ -1287,27 +1412,47 @@ class Analyzer:
                                    J.POSTPROCESS_INPROGRESS, worker=worker)
 
         live = {k: v for k, v in states.items() if not v.failed}
-        self._lstm_trained_this_cycle = 0
-        self._lstm_budget_skipped_ids = set()
+        fam_seconds: dict[str, float] = {}
         with tracing.span("engine.score", pairs=len(all_pairs),
                           bands=len(all_bands), bis=len(all_bis),
                           multis=len(all_multis), hpas=len(all_hpas)):
-            # one child span per model family: the mixed-fleet cycle bench
-            # (and /debug/traces) decomposes the score stage by family
-            with tracing.span("engine.score.pair", n=len(all_pairs)):
-                pair_res, pair_bad = self._isolate(self._score_pairs, all_pairs)
-            with tracing.span("engine.score.band", n=len(all_bands)):
-                band_res, band_bad = self._isolate(self._score_bands, all_bands)
-            with tracing.span("engine.score.bivariate", n=len(all_bis)):
-                bi_res, bi_bad = self._isolate(self._score_bivariate, all_bis)
-            with tracing.span("engine.score.lstm", n=len(all_multis)) as lsp:
-                multi_res, multi_bad = self._isolate(self._score_multi, all_multis)
-                lsp.attrs["budget_skips"] = len(self._lstm_budget_skipped_ids)
-                self.lstm_budget_skips += len(self._lstm_budget_skipped_ids)
-            with tracing.span("engine.score.hpa", n=len(all_hpas)):
-                hpa_res, hpa_bad = self._isolate(self._score_hpa, all_hpas)
-        scoring_failed = {**pair_bad, **band_bad, **bi_bad, **multi_bad, **hpa_bad}
+            if pipe is not None:
+                (pair_res, band_res, bi_res, multi_res, hpa_res,
+                 scoring_failed) = pipe.finish()
+                for k, v in pipe.stage_seconds.items():
+                    stages[k] += v
+                fam_seconds = pipe.family_seconds
+                # the bench's per-family decomposition reads these stats
+                # (engine.score.<fam>), span or not
+                for fam in ("pair", "band", "bivariate", "hpa"):
+                    tracing.tracer.add_timing(
+                        f"engine.score.{fam}", fam_seconds.get(fam, 0.0))
+            else:
+                # barriered fallback (SCORE_PIPELINE=0): one child span per
+                # model family, families strictly sequential
+                def timed(fam, score_fn, items, attrs_fn=None):
+                    with tracing.span(f"engine.score.{fam}", n=len(items)) as sp:
+                        t0 = time.perf_counter()
+                        res = self._isolate(score_fn, items)
+                        fam_seconds[fam] = time.perf_counter() - t0
+                        if attrs_fn is not None:
+                            attrs_fn(sp)
+                        return res
 
+                pair_res, pair_bad = timed("pair", self._score_pairs, all_pairs)
+                band_res, band_bad = timed("band", self._score_bands, all_bands)
+                bi_res, bi_bad = timed("bivariate", self._score_bivariate, all_bis)
+                multi_res, multi_bad = timed(
+                    "lstm", self._score_multi, all_multis,
+                    attrs_fn=lambda sp: sp.attrs.__setitem__(
+                        "budget_skips", len(self._lstm_budget_skipped_ids)))
+                hpa_res, hpa_bad = timed("hpa", self._score_hpa, all_hpas)
+                scoring_failed = {**pair_bad, **band_bad, **bi_bad,
+                                  **multi_bad, **hpa_bad}
+                stages["collect"] += sum(fam_seconds.values())
+            self.lstm_budget_skips += len(self._lstm_budget_skipped_ids)
+
+        t_fold = time.perf_counter()
         # fold per-metric results into per-job verdicts
         for it in all_pairs:
             r = pair_res.get((it.job_id, it.metric, "pair"))
@@ -1424,6 +1569,19 @@ class Analyzer:
                     reason="insufficient data points to judge", worker=worker,
                 )
                 outcomes[job_id] = J.COMPLETED_UNKNOWN
+        stages["fold"] = time.perf_counter() - t_fold
+        # per-stage observability: tracer stats (foremast_trace_* on
+        # /metrics, bench decomposition) + foremastbrain gauges + /status
+        for name, secs in stages.items():
+            tracing.tracer.add_timing(f"engine.stage.{name}", secs)
+        self.exporter.record_cycle_stages(stages, fam_seconds)
+        self.last_cycle_stages = {
+            "jobs": len(claimed),
+            "pipelined": pipe is not None,
+            "stage_seconds": {k: round(v, 6) for k, v in stages.items()},
+            "family_score_seconds": {
+                k: round(v, 6) for k, v in fam_seconds.items()},
+        }
         self.store.put_state("breath", self.breath.export())
         self.store.flush()
         return outcomes

@@ -70,6 +70,30 @@ class EngineConfig:
     # network-bound against the metric store, so overlap is the difference
     # between cycle time scaling with fleet size and with store latency.
     fetch_concurrency: int = 16
+    # streaming scoring pipeline (SCORE_PIPELINE; engine/pipeline.py):
+    # preprocess->dispatch overlap + async device launches collected in a
+    # final phase. Verdicts are byte-identical to the barriered path
+    # (enforced by tests/test_pipeline.py); 0 restores the full-barrier
+    # cycle for A/B or debugging.
+    score_pipeline: bool = True
+    # streamed-launch fire threshold (PIPELINE_FIRE_ROWS): a family/T
+    # accumulator launches as soon as it holds this many rows, overlapping
+    # device execution with the remaining fetches. Clamped to
+    # [16, score_batch]; values are snapped to the batch-rung ladder so
+    # mid-stream launches reuse the same compiled programs as the flush.
+    # Scorers are row-wise, so earlier launch boundaries cannot change
+    # verdicts. score_batch-sized = fire only on full chunks.
+    pipeline_fire_rows: int = 1024
+    # persistent XLA compilation cache directory (COMPILE_CACHE_PATH;
+    # empty = disabled). A restarted process reuses compiled programs
+    # instead of re-paying the first-cycle compile storm (~26 s per mixed
+    # fleet on CPU, BENCH_r05).
+    compile_cache_path: str = ""
+    # compile the standard (family x rung x T-bucket) grid in a background
+    # thread at startup (PREWARM_ON_START; engine/pipeline.py:prewarm), so
+    # the first live cycle doesn't eat the compile storm either. Also
+    # available ahead of deploy as `foremast-tpu prewarm`.
+    prewarm_on_start: bool = False
     ma_window: int = 30  # moving-average lookback (steps)
     # windows at/above this length use the time-parallel associative-scan
     # SES smoother (ops/seqscan.py) instead of sequential lax.scan; DES
@@ -262,6 +286,10 @@ def from_env(env=None) -> EngineConfig:
         max_claim_per_cycle=_env_int(env, "MAX_CLAIM_PER_CYCLE", 100_000),
         score_batch=_env_int(env, "SCORE_BATCH", 8192),
         fetch_concurrency=_env_int(env, "FETCH_CONCURRENCY", 16),
+        score_pipeline=_env_bool(env, "SCORE_PIPELINE", True),
+        pipeline_fire_rows=_env_int(env, "PIPELINE_FIRE_ROWS", 1024),
+        compile_cache_path=env.get("COMPILE_CACHE_PATH", ""),
+        prewarm_on_start=_env_bool(env, "PREWARM_ON_START", False),
         ma_window=_env_int(env, "MA_WINDOW", 30),
         long_window_steps=_env_int(env, "LONG_WINDOW_STEPS", 4096),
         hw_period=_env_int(env, "HW_PERIOD", 1440),

@@ -1,0 +1,386 @@
+"""Bucket-granular scoring pipeline: stream, dispatch, collect.
+
+The pre-pipeline cycle was a chain of full barriers — every job fetched
+and packed before ANY scoring started, the five model families scored
+strictly sequentially, and each chunk launch blocked on materialization
+before the next chunk was even packed. This module turns that chain into
+a pipeline at three levels:
+
+  1. **streaming preprocess -> dispatch** — `Analyzer._run_cycle` feeds
+     each job's preprocessed items into `CyclePipeline` the moment its
+     fetch-pool chunk completes. Items route into per-family /
+     per-T-bucket accumulators, and a device program launches as soon as
+     an accumulator fills a full batch rung (partials flush at stream
+     end), so device execution of bucket N overlaps the fetch+pack of
+     bucket N+1.
+  2. **async dispatch** — launches go through the analyzer's
+     `_launch_*` halves, which return JAX async-dispatch device values;
+     nothing blocks until the final collect phase materializes them, so
+     the four batch families interleave freely on the device queue.
+  3. **persistent compile cache + prewarm** — `enable_compile_cache`
+     points XLA's persistent compilation cache at COMPILE_CACHE_PATH so
+     a restarted process skips the first-cycle compile storm, and
+     `prewarm` compiles the standard (family x rung x T-bucket) grid up
+     front (CLI: `foremast-tpu prewarm`; runtime: PREWARM_ON_START).
+
+Two contracts are preserved exactly:
+
+  * **deterministic folding** — accumulators fill in claim order, fire at
+    the same chunk boundaries the barriered `_score_chunks` would cut
+    (full rungs mid-stream, rung-padded partials at flush), and results
+    are keyed dicts folded in claim order, so verdicts are byte-identical
+    to the sequential path regardless of device completion order.
+  * **`_isolate` blast radius** — a launch- or collect-time failure
+    retries that group per JOB through the family's synchronous scorer;
+    only the offending jobs report errors, everyone else's results stand.
+"""
+from __future__ import annotations
+
+import time
+
+from ..utils import tracing
+
+__all__ = ["CyclePipeline", "CompileCounter", "enable_compile_cache",
+           "prewarm", "STANDARD_RUNGS", "STANDARD_T_BUCKETS"]
+
+
+class CyclePipeline:
+    """One engine cycle's streaming dispatch state. Not thread-safe by
+    design: `feed` is called from the single consumer of the (ordered)
+    preprocess stream, which is what keeps launches deterministic."""
+
+    FAMILIES = ("pair", "band", "bivariate", "hpa")
+
+    def __init__(self, analyzer):
+        self.an = analyzer
+        # fire threshold: an accumulator launches the moment it holds a
+        # full batch rung, so device execution overlaps the remaining
+        # fetches. Snapped to the rung ladder (and capped at the chunk
+        # size) so streamed launches hit the same compiled programs as the
+        # flush; scorers are row-wise, so launch boundaries cannot change
+        # verdicts (the determinism test pins pipeline == barriered).
+        cap = max(16, analyzer.config.score_batch)
+        fire = min(max(analyzer.config.pipeline_fire_rows, 16), cap)
+        self.cap = analyzer._bucket_rows(fire)
+        self.acc: dict = {f: {} for f in self.FAMILIES}  # family -> T -> []
+        self.pending: list = []  # (family, entries, launch_state)
+        self.failed: list = []   # (family, entries) awaiting per-job retry
+        self.multis: list = []   # lstm items score at collect (train+cache)
+        self.stage_seconds = {"dispatch": 0.0, "collect": 0.0}
+        self.family_seconds: dict = {}
+        self.launches = 0
+
+    # ------------------------------------------------------------- feeding
+    def feed(self, pairs, bands, bis, multis, hpas):
+        """Route one job's preprocessed items (claim order) into the
+        accumulators; launch any bucket that filled its rung.
+
+        Routing (bucket keys, joint-grid prep, hpa row building) is
+        guarded per item like every scoring step: a malformed item lands
+        in the per-job retry list instead of aborting the whole cycle —
+        the `_isolate` blast-radius contract starts here, not at launch.
+        """
+        an = self.an
+        self.multis += multis
+        for it in pairs:
+            try:
+                self._add("pair", an._pair_T(it), it)
+            except Exception:  # noqa: BLE001 - retried per job at collect
+                self.failed.append(("pair", [it]))
+        for it in bands:
+            try:
+                self._add("band", an._band_T(it), it)
+            except Exception:  # noqa: BLE001
+                self.failed.append(("band", [it]))
+        for it in bis:
+            try:
+                pre, T = an._bi_prep(it)
+                self._add("bivariate", T, (it, pre))
+            except Exception:  # noqa: BLE001
+                self.failed.append(("bivariate", [it]))
+        if hpas:
+            try:
+                rows = an._hpa_rows(hpas)
+            except Exception:  # noqa: BLE001
+                self.failed.append(("hpa", list(hpas)))
+                rows = []
+            for row in rows:
+                try:
+                    self._add("hpa", an._hpa_row_T(row), row)
+                except Exception:  # noqa: BLE001
+                    self.failed.append(("hpa", [row]))
+
+    def _add(self, family: str, T: int, entry):
+        bucket = self.acc[family].setdefault(T, [])
+        bucket.append(entry)
+        if len(bucket) >= self.cap:
+            self.acc[family][T] = []
+            self._fire(family, T, bucket)
+
+    def _fire(self, family: str, T: int, entries: list):
+        t0 = time.perf_counter()
+        try:
+            if family == "pair":
+                st = self.an._launch_pairs(entries, T)
+            elif family == "band":
+                st = self.an._launch_bands(entries, T)
+            elif family == "bivariate":
+                st = self.an._launch_bivariate(entries, T)
+            else:
+                st = self.an._launch_hpa(entries, T)
+            self.pending.append((family, entries, st))
+        except Exception:  # noqa: BLE001 - blast radius: retry per job later
+            self.failed.append((family, entries))
+        dt = time.perf_counter() - t0
+        self.stage_seconds["dispatch"] += dt
+        self.family_seconds[family] = self.family_seconds.get(family, 0.0) + dt
+        self.launches += 1
+
+    @staticmethod
+    def _entry_items(entries: list) -> list:
+        """Flatten accumulator entries back to scorer items (for the
+        per-job retry path): pair/band entries ARE items, bivariate
+        entries are (item, prep), hpa entries are (job_id, tps, sla)."""
+        items = []
+        for e in entries:
+            if hasattr(e, "job_id"):
+                items.append(e)
+            elif len(e) == 2:
+                items.append(e[0])
+            else:
+                items.append(e[1])
+                if e[2] is not e[1]:
+                    items.append(e[2])
+        return items
+
+    # ----------------------------------------------------------- collecting
+    def finish(self):
+        """Flush partial buckets, materialize every launch, retry failures
+        per job, and score the lstm family. Returns
+        (pair_res, band_res, bi_res, multi_res, hpa_res, scoring_failed)."""
+        an = self.an
+        for family in self.FAMILIES:
+            buckets, self.acc[family] = self.acc[family], {}
+            for T, bucket in buckets.items():
+                if bucket:
+                    self._fire(family, T, bucket)
+        results: dict = {f: {} for f in self.FAMILIES}
+        bad: dict = {}
+        collect = {"pair": an._collect_pairs, "band": an._collect_bands,
+                   "bivariate": an._collect_bivariate, "hpa": an._collect_hpa}
+        sync = {"pair": an._score_pairs, "band": an._score_bands,
+                "bivariate": an._score_bivariate, "hpa": an._score_hpa}
+        t0 = time.perf_counter()
+        # materialize in launch order: completion order is the device's
+        # business; claim-order folding happens downstream off keyed dicts
+        for family, entries, st in self.pending:
+            t1 = time.perf_counter()
+            try:
+                results[family].update(collect[family](st))
+            except Exception:  # noqa: BLE001 - deferred device error
+                self.failed.append((family, entries))
+            dt = time.perf_counter() - t1
+            self.family_seconds[family] = (
+                self.family_seconds.get(family, 0.0) + dt)
+        # blast-radius fallback: a failed group retries per JOB through the
+        # family's synchronous scorer (same launch/collect code, barriered)
+        for family, entries in self.failed:
+            by_job: dict[str, list] = {}
+            for it in self._entry_items(entries):
+                by_job.setdefault(it.job_id, []).append(it)
+            for job_id, group in by_job.items():
+                try:
+                    results[family].update(sync[family](group))
+                except Exception as e:  # noqa: BLE001
+                    bad[job_id] = f"{type(e).__name__}: {e}"
+        # lstm scores here, not in the stream: training mutates the model
+        # cache under a per-cycle budget whose order must match claim order
+        with tracing.span("engine.score.lstm", n=len(self.multis)) as lsp:
+            t1 = time.perf_counter()
+            multi_res, multi_bad = an._isolate(an._score_multi, self.multis)
+            lsp.attrs["budget_skips"] = len(an._lstm_budget_skipped_ids)
+            self.family_seconds["lstm"] = time.perf_counter() - t1
+        # collect = everything after the stream: device wait + merge +
+        # retries + the lstm family — the same work the barriered mode
+        # books under collect, so SCORE_PIPELINE A/Bs compare like stages
+        self.stage_seconds["collect"] += time.perf_counter() - t0
+        bad.update(multi_bad)
+        return (results["pair"], results["band"], results["bivariate"],
+                multi_res, results["hpa"], bad)
+
+
+# ---------------------------------------------------------------- compiles
+class CompileCounter:
+    """Counts XLA compilation work via jax.monitoring events.
+
+    `compiles` counts backend_compile invocations — in a process WITHOUT
+    the persistent cache this is exactly the number of fresh XLA
+    compilations (in-memory jit cache hits never re-enter the backend),
+    which is what the steady-state zero-recompile gate asserts. With the
+    persistent cache enabled, backend_compile wraps retrieval too, so the
+    compile-storm question becomes `cache_misses` (fresh work) vs
+    `cache_hits` (replayed from COMPILE_CACHE_PATH).
+    """
+
+    COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+    CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+    CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+    def __init__(self):
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    def _on_duration(self, event, duration, **kw):
+        if event == self.COMPILE_EVENT:
+            self.compiles += 1
+            self.compile_seconds += duration
+
+    def _on_event(self, event, **kw):
+        if event == self.CACHE_HIT_EVENT:
+            self.cache_hits += 1
+        elif event == self.CACHE_MISS_EVENT:
+            self.cache_misses += 1
+
+    def __enter__(self):
+        import jax.monitoring as jm
+
+        jm.register_event_duration_secs_listener(self._on_duration)
+        jm.register_event_listener(self._on_event)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            from jax._src import monitoring as _m
+
+            _m._unregister_event_duration_listener_by_callback(
+                self._on_duration)
+            _m._unregister_event_listener_by_callback(self._on_event)
+        except Exception:  # noqa: BLE001 - best-effort on private API drift
+            pass
+        return False
+
+
+def enable_compile_cache(path: str) -> bool:
+    """Point JAX's persistent compilation cache at `path` (COMPILE_CACHE_PATH).
+
+    Zeroes the min-compile-time/entry-size gates so even the small
+    per-(rung, T) programs persist — they are exactly what the first-cycle
+    compile storm is made of. Returns False (without raising) on jax
+    builds that lack the knobs: the engine must run identically, just
+    without restart amortization.
+    """
+    if not path:
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 - knob missing on this jax build
+        return False
+    for knob, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 - defaults still cache big entries
+            pass
+    return True
+
+
+# ----------------------------------------------------------------- prewarm
+# the default prewarm grid: small rungs cover flush partials, 1024 covers
+# the default PIPELINE_FIRE_ROWS streamed launches, and the T buckets the
+# common 2h-current / short-history windows. Big fleets should prewarm
+# their real rungs (e.g. --rungs 16,64,256,1024,8192) and their historical
+# T buckets — see docs/performance.md for sizing.
+STANDARD_RUNGS = (16, 64, 256, 1024)
+STANDARD_T_BUCKETS = (128, 256)
+
+
+def prewarm(config=None, families=("pair", "band", "bivariate", "hpa"),
+            rungs=STANDARD_RUNGS, t_buckets=STANDARD_T_BUCKETS) -> dict:
+    """Compile the (family x rung x T-bucket) scoring grid up front.
+
+    Drives the REAL production entry points — the analyzer's family
+    scorers on synthetic items — so the compiled signatures are exactly
+    the ones steady-state cycles launch (dtype or packing drift would show
+    up as a failed zero-recompile regression test, not a silent miss).
+    With the persistent compile cache enabled the work is also banked for
+    every future process. Blocks until the grid is compiled; run it in a
+    background thread to prewarm behind live traffic (PREWARM_ON_START).
+    """
+    import numpy as np
+
+    from ..ops import hpa as hpa_ops
+    from ..ops.windowing import Window, bucket_length
+    from ..parallel import fleet as fl
+    from .analyzer import Analyzer, _BandItem, _BiItem, _HpaItem
+    from .config import EngineConfig, from_env
+
+    cfg = config if config is not None else from_env()
+    if not isinstance(cfg, EngineConfig):
+        raise TypeError(f"prewarm wants an EngineConfig, got {type(cfg)!r}")
+    an = Analyzer(cfg, data_source=None, store=None,
+                  breath=hpa_ops.BreathState())
+    rng = np.random.default_rng(0)
+    # clamp BOTH axes to their ladders: off-ladder values would compile
+    # programs no cycle ever launches (the chunker pads rows to batch
+    # rungs, pack_windows pads lengths to the window buckets) while the
+    # real bucket stayed cold
+    rungs = sorted({an._bucket_rows(int(r)) for r in rungs})
+    t_buckets = sorted({bucket_length(int(t)) for t in t_buckets})
+    policy = cfg.policy_for("latency")
+
+    def win(T):
+        return Window(rng.normal(10.0, 1.0, T).astype(np.float32),
+                      np.ones(T, bool), 0)
+
+    t0 = time.perf_counter()
+    programs = 0
+    with CompileCounter() as cc:
+        for T in t_buckets:
+            n_c = max(T // 4, 8)
+            n_h = T - n_c
+            for r in rungs:
+                if "pair" in families:
+                    # the fused pairwise program straight at the kernel:
+                    # fleet.pair_arg_spec mirrors _launch_pairs' packing
+                    np.asarray(fl.score_pairs(*fl.pair_arg_spec(r, T))
+                               ["unhealthy"])
+                    programs += 1
+                if "band" in families:
+                    an._score_bands([
+                        _BandItem(f"w{i}", "latency", win(n_h), win(n_c),
+                                  policy)
+                        for i in range(r)
+                    ])
+                    programs += 1
+                if "bivariate" in families:
+                    an._score_bivariate([
+                        _BiItem(f"w{i}", ("latency", "cpu"),
+                                (win(n_h), win(n_h)), (win(n_c), win(n_c)),
+                                (policy, policy))
+                        for i in range(r)
+                    ])
+                    programs += 1
+                if "hpa" in families:
+                    items = []
+                    for i in range(r):
+                        items.append(_HpaItem(f"w{i}", "tps", win(n_h),
+                                              win(n_c), True, 0))
+                        items.append(_HpaItem(f"w{i}", "latency", win(n_h),
+                                              win(n_c), True, 1))
+                    an._score_hpa(items)
+                    programs += 1
+    return {
+        "families": list(families),
+        "rungs": list(rungs),
+        "t_buckets": list(t_buckets),
+        "programs": programs,
+        "backend_compiles": cc.compiles,
+        "compile_cache_hits": cc.cache_hits,
+        "seconds": round(time.perf_counter() - t0, 3),
+    }

@@ -40,7 +40,8 @@ from ..ops import forecast as fc
 from ..ops.pairwise import sign_test_exact, two_sample_tests
 from .mesh import FLEET_AXIS, fleet_sharding, replicated
 
-__all__ = ["score_pairs", "make_fleet_scorer", "fleet_summary", "COMBINE_ANY", "COMBINE_ALL"]
+__all__ = ["score_pairs", "pair_arg_spec", "make_fleet_scorer",
+           "fleet_summary", "COMBINE_ANY", "COMBINE_ALL"]
 
 _F = jnp.float32
 
@@ -172,7 +173,37 @@ def _pair_verdict(
     }
 
 
+# NOTE: jitted calls ASYNC-dispatch — the returned dict holds device
+# values that materialize only when the caller converts them (the engine's
+# launch/collect split in analyzer._launch_chunks rides exactly this).
 score_pairs = jax.jit(jax.vmap(_pair_verdict))
+
+
+def pair_arg_spec(B: int, T: int):
+    """Zeroed argument tuple matching score_pairs' PRODUCTION signature.
+
+    Mirrors analyzer._launch_pairs' packing (shapes and dtypes) so
+    engine.pipeline.prewarm can compile the (rung, T) grid without
+    synthesizing windows; the zero-recompile regression test
+    (tests/test_pipeline.py) pins this spec to the real packing — drift
+    fails CI, it cannot silently de-warm the cache.
+    """
+    import numpy as np
+
+    return (
+        np.zeros((B, T), np.float32), np.zeros((B, T), bool),
+        np.zeros((B, T), np.float32), np.zeros((B, T), bool),
+        np.zeros(B, np.float32),                    # pairwise p threshold
+        np.zeros(B, np.int32),                      # enabled-test bitmask
+        np.zeros(B, np.int32),                      # ANY/ALL combinator
+        np.full(B, 30, np.int32),                   # ma_window
+        np.zeros(B, np.float32),                    # band threshold
+        np.ones(B, np.int32),                       # bound mode
+        np.zeros(B, np.float32),                    # min lower bound
+        np.tile(np.asarray(
+            [MIN_MANN_WHITNEY, MIN_WILCOXON, MIN_KRUSKAL, MIN_FRIEDMAN],
+            np.int32), (B, 1)),
+    )
 
 
 def make_fleet_scorer(mesh, k: int = 8):

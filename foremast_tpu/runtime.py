@@ -44,9 +44,19 @@ Env surface (union of the reference services'):
                          raw fetch/archive boundaries — soak runs and the
                          demo turn chaos on without code changes
                          (docs/resilience.md for the grammar)
+  SCORE_PIPELINE         streaming preprocess->dispatch scoring pipeline
+                         (default on; 0 restores the barriered cycle —
+                         engine/pipeline.py, docs/performance.md)
+  COMPILE_CACHE_PATH     persistent XLA compilation cache dir: restarts
+                         skip the first-cycle compile storm
+  PREWARM_ON_START       background-compile the standard (family x rung
+                         x T-bucket) grid at startup (also available as
+                         `foremast-tpu prewarm`)
+  LOG_LEVEL              process-wide logging level (default INFO)
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -59,6 +69,8 @@ from .engine.jobs import JobStore
 from .service.api import ForemastService, make_server
 
 __all__ = ["Runtime"]
+
+log = logging.getLogger("foremast_tpu.runtime")
 
 
 class Runtime:
@@ -79,6 +91,19 @@ class Runtime:
         chaos_spec: str | None = None,
     ):
         self.config = config or from_env()
+        # persistent XLA compile cache (COMPILE_CACHE_PATH): point the
+        # backend at the shared cache dir BEFORE anything jits, so a
+        # restarted pod replays compiled programs instead of re-paying the
+        # first-cycle compile storm (engine/pipeline.py)
+        if self.config.compile_cache_path:
+            from .engine.pipeline import enable_compile_cache
+
+            if enable_compile_cache(self.config.compile_cache_path):
+                log.info("compile cache at %s",
+                         self.config.compile_cache_path)
+            else:
+                log.warning("compile cache unsupported by this jax build; "
+                            "continuing without")
         self.exporter = VerdictExporter()
         source = data_source or PrometheusDataSource()
         # -- chaos layer (FOREMAST_CHAOS): deterministic fault injection
@@ -168,8 +193,8 @@ class Runtime:
             n = self.analyzer.load_lstm_cache(lstm_cache_path)
             self._lstm_saved_version = self.analyzer._lstm_param_version
             if n:
-                print(f"[foremast-tpu] warm-started {n} LSTM model(s) "
-                      f"from {lstm_cache_path}", flush=True)
+                log.info("warm-started %d LSTM model(s) from %s",
+                         n, lstm_cache_path)
         self.service = ForemastService(
             self.store, exporter=self.exporter, query_endpoint=query_endpoint,
             analyzer=self.analyzer, resilience=self.resilience,
@@ -217,7 +242,25 @@ class Runtime:
         )
         t_eng.start()
         self._threads = [t_http, t_eng]
+        if self.config.prewarm_on_start:
+            # background prewarm (PREWARM_ON_START): compile the standard
+            # (family x rung x T-bucket) grid behind live traffic so even
+            # the first real cycle of each shape skips its compile. Daemon
+            # + best-effort: a prewarm failure must never take the
+            # runtime down with it.
+            t_warm = threading.Thread(target=self._prewarm, daemon=True)
+            t_warm.start()
+            self._threads.append(t_warm)
         return self
+
+    def _prewarm(self):
+        from .engine.pipeline import prewarm
+
+        try:
+            info = prewarm(self.config)
+            log.info("prewarm done: %s", info)
+        except Exception as e:  # noqa: BLE001 - warmup is best-effort
+            log.warning("prewarm failed: %s", e)
 
     def _worker_loop(self, cycle_seconds: float, worker: str):
         while not self._stop.is_set():
@@ -233,8 +276,8 @@ class Runtime:
                         skew_margin_seconds=self.adopt_skew_margin_seconds,
                     )
                     if n:
-                        print(f"[foremast-tpu] adopted {n} stale job(s) "
-                              f"from the archive", flush=True)
+                        log.info("adopted %d stale job(s) from the archive",
+                                 n)
                 self.analyzer.run_cycle(worker=worker)
                 if self.wavefront_sink is not None:
                     self.wavefront_sink.flush()
@@ -250,11 +293,10 @@ class Runtime:
                         self._lstm_saved_version = \
                             self.analyzer._lstm_param_version
                     except Exception as e:  # noqa: BLE001
-                        print(f"[foremast-tpu] lstm cache save failed: "
-                              f"{e}", flush=True)
+                        log.warning("lstm cache save failed: %s", e)
                 self.store.gc(max_age_seconds=self.job_retention_seconds)
-            except Exception as e:  # noqa: BLE001 - worker must survive a bad cycle
-                print(f"[foremast-tpu] cycle error: {e}", flush=True)
+            except Exception:  # noqa: BLE001 - worker must survive a bad cycle
+                log.exception("cycle error")
             self._stop.wait(max(0.0, cycle_seconds - (time.time() - t0)))
 
     def request_stop(self):
@@ -298,8 +340,7 @@ def _tolerant(raw: str, cast, default, label: str):
     try:
         return cast(raw) if raw else default
     except ValueError:
-        print(f"[foremast-tpu] ignoring invalid {label}={raw!r}; "
-              f"using {default}", flush=True)
+        log.warning("ignoring invalid %s=%r; using %s", label, raw, default)
         return default
 
 
@@ -316,17 +357,27 @@ def _env_int(name: str, default: int) -> int:
 
 
 def main():
+    # one logging config for the whole process (worker loop, operator
+    # modules, this banner); no-op when the embedding app configured
+    # handlers already. LOG_LEVEL parses tolerantly like every other env
+    # knob here — a typo'd level must not crashloop the pod.
+    name = os.environ.get("LOG_LEVEL", "INFO").strip().upper()
+    level = getattr(logging, name, None)
+    logging.basicConfig(
+        level=level if isinstance(level, int) else logging.INFO,
+        format="%(asctime)s [%(name)s] %(levelname)s %(message)s",
+    )
+
     from .parallel.distributed import host_info, initialize
 
     # multi-host (DCN) deploys join the jax.distributed world here; plain
     # single-host deploys fall straight through
     if initialize():
         hi = host_info()
-        print(
-            f"[foremast-tpu] multi-host: process {hi.process_id}/"
-            f"{hi.num_processes}, {hi.local_devices} local / "
-            f"{hi.global_devices} global devices",
-            flush=True,
+        log.info(
+            "multi-host: process %d/%d, %d local / %d global devices",
+            hi.process_id, hi.num_processes, hi.local_devices,
+            hi.global_devices,
         )
     archive = None
     es = os.environ.get("ES_ENDPOINT", "")
@@ -369,11 +420,9 @@ def main():
     # K8s terminates pods with SIGTERM: exit the wait loop and run the
     # full stop() path (final snapshot flush) instead of dying mid-write
     signal.signal(signal.SIGTERM, lambda *_: rt.request_stop())
-    print(
-        f"[foremast-tpu] serving :{port}"
-        + (f" grpc :{grpc_port}" if grpc_port else "")
-        + f", cycle={cycle}s",
-        flush=True,
+    log.info(
+        "serving :%d%s, cycle=%ss",
+        port, f" grpc :{grpc_port}" if grpc_port else "", cycle,
     )
     rt.run_forever(
         port=port, cycle_seconds=cycle, grpc_port=grpc_port,

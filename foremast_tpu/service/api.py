@@ -480,6 +480,12 @@ class ForemastService:
             "jobs": self.store.status_counts(),
             "chaos_active": self.chaos_active,
         }
+        if self.analyzer is not None and getattr(
+                self.analyzer, "last_cycle_stages", None):
+            # the last cycle's stage/family timing decomposition (the
+            # pipeline's preprocess/dispatch/collect/fold split) — same
+            # numbers as the foremastbrain:cycle_stage_seconds gauges
+            out["cycle"] = self.analyzer.last_cycle_stages
         if self.resilience is not None:
             snap = self.resilience.snapshot()
             out["resilience"] = snap

@@ -100,6 +100,21 @@ class Tracer:
                 st[1] += dur
                 st[2] = max(st[2], dur)
 
+    def add_timing(self, name: str, seconds: float, count: int = 1):
+        """Fold an externally-measured duration into the per-name aggregate
+        stats (and the foremast_trace_* gauges) without opening a span.
+
+        The pipelined engine cycle interleaves its stages — preprocess
+        waits, dispatch packing, collect materialization — so a stage's
+        time is accumulated piecewise across the whole cycle and cannot
+        nest as one context manager. This records the already-summed
+        number; traces (the span tree) are untouched."""
+        with self._lock:
+            st = self._stats.setdefault(name, [0, 0.0, 0.0])
+            st[0] += count
+            st[1] += seconds
+            st[2] = max(st[2], seconds)
+
     def _finish_root(self, s: _Span):
         with self._lock:
             self._traces.append(s.to_dict())

@@ -1,0 +1,502 @@
+"""Pipelined scoring cycle (engine/pipeline.py, ISSUE 2).
+
+Covers the three tentpole contracts — byte-identical verdicts vs. the
+barriered path, streamed rung-granular dispatch, `_isolate` blast radius
+through the launch/collect split — plus the compile-count regression
+gates (zero steady-state recompiles; persistent-cache restarts) and the
+batch-rung edge cases.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from foremast_tpu.dataplane import FixtureDataSource, VerdictExporter
+from foremast_tpu.engine import (
+    Analyzer,
+    Document,
+    EngineConfig,
+    JobStore,
+    MetricQueries,
+)
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.pipeline import CompileCounter, CyclePipeline, prewarm
+from foremast_tpu.ops.windowing import Window
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+STEP = 60
+
+
+def _series(rng, level, n, spread=None, step=STEP):
+    spread = level * 0.1 + 0.01 if spread is None else spread
+    ts = np.arange(n) * step
+    return ts.tolist(), np.clip(rng.normal(level, spread, n), 0, None).tolist()
+
+
+def _mixed_fleet(n_pair=12, n_band=6, n_bi=4, n_lstm=2, n_hpa=3, seed=11):
+    """A deterministic mixed-family fixture fleet: (store, fixtures).
+
+    Some pair canaries are bad so the fold exercises the unhealthy path;
+    band/bi/lstm/hpa jobs are healthy continuous-ish jobs with history.
+    """
+    rng = np.random.default_rng(seed)
+    fixtures = {}
+    store = JobStore()
+
+    def mk(job_id, metrics, strategy="canary"):
+        doc = Document(
+            id=job_id, app_name=f"app-{job_id}", namespace="px",
+            strategy=strategy, start_time=to_rfc3339(0.0),
+            end_time=to_rfc3339(5_000_000.0), metrics=metrics,
+        )
+        store.create(doc)
+
+    for i in range(n_pair):
+        bad = i % 5 == 3
+        cur, base = f"u/p{i}/c", f"u/p{i}/b"
+        fixtures[cur] = _series(rng, 5.0 if bad else 0.5, 30)
+        fixtures[base] = _series(rng, 0.5, 30)
+        mk(f"pair{i}", {"error5xx": MetricQueries(current=cur, baseline=base)})
+    for i in range(n_band):
+        cur, hist = f"u/bd{i}/c", f"u/bd{i}/h"
+        fixtures[cur] = _series(rng, 10.0, 25)
+        fixtures[hist] = _series(rng, 10.0, 300)
+        mk(f"band{i}", {"latency": MetricQueries(current=cur, historical=hist)})
+    for i in range(n_bi):
+        ms = {}
+        for m in ("latency", "cpu"):
+            cur, hist = f"u/bi{i}/{m}/c", f"u/bi{i}/{m}/h"
+            fixtures[cur] = _series(rng, 10.0, 25)
+            fixtures[hist] = _series(rng, 10.0, 300)
+            ms[m] = MetricQueries(current=cur, historical=hist)
+        mk(f"bi{i}", ms)
+    for i in range(n_lstm):
+        ms = {}
+        for m in ("latency", "cpu", "tps"):
+            cur, hist = f"u/ml{i}/{m}/c", f"u/ml{i}/{m}/h"
+            fixtures[cur] = _series(rng, 10.0, 25)
+            fixtures[hist] = _series(rng, 10.0, 300)
+            ms[m] = MetricQueries(current=cur, historical=hist)
+        mk(f"lstm{i}", ms)
+    for i in range(n_hpa):
+        tps_c, tps_h = f"u/h{i}/tps/c", f"u/h{i}/tps/h"
+        lat_c, lat_h = f"u/h{i}/lat/c", f"u/h{i}/lat/h"
+        fixtures[tps_c] = _series(rng, 100.0, 25)
+        fixtures[tps_h] = _series(rng, 100.0, 300)
+        fixtures[lat_c] = _series(rng, 5.0, 25)
+        fixtures[lat_h] = _series(rng, 5.0, 300)
+        tps = MetricQueries(current=tps_c, historical=tps_h)
+        lat = MetricQueries(current=lat_c, historical=lat_h)
+        lat.priority, lat.is_increase = 1, True
+        mk(f"hpa{i}", {"tps": tps, "latency": lat}, strategy="hpa")
+    return store, fixtures
+
+
+def _snapshot(store: JobStore) -> str:
+    """Canonical byte view of every job's verdict-bearing state."""
+    docs = {}
+    for doc in store._jobs.values():
+        docs[doc.id] = {
+            "status": doc.status,
+            "reason": doc.reason,
+            "anomaly": doc.anomaly,
+        }
+    logs = [
+        {"job": h.job_id, "score": h.hpascore, "reason": h.reason,
+         "details": h.details}
+        for h in store._hpalogs
+    ]
+    return json.dumps({"docs": docs, "hpalogs": logs}, sort_keys=True)
+
+
+def _run_fleet(score_pipeline: bool, cycles: int = 2, fleet_kw=None,
+               **cfg_kw):
+    store, fixtures = _mixed_fleet(**(fleet_kw or {}))
+    cfg = EngineConfig(pairwise_threshold=1e-4, lstm_epochs=2,
+                       score_pipeline=score_pipeline, **cfg_kw)
+    eng = Analyzer(cfg, FixtureDataSource(fixtures), store, VerdictExporter())
+    outs = [eng.run_cycle(now=1000.0 + 10 * c) for c in range(cycles)]
+    return outs, _snapshot(store), eng
+
+
+# ------------------------------------------------------------ determinism
+def test_pipeline_verdicts_byte_identical_to_barriered():
+    """The acceptance gate: pipeline on vs. off over an identical mixed
+    fixture fleet produces byte-identical verdict state (statuses,
+    reasons, anomaly payloads, hpalogs) and identical outcome dicts —
+    fold order is claim order regardless of device completion order."""
+    outs_p, snap_p, _ = _run_fleet(True)
+    outs_s, snap_s, _ = _run_fleet(False)
+    assert outs_p == outs_s
+    assert snap_p == snap_s
+
+
+def test_pipeline_chunk_boundaries_match_barriered_rungs():
+    """A tiny score_batch forces mid-stream launches; results must still
+    match the barriered path exactly (the accumulator fires at the same
+    chunk boundaries _score_chunks would cut)."""
+    outs_p, snap_p, eng = _run_fleet(True, cycles=1, score_batch=4)
+    outs_s, snap_s, _ = _run_fleet(False, cycles=1, score_batch=4)
+    assert outs_p == outs_s
+    assert snap_p == snap_s
+
+
+def test_pipeline_early_fire_rung_keeps_verdicts_identical():
+    """PIPELINE_FIRE_ROWS below the chunk cap launches mid-stream at
+    DIFFERENT boundaries than the barriered chunker — scorers are
+    row-wise, so verdicts must still be byte-identical."""
+    fleet = dict(n_pair=40, n_band=20, n_bi=6, n_lstm=0, n_hpa=18)
+    outs_p, snap_p, _ = _run_fleet(True, cycles=1, fleet_kw=fleet,
+                                   pipeline_fire_rows=16)
+    outs_s, snap_s, _ = _run_fleet(False, cycles=1, fleet_kw=fleet)
+    assert outs_p == outs_s
+    assert snap_p == snap_s
+
+
+# ------------------------------------------------------------- streaming
+def test_streaming_accumulator_fires_full_rungs_early():
+    """Buckets launch the moment they fill the chunk cap; partials flush
+    at finish. 40 one-bucket pair items with cap 16 -> 2 early launches
+    + 1 flush, every result present."""
+    from foremast_tpu.engine.analyzer import _PairItem
+
+    rng = np.random.default_rng(0)
+    cfg = EngineConfig(score_batch=16)
+    eng = Analyzer(cfg, FixtureDataSource({}), JobStore())
+
+    def item(i):
+        vals = rng.normal(5.0, 0.5, 30).astype(np.float32)
+        w = Window(vals, np.ones(30, bool), 0)
+        w2 = Window(vals.copy(), np.ones(30, bool), 0)
+        return _PairItem(f"j{i}", "m", w, w2, cfg.policy_for("m"))
+
+    pipe = CyclePipeline(eng)
+    for i in range(40):
+        pipe.feed([item(i)], [], [], [], [])
+        # two full rungs fire during the stream, not at the end
+        assert pipe.launches == (i + 1) // 16
+    pair_res, *_rest = pipe.finish()
+    assert pipe.launches == 3
+    assert len(pair_res) == 40
+    sync = eng._score_pairs([item(i) for i in range(40)])
+    assert pair_res.keys() == sync.keys()
+
+
+def test_pipeline_collect_failure_retries_per_job():
+    """A collect-time failure (deferred device error) falls back to the
+    per-job synchronous path: results complete, nothing reported bad."""
+    store, fixtures = _mixed_fleet(n_pair=6, n_band=0, n_bi=0, n_lstm=0,
+                                   n_hpa=0)
+    cfg = EngineConfig(pairwise_threshold=1e-4)
+    eng = Analyzer(cfg, FixtureDataSource(fixtures), store)
+    orig = eng._collect_pairs
+    calls = {"n": 0}
+
+    def flaky(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("deferred device error")
+        return orig(state)
+
+    eng._collect_pairs = flaky
+    out = eng.run_cycle(now=1000.0)
+    assert calls["n"] > 1  # the retry actually re-collected
+    assert set(out) == {f"pair{i}" for i in range(6)}
+    # blast radius: no job ended ABORT/INITIAL-on-error
+    assert all(s in (J.INITIAL, J.COMPLETED_UNHEALTH) for s in out.values())
+
+
+def test_pipeline_poisoned_family_reports_only_bad_jobs():
+    """A launch that fails even per job reports {job_id: error} for the
+    offenders only; other families' jobs still fold normally."""
+    store, fixtures = _mixed_fleet(n_pair=4, n_band=2, n_bi=0, n_lstm=0,
+                                   n_hpa=0)
+    eng = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+
+    def boom(*a, **kw):
+        raise RuntimeError("poisoned launch")
+
+    eng._launch_pairs = boom  # sync fallback hits it too -> per-job errors
+    out = eng.run_cycle(now=1000.0)
+    # canary pair jobs die terminally on scoring failure...
+    assert all(out[f"pair{i}"] == J.ABORT for i in range(4))
+    assert all("poisoned launch" in store.get(f"pair{i}").reason
+               for i in range(4))
+    # ...band jobs are untouched by the pair family's blast
+    assert all(out[f"band{i}"] == J.INITIAL for i in range(2))
+
+
+# ------------------------------------------------------- batch-rung edges
+def test_bucket_rows_exact_rung_boundary_and_tiny_cap():
+    eng = Analyzer(EngineConfig(score_batch=8192), FixtureDataSource({}),
+                   JobStore())
+    assert eng._bucket_rows(64) == 64      # exactly on a rung: no pad
+    assert eng._bucket_rows(65) == 256     # next rung up
+    # score_batch below the smallest rung clamps to 16, not below
+    tiny = Analyzer(EngineConfig(score_batch=8), FixtureDataSource({}),
+                    JobStore())
+    assert tiny._bucket_rows(1) == 16
+    assert tiny._bucket_rows(100) == 16    # cap wins over the ladder
+
+
+def test_score_chunks_rung_boundary_no_padding():
+    """n exactly on a rung boundary launches unpadded."""
+    eng = Analyzer(EngineConfig(score_batch=8192), FixtureDataSource({}),
+                   JobStore())
+    calls = []
+
+    def fn(vals):
+        calls.append(vals.shape[0])
+        return {"s": vals.sum(axis=1)}
+
+    vals = np.ones((64, 4), np.float32)
+    out = eng._score_chunks(fn, [vals])
+    assert calls == [64]
+    assert out["s"].shape == (64,)
+
+
+def test_score_chunks_big_fleet_tail_pads_to_own_rung():
+    """The tail of a big fleet re-buckets DOWN the ladder (6 -> 16), it
+    must not pad to the full chunk."""
+    eng = Analyzer(EngineConfig(score_batch=64), FixtureDataSource({}),
+                   JobStore())
+    calls = []
+
+    def fn(vals):
+        calls.append(vals.shape[0])
+        return {"s": vals.sum(axis=1)}
+
+    vals = np.arange(70, dtype=np.float32)[:, None] * np.ones(4, np.float32)
+    out = eng._score_chunks(fn, [vals])
+    assert calls == [64, 16]
+    np.testing.assert_allclose(out["s"], vals.sum(axis=1))
+
+
+# --------------------------------------------------- hpa step regression
+def test_hpa_bucket_preserves_series_step(monkeypatch):
+    """A 30 s-step HPA job must keep its step through the pack path —
+    the old build() dropped it back to the 60 s DEFAULT_STEP."""
+    from foremast_tpu.engine import analyzer as A
+
+    captured = []
+    orig = A.pack_windows
+
+    def spy(windows, pad_to=None):
+        captured.append(list(windows))
+        return orig(windows, pad_to=pad_to)
+
+    monkeypatch.setattr(A, "pack_windows", spy)
+    rng = np.random.default_rng(0)
+
+    def win(n, start, step):
+        return Window(rng.normal(100.0, 3.0, n).astype(np.float32),
+                      np.ones(n, bool), start, step)
+
+    eng = Analyzer(EngineConfig(), FixtureDataSource({}), JobStore())
+    items = [
+        A._HpaItem("j30", "tps", win(90, 0, 30), win(30, 90 * 30, 30),
+                   True, 0),
+        A._HpaItem("j30", "latency", win(90, 0, 30), win(30, 90 * 30, 30),
+                   True, 1),
+    ]
+    out = eng._score_hpa(items)
+    assert "j30" in out and out["j30"]["raw_score"] >= 0.0
+    steps = {w.step for group in captured for w in group}
+    assert steps == {30}
+
+
+class _WindowSource:
+    """Byte-level-style source: serves prebuilt grid Windows directly
+    (the fetch_window fast path), so non-default steps survive fetch."""
+
+    def __init__(self, windows):
+        self.windows = windows
+
+    def fetch_window(self, url):
+        return self.windows[url]
+
+    def fetch(self, url):  # pragma: no cover - fetch_window always hits
+        raise AssertionError("fetch_window path expected")
+
+
+def test_hpa_e2e_30s_step_job_scores():
+    """Full cycle over a 30 s-grid HPA job (fetch_window source): scores,
+    emits an hpalog, requeues — no snap back to the 60 s default."""
+    rng = np.random.default_rng(4)
+
+    def win(level, n, start):
+        return Window(rng.normal(level, level * 0.03, n).astype(np.float32),
+                      np.ones(n, bool), start, 30)
+
+    windows = {
+        "u/t/c": win(100.0, 30, 9000), "u/t/h": win(100.0, 300, 0),
+        "u/l/c": win(5.0, 30, 9000), "u/l/h": win(5.0, 300, 0),
+    }
+    store = JobStore()
+    tps = MetricQueries(current="u/t/c", historical="u/t/h")
+    lat = MetricQueries(current="u/l/c", historical="u/l/h")
+    lat.priority, lat.is_increase = 1, True
+    store.create(Document(
+        id="h30", app_name="a", namespace="n", strategy="hpa",
+        start_time=to_rfc3339(0.0), end_time=to_rfc3339(5_000_000.0),
+        metrics={"tps": tps, "latency": lat},
+    ))
+    eng = Analyzer(EngineConfig(), _WindowSource(windows), store)
+    out = eng.run_cycle(now=10_000.0)
+    assert out["h30"] == J.INITIAL  # scored + requeued (continuous)
+    assert store._hpalogs and store._hpalogs[-1].job_id == "h30"
+
+
+# --------------------------------------------------- stage observability
+def test_cycle_stage_gauges_and_status_surface():
+    exporter = VerdictExporter()
+    store, fixtures = _mixed_fleet(n_pair=4, n_band=2, n_bi=0, n_lstm=0,
+                                   n_hpa=1)
+    eng = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store,
+                   exporter)
+    eng.run_cycle(now=1000.0)
+    text = exporter.render()
+    for stage in ("preprocess", "dispatch", "collect", "fold"):
+        assert f'foremastbrain:cycle_stage_seconds{{stage="{stage}"}}' in text
+    assert 'foremastbrain:cycle_family_score_seconds{family="pair"}' in text
+    # /status mirrors the same decomposition
+    from foremast_tpu.service.api import ForemastService
+
+    svc = ForemastService(store, exporter=exporter, analyzer=eng)
+    status, payload = svc.status_summary()
+    assert status == 200
+    cyc = payload["cycle"]
+    assert cyc["pipelined"] is True
+    assert set(cyc["stage_seconds"]) == {"preprocess", "dispatch",
+                                         "collect", "fold"}
+    assert cyc["family_score_seconds"]["pair"] > 0
+
+
+# -------------------------------------------------- compile-count gates
+@pytest.mark.perf
+def test_steady_state_cycles_trigger_zero_recompiles():
+    """The regression gate for the rung/bucket design + pipeline: after
+    warmup, mixed cycles launch ONLY already-compiled programs."""
+    store, fixtures = _mixed_fleet()
+    cfg = EngineConfig(pairwise_threshold=1e-4, lstm_epochs=2)
+    eng = Analyzer(cfg, FixtureDataSource(fixtures), store)
+    warm = 0
+    eng.run_cycle(now=1000.0)
+    while eng._lstm_trained_this_cycle > 0 and warm < 6:
+        eng.run_cycle(now=1000.0)
+        warm += 1
+    eng.run_cycle(now=1000.0)  # one settle cycle past the last training
+    with CompileCounter() as cc:
+        eng.run_cycle(now=1000.0)
+        eng.run_cycle(now=1000.0)
+    assert cc.compiles == 0, (
+        f"steady-state mixed cycles compiled {cc.compiles} fresh XLA "
+        "program(s); a shape is leaking past the rung/bucket ladder"
+    )
+
+
+@pytest.mark.perf
+def test_prewarm_grid_covers_matching_cycle_shapes():
+    """After prewarm of a (rung 16, T 64/512) grid, a cycle whose fleet
+    lands on those shapes compiles nothing new — this also pins
+    fleet.pair_arg_spec to the analyzer's real packing."""
+    cfg = EngineConfig(pairwise_threshold=1e-4)
+    prewarm(cfg, rungs=(16,), t_buckets=(64, 512))
+    rng = np.random.default_rng(3)
+    fixtures = {}
+    store = JobStore()
+    for i in range(5):  # rung 16 after padding; pair T bucket = 64
+        cur, base = f"u/p{i}/c", f"u/p{i}/b"
+        fixtures[cur] = _series(rng, 0.5, 60)
+        fixtures[base] = _series(rng, 0.5, 60)
+        store.create(Document(
+            id=f"p{i}", app_name="a", namespace="n", strategy="canary",
+            start_time=to_rfc3339(0.0), end_time=to_rfc3339(5_000_000.0),
+            metrics={"error5xx": MetricQueries(current=cur, baseline=base)},
+        ))
+    for i in range(3):  # band concat 300+25 -> T bucket 1024, rung 16
+        cur, hist = f"u/b{i}/c", f"u/b{i}/h"
+        fixtures[cur] = _series(rng, 10.0, 25)
+        fixtures[hist] = _series(rng, 10.0, 300)
+        store.create(Document(
+            id=f"b{i}", app_name="a", namespace="n", strategy="canary",
+            start_time=to_rfc3339(0.0), end_time=to_rfc3339(5_000_000.0),
+            metrics={"latency": MetricQueries(current=cur, historical=hist)},
+        ))
+    eng = Analyzer(cfg, FixtureDataSource(fixtures), store)
+    with CompileCounter() as cc:
+        out = eng.run_cycle(now=1000.0)
+    assert len(out) == 8
+    assert cc.compiles == 0, (
+        f"cycle after prewarm compiled {cc.compiles} program(s): the "
+        "prewarm grid (or fleet.pair_arg_spec) drifted from the "
+        "production packing"
+    )
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+def test_compile_cache_restart_skips_compile_storm(tmp_path):
+    """With COMPILE_CACHE_PATH set, a restarted process replays compiled
+    programs from disk: run the same tiny cycle in two fresh interpreters
+    and require the second to compile (almost) nothing fresh."""
+    cache = str(tmp_path / "xla-cache")
+    script = r"""
+import json, os, sys
+import numpy as np
+from foremast_tpu.engine import Analyzer, Document, EngineConfig, JobStore, MetricQueries
+from foremast_tpu.engine.pipeline import CompileCounter, enable_compile_cache
+from foremast_tpu.dataplane import FixtureDataSource
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+assert enable_compile_cache(sys.argv[1])
+rng = np.random.default_rng(0)
+fixtures, store = {}, JobStore()
+for i in range(4):
+    cur, base = f"u/{i}/c", f"u/{i}/b"
+    ts = (np.arange(30) * 60).tolist()
+    fixtures[cur] = (ts, rng.normal(0.5, 0.05, 30).tolist())
+    fixtures[base] = (ts, rng.normal(0.5, 0.05, 30).tolist())
+    store.create(Document(id=f"j{i}", app_name="a", namespace="n",
+                 strategy="canary", start_time=to_rfc3339(0.0),
+                 end_time=to_rfc3339(5_000_000.0),
+                 metrics={"error5xx": MetricQueries(current=cur, baseline=base)}))
+eng = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+with CompileCounter() as cc:
+    eng.run_cycle(now=1000.0)
+print(json.dumps({"cache_misses": cc.cache_misses, "cache_hits": cc.cache_hits}))
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run_once():
+        out = subprocess.run(
+            [sys.executable, "-c", script, cache], env=env,
+            capture_output=True, text=True, timeout=420, check=True,
+        )
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    first = run_once()
+    second = run_once()
+    # cold start: every program is fresh work (persistent-cache misses);
+    # restart: programs replay from disk — misses (the compile storm)
+    # collapse while hits take their place
+    assert first["cache_misses"] > 0 and first["cache_hits"] == 0, first
+    assert second["cache_hits"] > 0, second
+    assert second["cache_misses"] < first["cache_misses"], (first, second)
+
+
+# ------------------------------------------------------------ prewarm CLI
+def test_prewarm_cli_prints_grid_summary(capsys):
+    from foremast_tpu import cli
+
+    rc = cli.main(["prewarm", "--rungs", "16", "--buckets", "32",
+                   "--families", "pair,hpa"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["families"] == ["pair", "hpa"]
+    assert rec["rungs"] == [16]
+    assert rec["programs"] == 2
+    assert rec["seconds"] >= 0
