@@ -173,7 +173,13 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
 
         engine = Analyzer(
             EngineConfig(score_pipeline=_eb(os.environ, "SCORE_PIPELINE",
-                                            True)),
+                                            True),
+                         # this bench replays a STATIC fixture each cycle,
+                         # so SCORE_MEMO=1 would measure fingerprint hits
+                         # instead of scoring — the steady-state figure
+                         # lives in run_steady. Off here by default,
+                         # env-overridable for A/B.
+                         score_memo=_eb(os.environ, "SCORE_MEMO", False)),
             source, store)
 
         with CompileCounter() as cc_warm:
@@ -279,11 +285,175 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
     }
 
 
+def _range_body(t0: int, series, qstart: float, qend: float,
+                step: int = 60) -> bytes:
+    """Serialize the slots of `series` (anchored at t0) that a range query
+    [qstart, qend] would return — a synthetic Prometheus that actually
+    honors its start/end params, so delta queries fetch only the tail."""
+    import math
+
+    k_lo = max(int(math.ceil((qstart - t0) / step)), 0)
+    k_hi = min(int(math.floor((qend - t0) / step)), len(series) - 1)
+    vals = [[t0 + k * step, f"{series[k]:.4f}"] for k in range(k_lo, k_hi + 1)]
+    return json.dumps(
+        {
+            "status": "success",
+            "data": {
+                "resultType": "matrix",
+                "result": [
+                    {"metric": {"__name__": "namespace_app_latency"},
+                     "values": vals}
+                ],
+            },
+        }
+    ).encode()
+
+
+def run_steady(n_jobs: int = 2000, cycles: int = 12, window_steps: int = 128,
+               cadence_s: int = 10, delta: bool = True,
+               memo: bool = True) -> dict:
+    """Steady-state leg: N warm cycles over a range-honoring synthetic
+    backend whose series gain ~1 sample per metric step while the engine
+    cycles at `cadence_s` (the production CYCLE_SECONDS default) — i.e.
+    most cycles see NO new samples, every 6th sees one. A/B the
+    DELTA_FETCH / SCORE_MEMO pair against the full-refetch path on this
+    identical stream (the driver calls this twice)."""
+    import re as _re
+
+    import numpy as np
+
+    from .dataplane.delta import DeltaWindowSource
+    from .dataplane.fetch import RawFixtureDataSource
+    from .engine import jobs as J
+    from .engine.analyzer import Analyzer
+    from .engine.config import EngineConfig
+    from .utils import tracing
+    from .utils.timeutils import to_rfc3339
+
+    step = 60
+    t0 = 1_700_000_000 // step * step
+    horizon = 6 * window_steps + (cycles * cadence_s) // step + 8
+    rng = np.random.default_rng(9)
+    shapes = 10.0 + rng.normal(0.0, 2.0, (64, horizon))
+    clock = {"now": 0.0}
+    rng_re = _re.compile(r"[?&]start=([0-9.]+).*[?&]end=([0-9.]+)")
+
+    def resolver(url: str) -> bytes:
+        i = int(url.rsplit("job=", 1)[1].split("&", 1)[0]) % 64
+        m = rng_re.search(url)
+        qs, qe = float(m.group(1)), float(m.group(2))
+        return _range_body(t0, shapes[i], qs, min(qe, clock["now"]), step)
+
+    def url(i, tag, s, e):
+        return (f"http://prom/q?job={i}&w={tag}"
+                f"&start={s:.0f}&end={e:.0f}&step={step}")
+
+    # half pair (baseline frozen in the past), half band (7x history
+    # frozen): current windows start full and gain one sample per step
+    W = window_steps
+    base_end = t0 + W * step
+    cur_start = base_end
+    far = t0 + (horizon - 1) * step
+    docs = []
+    for i in range(n_jobs):
+        if i % 2 == 0:
+            metrics = {"latency": J.MetricQueries(
+                current=url(i, "cur", cur_start, far),
+                baseline=url(i, "base", t0, base_end),
+            )}
+        else:
+            metrics = {"latency": J.MetricQueries(
+                current=url(i, "cur", t0 + 4 * W * step, far),
+                historical=url(i, "hist", t0, t0 + 4 * W * step),
+            )}
+        docs.append(J.Document(
+            id=f"steady-{i}", app_name=f"app-{i % 128}", namespace="bench",
+            strategy="canary", start_time=to_rfc3339(t0),
+            end_time=to_rfc3339(far + 86_400), metrics=metrics,
+        ))
+
+    from .engine.pipeline import CompileCounter
+
+    inner = RawFixtureDataSource(resolver=resolver)
+    source = DeltaWindowSource(inner) if delta else inner
+    with tempfile.TemporaryDirectory() as tmp:
+        store = J.JobStore(snapshot_path=os.path.join(tmp, "jobs.json"))
+        for d in docs:
+            store.create(d)
+        engine = Analyzer(
+            EngineConfig(score_memo=memo, delta_fetch=delta), source, store)
+        # warm start: every current window already full at bench t=0
+        clock["now"] = float(t0 + (5 * W + 1) * step)
+        with CompileCounter() as cc_warm:
+            engine.run_cycle(now=clock["now"])
+        tracing.tracer.reset()
+        inner.requests.clear()
+        launches0 = engine.device_launches
+        if delta:
+            source.delta_hits = source.full_fetches = 0
+            source.bytes_saved = source.points_saved = 0
+        hits0 = dict(engine.score_memo_hits)
+
+        t_start = time.perf_counter()
+        with CompileCounter() as cc_steady:
+            for _ in range(cycles):
+                clock["now"] += cadence_s
+                engine.run_cycle(now=clock["now"])
+        wall = time.perf_counter() - t_start
+
+    stats = tracing.tracer.stats()
+    out = {
+        "jobs_per_sec": round(n_jobs * cycles / wall, 1),
+        "wall_s": round(wall, 3),
+        "jobs": n_jobs,
+        "cycles": cycles,
+        "cadence_s": cadence_s,
+        "delta_fetch": delta,
+        "score_memo": memo,
+        "fetches_per_cycle": len(inner.requests) / cycles,
+        "device_launches_per_cycle": round(
+            (engine.device_launches - launches0) / cycles, 2),
+        "score_memo_hits_per_cycle": round(sum(
+            engine.score_memo_hits.get(f, 0) - hits0.get(f, 0)
+            for f in engine.score_memo_hits) / cycles, 2),
+        "preprocess_s_per_cycle": round(
+            stats.get("engine.preprocess", {}).get("total_seconds", 0.0)
+            / cycles, 4),
+        "compiles_steady_state": cc_steady.compiles,
+    }
+    if delta:
+        snap = source.snapshot()
+        out["delta_hit_ratio"] = snap["hit_ratio"]
+        out["delta_bytes_saved"] = snap["bytes_saved"]
+        out["delta_points_saved"] = snap["points_saved"]
+        out["delta_fallbacks"] = snap["fallbacks"]
+    return out
+
+
+def run_steady_ab(n_jobs: int = 2000, cycles: int = 12) -> dict:
+    """The A/B the perf gate and docs quote: identical stream, delta+memo
+    on vs. the full-refetch path."""
+    on = run_steady(n_jobs, cycles, delta=True, memo=True)
+    off = run_steady(n_jobs, cycles, delta=False, memo=False)
+    return {
+        "metric": "steady_state_jobs_per_sec",
+        "value": on["jobs_per_sec"],
+        "unit": "jobs/s",
+        "on": on,
+        "off": off,
+        "speedup": round(on["jobs_per_sec"] / max(off["jobs_per_sec"], 1e-9),
+                         3),
+    }
+
+
 def main() -> None:
     from .engine.config import _env_bool
 
     n = int(os.environ.get("BENCH_CYCLE_JOBS", "10000"))
     cycles = int(os.environ.get("BENCH_CYCLE_REPS", "2"))
+    if _env_bool(os.environ, "BENCH_CYCLE_STEADY", False):
+        print(json.dumps(run_steady_ab(n, cycles)))
+        return
     mix = _env_bool(os.environ, "BENCH_CYCLE_MIX", False)
     print(json.dumps(run(n, cycles, mix=mix)))
 

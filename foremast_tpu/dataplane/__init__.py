@@ -1,4 +1,5 @@
 """Data plane: query construction, fetching, verdict export."""
+from .delta import DeltaWindowSource  # noqa: F401
 from .exporter import VerdictExporter  # noqa: F401
 from .fetch import (  # noqa: F401
     CachingDataSource,

@@ -25,12 +25,14 @@ way (tests/test_native.py asserts exact parity).
 """
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import time
 import urllib.request
 from collections import OrderedDict
 from typing import Callable
+from urllib.parse import urljoin, urlsplit
 
 import numpy as np
 
@@ -40,6 +42,133 @@ from ..ops.windowing import MAX_WINDOW_STEPS, Window, align_step, resample_to_gr
 
 class FetchError(Exception):
     pass
+
+
+class HttpConnectionPool:
+    """Bounded per-host keep-alive pool over http.client.
+
+    The engine re-queries the same handful of metric-store hosts every
+    cycle; per-call `urllib.request.urlopen` paid a fresh TCP (and TLS)
+    handshake for every one of those queries. This pool keeps up to
+    `max_per_host` idle connections per (scheme, host, port) and reuses
+    them across cycles. Error semantics match the urlopen path the
+    sources had: any transport or non-2xx failure raises (the sources
+    convert to FetchError), so the resilience layer's breaker/retry
+    accounting above is unchanged. A request that fails on a REUSED
+    connection retries once on a fresh one — keep-alive servers close
+    idle connections at will, and these are idempotent GETs.
+
+    Non-http(s) schemes fall back to urlopen (file:// fixtures etc.).
+    """
+
+    _MAX_REDIRECTS = 4  # urlopen followed redirects; keep that behavior
+
+    def __init__(self, max_per_host: int = 8):
+        self.max_per_host = max_per_host
+        self._idle: dict[tuple, list] = {}
+        self._lock = threading.Lock()
+        self.connections_opened = 0  # observability: new TCP handshakes
+        self.requests_served = 0
+        # env proxies (http_proxy/https_proxy/no_proxy): urlopen honored
+        # them via ProxyHandler; proxied hosts keep that path instead of
+        # a doomed direct connect
+        self._proxies = urllib.request.getproxies()
+
+    def _checkout(self, key, fresh: bool = False):
+        if not fresh:
+            with self._lock:
+                conns = self._idle.get(key)
+                if conns:
+                    return conns.pop(), True
+        scheme, host, port = key
+        cls = (http.client.HTTPSConnection if scheme == "https"
+               else http.client.HTTPConnection)
+        with self._lock:
+            self.connections_opened += 1
+        return cls(host, port), False
+
+    def _checkin(self, key, conn):
+        with self._lock:
+            conns = self._idle.setdefault(key, [])
+            if len(conns) < self.max_per_host:
+                conns.append(conn)
+                return
+        conn.close()
+
+    def request(self, url: str, timeout: float = 10.0,
+                headers: dict | None = None) -> bytes:
+        parts = urlsplit(url)
+        if parts.scheme not in ("http", "https") or self._proxied(parts):
+            req = urllib.request.Request(url, headers=headers or {})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.read()
+        for _ in range(self._MAX_REDIRECTS + 1):
+            out = self._one(parts, url, timeout, headers)
+            if isinstance(out, bytes):
+                self.requests_served += 1
+                return out
+            url = out  # redirect target
+            parts = urlsplit(url)
+            if parts.scheme not in ("http", "https"):
+                with urllib.request.urlopen(url, timeout=timeout) as r:
+                    return r.read()
+        raise OSError(f"too many redirects for {url}")
+
+    def _one(self, parts, url: str, timeout, headers):
+        key = (parts.scheme, parts.hostname or "",
+               parts.port or (443 if parts.scheme == "https" else 80))
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        last_exc = None
+        for attempt in (0, 1):
+            # the retry attempt forces a FRESH connection: after a server
+            # roll the idle pool may hold several dead sockets, and popping
+            # another one would report a healthy backend as failed
+            conn, reused = self._checkout(key, fresh=attempt > 0)
+            conn.timeout = timeout
+            if conn.sock is not None:
+                # http.client applies self.timeout only inside connect();
+                # a reused connection's live socket must be re-armed or it
+                # keeps whichever timeout its opener used
+                conn.sock.settimeout(timeout)
+            try:
+                conn.request("GET", path, headers=headers or {})
+                resp = conn.getresponse()
+                body = resp.read()  # drain fully or the conn can't be reused
+            except Exception as e:  # noqa: BLE001 - transport boundary
+                conn.close()
+                last_exc = e
+                if reused:
+                    continue  # stale keep-alive connection: one fresh retry
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(key, conn)
+            if resp.status in (301, 302, 303, 307, 308):
+                loc = resp.getheader("Location")
+                if loc:
+                    return urljoin(url, loc)
+            if not 200 <= resp.status < 300:
+                raise OSError(f"HTTP {resp.status} for {url}: "
+                              f"{body[:200]!r}")
+            return body
+        raise last_exc
+
+    def _proxied(self, parts) -> bool:
+        if parts.scheme not in self._proxies:
+            return False
+        try:
+            return not urllib.request.proxy_bypass(parts.netloc)
+        except Exception:  # noqa: BLE001 - platform bypass lookups can fail
+            return True
+
+
+# process-wide default pool, shared by every HTTP-backed source (they all
+# target the same few metric-store hosts); tests monkeypatch
+# `HTTP_POOL.request` where they used to monkeypatch urlopen
+HTTP_POOL = HttpConnectionPool()
 
 
 # Span-endpoint cap for hostile timestamps, shared by grid_from_series and
@@ -133,18 +262,25 @@ def parse_prometheus_body(raw: bytes):
 
 
 class PrometheusDataSource:
-    def __init__(self, timeout: float = 10.0):
+    def __init__(self, timeout: float = 10.0, pool: HttpConnectionPool | None = None):
         self.timeout = timeout
+        self.pool = pool or HTTP_POOL  # keep-alive: reuse conns across cycles
 
     def _raw(self, url: str) -> bytes:
         try:
-            with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                return r.read()
+            return self.pool.request(url, timeout=self.timeout)
         except Exception as e:  # noqa: BLE001 - network boundary
             raise FetchError(f"prometheus fetch failed: {e}") from e
 
     def fetch(self, url: str):
         return parse_prometheus_body(self._raw(url))
+
+    def fetch_series(self, url: str):
+        """(ts, vals, nbytes) — the delta layer's seam: parsed samples plus
+        the response size for bytes-saved accounting."""
+        raw = self._raw(url)
+        ts, vals = parse_prometheus_body(raw)
+        return ts, vals, len(raw)
 
     def fetch_window(self, url: str) -> Window:
         """Engine fast path: body bytes -> grid Window (fused native parse
@@ -167,22 +303,27 @@ def parse_wavefront_body(raw: bytes):
 
 
 class WavefrontDataSource:
-    def __init__(self, token: str = "", timeout: float = 10.0):
+    def __init__(self, token: str = "", timeout: float = 10.0,
+                 pool: HttpConnectionPool | None = None):
         self.token = token
         self.timeout = timeout
+        self.pool = pool or HTTP_POOL
 
     def _raw(self, url: str) -> bytes:
-        req = urllib.request.Request(url)
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        headers = {"Authorization": f"Bearer {self.token}"} if self.token else {}
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                return r.read()
+            return self.pool.request(url, timeout=self.timeout,
+                                     headers=headers)
         except Exception as e:  # noqa: BLE001
             raise FetchError(f"wavefront fetch failed: {e}") from e
 
     def fetch(self, url: str):
         return parse_wavefront_body(self._raw(url))
+
+    def fetch_series(self, url: str):
+        raw = self._raw(url)
+        ts, vals = parse_wavefront_body(raw)
+        return ts, vals, len(raw)
 
     def fetch_window(self, url: str, step: int = 60,
                      max_steps: int = MAX_WINDOW_STEPS) -> Window:
@@ -223,6 +364,11 @@ class RawFixtureDataSource:
 
     def fetch(self, url: str):
         return parse_prometheus_body(self._raw(url))
+
+    def fetch_series(self, url: str):
+        raw = self._raw(url)
+        ts, vals = parse_prometheus_body(raw)
+        return ts, vals, len(raw)
 
     def fetch_window(self, url: str) -> Window:
         return window_from_prometheus_body(self._raw(url))

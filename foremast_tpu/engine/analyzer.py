@@ -19,7 +19,9 @@ and forecast-band checks fused (parallel.fleet), HPA scores batched
 """
 from __future__ import annotations
 
+import hashlib
 import time
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -107,6 +109,32 @@ class _HpaItem:
     # Split into (pods_now, pods_hist) at score time against the job's own
     # current-window boundary (_pod_count_stats).
     pod_window: object = None
+
+
+def _fp(*parts) -> bytes:
+    """Order-sensitive fingerprint of scorer inputs (SCORE_MEMO).
+
+    Windows hash their full identity (start, step, length, values, mask);
+    ndarrays their bytes; everything else its repr. blake2b-128 — the memo
+    only ever compares fingerprints of the SAME key, so 128 bits is far
+    past accidental-collision territory, and hashing is ~100x cheaper than
+    the device launch it elides."""
+    h = hashlib.blake2b(digest_size=16)
+    for p in parts:
+        if p is None:
+            h.update(b"\xffN")
+        elif isinstance(p, Window):
+            h.update(np.float64(
+                (p.start, p.step, p.values.shape[0])).tobytes())
+            h.update(p.values.tobytes())
+            h.update(p.mask.tobytes())
+        elif isinstance(p, np.ndarray):
+            h.update(np.int64(p.shape).tobytes())
+            h.update(p.tobytes())
+        else:
+            h.update(repr(p).encode())
+        h.update(b"|")
+    return h.digest()
 
 
 def _concat_trimmed(hist: Window, cur: Window):
@@ -232,6 +260,64 @@ class Analyzer:
         # last cycle's stage/family timing decomposition (served on
         # /status; gauges on /metrics) — empty until the first cycle
         self.last_cycle_stages: dict = {}
+        # -- fingerprint score memoization (SCORE_MEMO) --
+        # (family, result_key) -> (fingerprint, result dict). Survives
+        # across cycles on the analyzer; the per-cycle CyclePipeline
+        # consults it so unchanged rows skip their device launch entirely.
+        # LRU-bounded at 4x WINDOW_CACHE_MAX (~one entry per job window).
+        self._score_memo: OrderedDict = OrderedDict()
+        self.score_memo_hits: dict[str, int] = {}    # family -> cumulative
+        self.score_memo_misses: dict[str, int] = {}
+        # lstm memo tables: deterministic-training reuse (train-window
+        # fingerprint -> trained entry; PRNGKey(0) + identical data =>
+        # identical params, so reuse == retrain) and verdict reuse
+        # ((job, metrics) -> (score-input fingerprint, z))
+        self._lstm_train_memo: OrderedDict = OrderedDict()
+        self._lstm_z_memo: OrderedDict = OrderedDict()
+        self.lstm_train_memo_hits = 0
+        self.lstm_rescore_skips = 0
+        # total device-program launches (chunk launches across every
+        # family, lstm scoring, training) — the steady-state no-change
+        # gate asserts this stays flat over a memo-hit cycle
+        self.device_launches = 0
+
+    def _memo_put(self, table: OrderedDict, key, val):
+        """Insert-and-bound for the memo tables (LRU, shared ceiling)."""
+        table[key] = val
+        table.move_to_end(key)
+        bound = max(4 * self.config.window_cache_max, 64)
+        while len(table) > bound:
+            table.popitem(last=False)
+
+    def _memo_key_fp(self, family: str, entry, T: int):
+        """(result_key, fingerprint) for one routed accumulator entry.
+
+        The fingerprint covers everything the family's launch+collect
+        reads from the entry: every window's full identity, the policy,
+        and the T bucket (the band kernel gate is a function of T).
+        Config is deliberately absent — it is frozen for the analyzer's
+        lifetime, and the memo dies with the analyzer."""
+        if family == "pair":
+            it = entry
+            return ((it.job_id, it.metric, "pair"),
+                    _fp(b"pair", T, it.metric, it.baseline, it.current,
+                        it.policy))
+        if family == "band":
+            it = entry
+            return ((it.job_id, it.metric, "band"),
+                    _fp(b"band", T, it.metric, it.historical, it.current,
+                        it.policy))
+        if family == "bivariate":
+            it = entry[0]  # (item, joint-grid prep)
+            return ((it.job_id, "&".join(it.metrics), "bivariate"),
+                    _fp(b"bi", T, it.metrics, *it.hist, *it.cur,
+                        *it.policies))
+        job_id, t, s = entry  # hpa row
+        return (job_id,
+                _fp(b"hpa", T, t.metric, t.historical, t.current,
+                    t.is_increase, t.priority, t.is_absolute, t.pod_window,
+                    s.metric, s.historical, s.current, s.is_increase,
+                    s.priority, s.is_absolute))
 
     # ------------------------------------------------------------------ fetch
     def _fetch_window(self, url: str, now: float) -> Window | None:
@@ -392,6 +478,7 @@ class Analyzer:
             if n < target:
                 sl = [np.pad(a, ((0, target - n),) + ((0, 0),) * (a.ndim - 1),
                              mode="edge") for a in sl]
+            self.device_launches += 1
             launches.append((fn(*sl), n))
         return launches
 
@@ -816,9 +903,13 @@ class Analyzer:
         error against the healthy-error distribution."""
         cfg = self.config
         results = {}
+        memo_on = cfg.score_memo
+        memo_zs: list = []   # (item, z) reused without a device launch
+        zfp_by_job: dict = {}  # (job_id, metrics) -> score-input fp
         # (item, params, err_mu, err_sd, version, cwin, cmask)
         scoreable: list = []
-        # (item, cache_key, hwin, hmask, cwin, cmask) — budgeted misses
+        # (item, cache_key, hwin, hmask, cwin, cmask, train_fp) — budgeted
+        # misses
         pending: list = []
         pending_keys: set = set()
         # same-cycle duplicates of a pending cache_key (N jobs of one app
@@ -867,6 +958,19 @@ class Analyzer:
 
             cache_key = (it.cache_key, tuple(it.metrics), W)
             entry = self._lstm_cache.pop(cache_key, None)
+            train_fp = _fp(b"lstm-train", hwin, hmask, cfg.lstm_epochs,
+                           cfg.lstm_hidden, cfg.lstm_latent) if memo_on \
+                else None
+            if entry is None and memo_on:
+                # train-window fingerprint memo: training is deterministic
+                # (PRNGKey(0), fixed epochs), so identical train windows
+                # reproduce identical params — reuse the previous entry
+                # instead of re-paying the train (the restart/eviction/
+                # key-churn case BENCH_r05 measured at 25.8 s of warmup)
+                entry = self._lstm_train_memo.get(train_fp)
+                if entry is not None:
+                    self._lstm_train_memo.move_to_end(train_fp)
+                    self.lstm_train_memo_hits += 1
             if entry is None:
                 if cache_key in pending_keys:
                     # a leader is already training this key this cycle:
@@ -888,13 +992,28 @@ class Analyzer:
                 self._lstm_trained_this_cycle += 1
                 # defer: same-shape misses train together in one vmapped
                 # loop (lstm_ae.train_fleet) after the collection pass
-                pending.append((it, cache_key, hwin, hmask, cwin, cmask))
+                pending.append((it, cache_key, hwin, hmask, cwin, cmask,
+                                train_fp))
                 pending_keys.add(cache_key)
                 continue
             self._lstm_cache[cache_key] = entry  # re-insert = mark recent
             while len(self._lstm_cache) > cfg.max_cache_size:
                 self._lstm_cache.pop(next(iter(self._lstm_cache)))
             params, err_mu, err_sd, version = entry
+            if memo_on:
+                # verdict memo: unchanged score windows against unchanged
+                # params (version pins them) reuse the previous z without
+                # a device launch — the steady-state common case for jobs
+                # whose train window hasn't moved
+                jkey = (it.job_id, tuple(it.metrics))
+                zfp = _fp(b"lstm-z", cwin, cmask, err_mu, err_sd, version)
+                prev = self._lstm_z_memo.get(jkey)
+                if prev is not None and prev[0] == zfp:
+                    self._lstm_z_memo.move_to_end(jkey)
+                    self.lstm_rescore_skips += 1
+                    memo_zs.append((it, prev[1]))
+                    continue
+                zfp_by_job[jkey] = zfp
             scoreable.append((it, params, err_mu, err_sd, version,
                               cwin, cmask))
 
@@ -906,11 +1025,26 @@ class Analyzer:
             params, err_mu, err_sd, version = entry
             scoreable.append((it, params, err_mu, err_sd, version,
                               cwin, cmask))
-        for (it, z) in self._score_multi_fleet(scoreable):
+        if memo_on:
+            # freshly trained / follower rows get their score fingerprint
+            # recorded too, so the NEXT cycle's unchanged windows memo-hit
+            for it, _p, mu_, sd_, version, cwin, cmask in scoreable:
+                jkey = (it.job_id, tuple(it.metrics))
+                zfp_by_job.setdefault(
+                    jkey, _fp(b"lstm-z", cwin, cmask, mu_, sd_, version))
+        import itertools
+
+        for (it, z) in itertools.chain(
+                memo_zs, self._score_multi_fleet(scoreable)):
             results[(it.job_id, "+".join(it.metrics), "lstm")] = {
                 "unhealthy": z > cfg.lstm_threshold,
                 "z": z,
             }
+            if memo_on:
+                jkey = (it.job_id, tuple(it.metrics))
+                zfp = zfp_by_job.get(jkey)
+                if zfp is not None:
+                    self._memo_put(self._lstm_z_memo, jkey, (zfp, z))
         return results
 
     def _train_pending(self, pending):
@@ -927,7 +1061,8 @@ class Analyzer:
             hwin = rec[2]
             groups.setdefault(hwin.shape, []).append(rec)
         def train_one(rec):
-            it, cache_key, hwin, hmask, cwin, cmask = rec
+            it, cache_key, hwin, hmask, cwin, cmask = rec[:6]
+            self.device_launches += 1
             state, tx = lstm_ae.init_state(
                 model, _jax.random.PRNGKey(0), T=hwin.shape[1])
             state, _ = lstm_ae.train(
@@ -950,6 +1085,7 @@ class Analyzer:
                     try:
                         Xh = np.stack([r[2] for r in recs])
                         Mh = np.stack([r[3] for r in recs])
+                        self.device_launches += 1
                         pstack, mus, sds = lstm_ae.train_fleet(
                             model, _jax.random.PRNGKey(0), Xh, Mh,
                             epochs=cfg.lstm_epochs)
@@ -973,13 +1109,21 @@ class Analyzer:
             for rec, result in zip(recs, trained):
                 if result is None:
                     continue
-                it, cache_key, _hw, _hm, cwin, cmask = rec
+                it, cache_key, _hw, _hm, cwin, cmask = rec[:6]
                 params, mu_, sd_ = result
                 self._lstm_param_version += 1
                 entry = (params, mu_, sd_, self._lstm_param_version)
                 self._lstm_cache[cache_key] = entry
                 while len(self._lstm_cache) > cfg.max_cache_size:
                     self._lstm_cache.pop(next(iter(self._lstm_cache)))
+                train_fp = rec[6] if len(rec) > 6 else None
+                if train_fp is not None:
+                    # params are shared refs with the LRU cache, so this
+                    # index adds no param memory; bound it like the cache
+                    self._lstm_train_memo[train_fp] = entry
+                    self._lstm_train_memo.move_to_end(train_fp)
+                    while len(self._lstm_train_memo) > cfg.max_cache_size:
+                        self._lstm_train_memo.popitem(last=False)
                 yield (it, params, mu_, sd_, entry[3], cwin, cmask)
 
     # fleet scoring engages above this group size; smaller groups take the
@@ -1012,6 +1156,7 @@ class Analyzer:
             model = self._lstm_model(F)
             if len(recs) < self._LSTM_FLEET_MIN:
                 for it, params, mu, sd, _ver, cwin, cmask in recs:
+                    self.device_launches += 1
                     z = float(np.max(np.asarray(lstm_ae.anomaly_scores(
                         params, cwin, cmask, mu, sd, model.apply))))
                     yield it, z
@@ -1056,6 +1201,7 @@ class Analyzer:
                     M = np.concatenate([M, np.repeat(M[-1:], pad, axis=0)])
                     mus = np.concatenate([mus, np.repeat(mus[-1:], pad)])
                     sds = np.concatenate([sds, np.repeat(sds[-1:], pad)])
+                self.device_launches += 1
                 zs = np.asarray(lstm_ae.anomaly_scores_fleet(
                     pstack, X, M, mus, sds, model.apply))[:J]
                 for (it, *_), z in zip(chunk, zs.max(axis=1)):
@@ -1370,6 +1516,8 @@ class Analyzer:
         all_hpas: list[_HpaItem] = []
         self._lstm_trained_this_cycle = 0
         self._lstm_budget_skipped_ids = set()
+        launches0 = self.device_launches
+        rescore_skips0 = self.lstm_rescore_skips
         pipe = CyclePipeline(self) if self.config.score_pipeline else None
         stages = {"preprocess": 0.0, "dispatch": 0.0, "collect": 0.0,
                   "fold": 0.0}
@@ -1581,6 +1729,12 @@ class Analyzer:
             "stage_seconds": {k: round(v, 6) for k, v in stages.items()},
             "family_score_seconds": {
                 k: round(v, 6) for k, v in fam_seconds.items()},
+            # steady-state memo observability: launches actually fired
+            # this cycle and verdicts served straight from fingerprints
+            "device_launches": self.device_launches - launches0,
+            "score_memo_hits": dict(pipe.memo_hits) if pipe is not None
+            else {},
+            "lstm_rescore_skips": self.lstm_rescore_skips - rescore_skips0,
         }
         self.store.put_state("breath", self.breath.export())
         self.store.flush()

@@ -69,6 +69,36 @@ class CyclePipeline:
         self.stage_seconds = {"dispatch": 0.0, "collect": 0.0}
         self.family_seconds: dict = {}
         self.launches = 0
+        # fingerprint score memo (SCORE_MEMO): unchanged rows resolve
+        # straight from the analyzer's cross-cycle memo and never enter an
+        # accumulator — buckets hold only changed rows, so steady-state
+        # cycles fire fewer, smaller programs (and a no-change cycle fires
+        # none at all). Routing/bucketing is unchanged for the rows that
+        # do score, so launch boundaries — and verdicts — stay identical
+        # to the memo-off path.
+        self.memo = analyzer._score_memo if analyzer.config.score_memo \
+            else None
+        self.memo_results: dict = {f: {} for f in self.FAMILIES}
+        self.memo_hits: dict = {}  # family -> hits this cycle
+        self._fps: dict = {}       # (family, result_key) -> fingerprint
+
+    def _memo_check(self, family: str, entry, T: int) -> bool:
+        """True when this entry's verdict was served from the memo."""
+        if self.memo is None:
+            return False
+        key, fp = self.an._memo_key_fp(family, entry, T)
+        hit = self.memo.get((family, key))
+        if hit is not None and hit[0] == fp:
+            self.memo.move_to_end((family, key))
+            self.memo_results[family][key] = hit[1]
+            self.memo_hits[family] = self.memo_hits.get(family, 0) + 1
+            self.an.score_memo_hits[family] = (
+                self.an.score_memo_hits.get(family, 0) + 1)
+            return True
+        self._fps[(family, key)] = fp
+        self.an.score_memo_misses[family] = (
+            self.an.score_memo_misses.get(family, 0) + 1)
+        return False
 
     # ------------------------------------------------------------- feeding
     def feed(self, pairs, bands, bis, multis, hpas):
@@ -84,18 +114,23 @@ class CyclePipeline:
         self.multis += multis
         for it in pairs:
             try:
-                self._add("pair", an._pair_T(it), it)
+                T = an._pair_T(it)
+                if not self._memo_check("pair", it, T):
+                    self._add("pair", T, it)
             except Exception:  # noqa: BLE001 - retried per job at collect
                 self.failed.append(("pair", [it]))
         for it in bands:
             try:
-                self._add("band", an._band_T(it), it)
+                T = an._band_T(it)
+                if not self._memo_check("band", it, T):
+                    self._add("band", T, it)
             except Exception:  # noqa: BLE001
                 self.failed.append(("band", [it]))
         for it in bis:
             try:
                 pre, T = an._bi_prep(it)
-                self._add("bivariate", T, (it, pre))
+                if not self._memo_check("bivariate", (it, pre), T):
+                    self._add("bivariate", T, (it, pre))
             except Exception:  # noqa: BLE001
                 self.failed.append(("bivariate", [it]))
         if hpas:
@@ -106,7 +141,9 @@ class CyclePipeline:
                 rows = []
             for row in rows:
                 try:
-                    self._add("hpa", an._hpa_row_T(row), row)
+                    T = an._hpa_row_T(row)
+                    if not self._memo_check("hpa", row, T):
+                        self._add("hpa", T, row)
                 except Exception:  # noqa: BLE001
                     self.failed.append(("hpa", [row]))
 
@@ -193,6 +230,15 @@ class CyclePipeline:
                     results[family].update(sync[family](group))
                 except Exception as e:  # noqa: BLE001
                     bad[job_id] = f"{type(e).__name__}: {e}"
+        if self.memo is not None:
+            # memoize every freshly scored verdict (collect + retries) for
+            # the next cycle, then fold the memo-served ones back in
+            for family in self.FAMILIES:
+                for key, res in results[family].items():
+                    fp = self._fps.get((family, key))
+                    if fp is not None:
+                        an._memo_put(self.memo, (family, key), (fp, res))
+                results[family].update(self.memo_results[family])
         # lstm scores here, not in the stream: training mutates the model
         # cache under a per-cycle budget whose order must match claim order
         with tracing.span("engine.score.lstm", n=len(self.multis)) as lsp:

@@ -232,6 +232,18 @@ class FaultyDataSource:
 
         return self._act(self.inner.fetch, url, parse_prometheus_body)
 
+    def fetch_series(self, url: str):
+        fs = getattr(self.inner, "fetch_series", None)
+        if fs is None:
+            return None
+        from ..dataplane.fetch import parse_prometheus_body
+
+        def garbage(raw):
+            ts, vals = parse_prometheus_body(raw)
+            return ts, vals, len(raw)
+
+        return self._act(fs, url, garbage)
+
     def fetch_window(self, url: str):
         fw = getattr(self.inner, "fetch_window", None)
         if fw is None:

@@ -147,6 +147,15 @@ class ResilientDataSource(_ResilientBase):
             return None  # engine falls back to fetch(), like CachingDataSource
         return self._call(fw, url)
 
+    def fetch_series(self, url: str):
+        """Delta-layer seam (parsed samples + byte count), same breaker +
+        retry train as every other fetch shape. None = the inner source
+        has no byte-level path; the delta layer falls back to fetch()."""
+        fs = getattr(self.inner, "fetch_series", None)
+        if fs is None:
+            return None
+        return self._call(fs, url)
+
     def _call(self, fn, url: str):
         key = host_key(url)
         br = self.breakers.for_key(key)

@@ -47,6 +47,15 @@ Env surface (union of the reference services'):
   SCORE_PIPELINE         streaming preprocess->dispatch scoring pipeline
                          (default on; 0 restores the barriered cycle —
                          engine/pipeline.py, docs/performance.md)
+  DELTA_FETCH            steady-state delta window fetch (default on):
+                         re-fetch only each window's tail per cycle and
+                         splice into the cached grid, byte-identical to a
+                         full refetch (dataplane/delta.py); 0 restores
+                         the full-refetch path exactly
+  WINDOW_CACHE_MAX       delta window-cache entries (~3 per job)
+  SCORE_MEMO             fingerprint score memoization (default on):
+                         unchanged job rows reuse last cycle's verdict
+                         without a device launch (engine/pipeline.py)
   COMPILE_CACHE_PATH     persistent XLA compilation cache dir: restarts
                          skip the first-cycle compile storm
   PREWARM_ON_START       background-compile the standard (family x rung
@@ -168,8 +177,24 @@ class Runtime:
                     ),
                     exporter=self.exporter,
                 )
+        # -- delta fetch layer (DELTA_FETCH; dataplane/delta.py): steady-
+        # state cycles re-fetch only each window's tail and splice it into
+        # the cached grid. Sits UNDER the TTL cache (which dedupes
+        # identical URLs within a cycle) and ABOVE resilience (so delta
+        # queries ride the same breaker/retry train). DELTA_FETCH=0 skips
+        # the layer entirely — the full-refetch path is byte-for-byte
+        # today's. --
+        self.delta_source = None
+        if self.config.delta_fetch:
+            from .dataplane.delta import DeltaWindowSource
+
+            source = DeltaWindowSource(
+                source, max_entries=self.config.window_cache_max)
+            self.delta_source = source
+        self.cache_source = None
         if cache:
             source = CachingDataSource(source, max_entries=self.config.max_cache_size)
+            self.cache_source = source
         self.source = source
         self.store = JobStore(snapshot_path=snapshot_path, archive=archive)
         self.job_retention_seconds = job_retention_seconds
@@ -198,6 +223,7 @@ class Runtime:
         self.service = ForemastService(
             self.store, exporter=self.exporter, query_endpoint=query_endpoint,
             analyzer=self.analyzer, resilience=self.resilience,
+            delta_source=self.delta_source, cache_source=self.cache_source,
         )
         self.service.chaos_active = bool(self.chaos_injectors)
         self.wavefront_sink = wavefront_sink

@@ -260,7 +260,8 @@ class ForemastService:
     """Route handlers over the shared store/exporter."""
 
     def __init__(self, store: JobStore, exporter: VerdictExporter | None = None,
-                 query_endpoint: str = "", analyzer=None, resilience=None):
+                 query_endpoint: str = "", analyzer=None, resilience=None,
+                 delta_source=None, cache_source=None):
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.query_endpoint = query_endpoint  # metric-store base for the proxy
@@ -270,6 +271,11 @@ class ForemastService:
         # optional resilience handle (ResilientDataSource): /status reports
         # live breaker states + retry counters from its snapshot()
         self.resilience = resilience
+        # optional dataplane handles: the delta window source (hit ratio,
+        # bytes saved) and the TTL CachingDataSource (hit/miss/
+        # single-flight counters) — both surfaced on /metrics and /status
+        self.delta_source = delta_source
+        self.cache_source = cache_source
         self.chaos_active = False  # stamped by the runtime when chaos is on
         # set by make_server: () -> the HTTP admission gate's shed counter
         self.http_shed_count = None
@@ -465,6 +471,64 @@ class ForemastService:
                 "foremast_lstm_stack_rebuilds_total "
                 f"{self.analyzer.lstm_stack_rebuilds}"
             )
+            # fingerprint score memo (SCORE_MEMO): verdicts served without
+            # a device launch, per family + the lstm rescue paths.
+            # Snapshot first: the cycle thread inserts new family keys
+            # concurrently, and iterating the live dicts can raise
+            # "dict changed size during iteration" mid-scrape.
+            memo_hits = dict(self.analyzer.score_memo_hits)
+            memo_misses = dict(self.analyzer.score_memo_misses)
+            for fam in sorted(set(memo_hits) | set(memo_misses)):
+                lines.append(
+                    f'foremastbrain:score_memo_hits_total{{family="{fam}"}} '
+                    f"{memo_hits.get(fam, 0)}"
+                )
+                lines.append(
+                    f'foremastbrain:score_memo_misses_total{{family="{fam}"}} '
+                    f"{memo_misses.get(fam, 0)}"
+                )
+            lines.append(
+                "foremastbrain:lstm_rescore_skips_total "
+                f"{self.analyzer.lstm_rescore_skips}"
+            )
+            lines.append(
+                "foremastbrain:lstm_train_memo_hits_total "
+                f"{self.analyzer.lstm_train_memo_hits}"
+            )
+            lines.append(
+                "foremastbrain:device_launches_total "
+                f"{self.analyzer.device_launches}"
+            )
+        if self.cache_source is not None:
+            # the TTL window cache's own counters (tracked since PR 1 but
+            # never exported): hit/miss plus single-flight stampede saves
+            lines.append(
+                "foremastbrain:window_cache_hits_total "
+                f"{self.cache_source.hits}"
+            )
+            lines.append(
+                "foremastbrain:window_cache_misses_total "
+                f"{self.cache_source.misses}"
+            )
+            lines.append(
+                "foremastbrain:window_cache_single_flight_waits_total "
+                f"{self.cache_source.single_flight_waits}"
+            )
+        if self.delta_source is not None:
+            snap = self.delta_source.snapshot()
+            lines.append(
+                f"foremastbrain:delta_fetch_hits_total {snap['delta_hits']}")
+            lines.append(
+                "foremastbrain:delta_fetch_full_total "
+                f"{snap['full_fetches']}")
+            lines.append(
+                f"foremastbrain:delta_fetch_hit_ratio {snap['hit_ratio']}")
+            lines.append(
+                "foremastbrain:delta_fetch_bytes_saved_total "
+                f"{snap['bytes_saved']}")
+            lines.append(
+                "foremastbrain:delta_fetch_points_saved_total "
+                f"{snap['points_saved']}")
         if self.http_shed_count is not None:
             lines.append(f"foremast_http_shed_total {self.http_shed_count()}")
         self_gauges = "\n".join(lines) + "\n"
@@ -486,6 +550,16 @@ class ForemastService:
             # pipeline's preprocess/dispatch/collect/fold split) — same
             # numbers as the foremastbrain:cycle_stage_seconds gauges
             out["cycle"] = self.analyzer.last_cycle_stages
+        if self.delta_source is not None:
+            # steady-state incremental fetch health: hit ratio, bytes not
+            # re-downloaded, and why any full refetches happened
+            out["delta_fetch"] = self.delta_source.snapshot()
+        if self.cache_source is not None:
+            out["window_cache"] = {
+                "hits": self.cache_source.hits,
+                "misses": self.cache_source.misses,
+                "single_flight_waits": self.cache_source.single_flight_waits,
+            }
         if self.resilience is not None:
             snap = self.resilience.snapshot()
             out["resilience"] = snap

