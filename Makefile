@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos clean
+.PHONY: test test-fast native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos soak clean
 
 test:            ## full suite (CPU, 8 virtual devices via conftest)
 	$(PY) -m pytest tests/ -q
@@ -37,6 +37,9 @@ fuzz:            ## extended native-parser fuzz campaign (100k mutations)
 
 chaos:           ## seeded chaos soak: engine cycles under the fault plan
 	$(CPU_ENV) $(PY) -m pytest tests/test_chaos_soak.py -m chaos -q
+
+soak:            ## live-runtime chaos soak (<120s): spike+hang faults against a running process; health DEGRADED->OK end to end
+	$(CPU_ENV) $(PY) -m pytest tests/test_soak_live.py -m chaos -q
 
 demo:            ## hermetic rollback demo (no cluster)
 	$(CPU_ENV) $(PY) -m foremast_tpu demo

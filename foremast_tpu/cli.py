@@ -215,6 +215,28 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_health(args) -> int:
+    """Print the runtime's degraded-mode health state (/readyz).
+
+    Exit code mirrors readiness: 0 for ok/degraded (serving), 1 for
+    overloaded/stalled or an unreachable runtime — scriptable as a gate
+    (`foremast-tpu health && kubectl ...`). Shares HttpAnalyst's probe
+    transport (endpoint normalization + 503-body semantics) with the
+    operator's remediation-suppression gate."""
+    from .operator.analyst import AnalystError, HttpAnalyst
+
+    endpoint = (args.endpoint or os.environ.get("ANALYST_ENDPOINT", "")
+                or "http://localhost:8099")
+    analyst = HttpAnalyst(endpoint, timeout=5.0)
+    try:
+        status, body = analyst.probe_ready()
+    except AnalystError as e:
+        print(f"cannot probe {endpoint}: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(body, indent=2))
+    return 0 if status == 200 else 1
+
+
 def cmd_trigger(args) -> int:
     from .trigger.trigger import main
 
@@ -285,6 +307,14 @@ def build_parser() -> argparse.ArgumentParser:
         "trigger",
         help="run the non-K8s poller (REQUESTS_FILE CSV -> rolling analyses)",
     ).set_defaults(func=cmd_trigger)
+    hp = sub.add_parser(
+        "health",
+        help="print the runtime's degraded-mode health state (/readyz)",
+    )
+    hp.add_argument("--endpoint", default="",
+                    help="runtime base URL (env ANALYST_ENDPOINT; "
+                         "default http://localhost:8099)")
+    hp.set_defaults(func=cmd_health)
     for name, fn, help_ in (
         ("watch", cmd_watch, "enable continuous monitoring for an app"),
         ("unwatch", cmd_unwatch, "disable continuous monitoring for an app"),

@@ -20,6 +20,7 @@ and forecast-band checks fused (parallel.fleet), HPA scores batched
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
@@ -51,6 +52,29 @@ from ..utils import tracing
 from ..utils.timeutils import from_rfc3339
 from . import jobs as J
 from .config import EngineConfig, MetricPolicy
+from .health import HealthMonitor
+
+
+class WatchdogTimeout(Exception):
+    """A device materialization (or its per-job retry) overran WATCHDOG_S.
+
+    Raised by Analyzer._watchdog_call; the pipeline's collect phase treats
+    it like any collect failure — the bucket fails over to the sync
+    per-job path — so one hung launch costs one bucket's timeout, not the
+    whole cycle."""
+
+
+# shed marker carried through the preprocess stream in the `failed` slot:
+# distinguishable from every real FetchError string (which the analyzer
+# stamps into job reasons) by identity, never shown to users directly
+_SHED = "__cycle_deadline_shed__"
+
+# poison-job quarantine re-admission backoff: first parking sits out
+# QUARANTINE_BASE_S, doubling per subsequent parking up to the cap. Not
+# env knobs — QUARANTINE_AFTER is the operator-facing control; the
+# backoff shape only needs to be sane (docs/resilience.md).
+QUARANTINE_BASE_S = 30.0
+QUARANTINE_MAX_S = 3600.0
 
 
 @dataclass
@@ -280,6 +304,32 @@ class Analyzer:
         # family, lstm scoring, training) — the steady-state no-change
         # gate asserts this stays flat over a memo-hit cycle
         self.device_launches = 0
+        # -- degraded-mode operation state (docs/resilience.md) --
+        # health state machine: the runtime wires cycle cadence + breaker
+        # boards in; standalone analyzers still compute shed/stale/
+        # watchdog-driven states
+        self.health = HealthMonitor(exporter=self.exporter)
+        # load shedding (CYCLE_DEADLINE_S): cumulative shed count + the
+        # consecutive-shed streak per open job (a shed job sorts ahead of
+        # its priority class next cycle, so a permanently-blown budget
+        # still round-robins the fleet instead of starving the tail)
+        self.jobs_shed_total = 0
+        self._shed_streak: dict[str, int] = {}
+        # stale-verdict serving (MAX_STALE_S): job_id -> last cycle
+        # timestamp at which the job was judged healthy on FRESH data.
+        # Entries die with the job (terminal transitions pop them).
+        self.stale_verdicts_served_total = 0
+        self._stale_state: dict[str, float] = {}
+        # poison-job quarantine (QUARANTINE_AFTER): job_id ->
+        # [consecutive_failures, quarantined_until, times_quarantined]
+        self.jobs_quarantined_total = 0
+        self._quarantine: dict[str, list] = {}
+        # hung-launch watchdog (WATCHDOG_S): fires counter + the live
+        # count of abandoned sacrificial threads (each still parked on a
+        # hung device call); bounded by _WATCHDOG_MAX_ABANDONED
+        self.watchdog_fires_total = 0
+        self._wd_lock = threading.Lock()
+        self._watchdog_abandoned = 0
 
     def _memo_put(self, table: OrderedDict, key, val):
         """Insert-and-bound for the memo tables (LRU, shared ceiling)."""
@@ -428,6 +478,89 @@ class Analyzer:
                 except Exception as e:  # noqa: BLE001
                     bad[job_id] = f"{type(e).__name__}: {e}"
             return results, bad
+
+    def _watchdog_call(self, fn, *args):
+        """Run a collect-phase materialization bounded by WATCHDOG_S.
+
+        JAX device waits have no timeout parameter, so the bound comes
+        from outside: the call runs on a sacrificial daemon thread and
+        the caller waits at most the budget. On expiry the thread is
+        ABANDONED (a truly hung runtime call cannot be interrupted from
+        Python) and WatchdogTimeout raised — the pipeline fails the
+        bucket over to the sync per-job path, which is wrapped too, so a
+        poisoned device stalls one bucket per cycle, never the cycle.
+        Disabled (WATCHDOG_S=0) this is a plain call with zero overhead.
+        """
+        timeout = self.config.watchdog_seconds
+        if timeout <= 0:
+            return fn(*args)
+        with self._wd_lock:
+            if self._watchdog_abandoned >= self._WATCHDOG_MAX_ABANDONED:
+                # a persistently wedged device would otherwise accumulate
+                # abandoned threads (and their pinned launch state)
+                # without bound across cycles; at the cap, new guarded
+                # calls fast-fail as watchdog fires — same failover and
+                # the same DEGRADED health signal, zero new threads
+                self._record_watchdog_fire()
+                raise WatchdogTimeout(
+                    f"{self._watchdog_abandoned} abandoned watchdog "
+                    "threads (device wedged); call skipped")
+        out: list = []
+        err: list = []
+        done = threading.Event()
+        abandoned = {"flag": False}
+
+        def run():
+            try:
+                out.append(fn(*args))
+            except BaseException as e:  # noqa: BLE001 - relayed to caller
+                err.append(e)
+            finally:
+                done.set()
+                # flag read UNDER the lock, pairing with the timed-out
+                # main thread's locked {is_set check -> flag set}: without
+                # it, a call completing exactly at the timeout boundary
+                # could read the flag before main sets it and leak the
+                # abandoned slot forever (8 leaks = watchdog wedged shut)
+                with self._wd_lock:
+                    if abandoned["flag"]:
+                        # the hung call eventually returned: free its slot
+                        self._watchdog_abandoned -= 1
+
+        t = threading.Thread(target=run, name="collect-watchdog", daemon=True)
+        t.start()
+        if not done.wait(timeout):
+            with self._wd_lock:
+                if not done.is_set():
+                    abandoned["flag"] = True
+                    self._watchdog_abandoned += 1
+            if abandoned["flag"]:
+                self._record_watchdog_fire()
+                raise WatchdogTimeout(
+                    f"device materialization exceeded {timeout:g}s "
+                    "(watchdog)")
+        if err:
+            raise err[0]
+        return out[0]
+
+    # abandoned-thread ceiling: past this many never-returned device
+    # calls the watchdog stops spawning and fast-fails instead
+    _WATCHDOG_MAX_ABANDONED = 8
+
+    def _record_watchdog_fire(self):
+        self.watchdog_fires_total += 1
+        self.exporter.record_counter(
+            "foremastbrain:watchdog_fires_total", {},
+            help="device materializations timed out by the collect "
+                 "watchdog (WATCHDOG_S)")
+
+    def quarantined_count(self, now: float | None = None) -> int:
+        """Jobs currently parked in poison quarantine. Snapshot first
+        (list() is atomic under the GIL): /metrics scrapes call this from
+        HTTP threads while the cycle thread inserts/pops entries, and
+        iterating the live dict would raise mid-scrape."""
+        now = time.time() if now is None else now
+        return sum(1 for q in list(self._quarantine.values()) if q[1] > now)
 
     # ladder continues past the default chunk so a LARGE configured
     # score_batch still pads small fleets to the nearest rung, never to
@@ -1446,24 +1579,138 @@ class Analyzer:
         return out
 
     # ------------------------------------------------------------- verdict
+    def _serve_stale(self, doc: J.Document, failure: str, worker: str,
+                     now: float, in_postprocess: bool = False) -> str | None:
+        """Re-serve a warm job's last fresh verdict during a source outage.
+
+        A job is warm when it was judged healthy on FRESH data at most
+        MAX_STALE_S ago. Serving means: mid-window, requeue with the
+        staleness age stamped in the reason (no PREPROCESS_FAILED flap);
+        past endTime, complete COMPLETED_HEALTH on the last fresh verdict
+        instead of flipping COMPLETED_UNKNOWN. Unhealthy verdicts are
+        never stale-served — they complete terminally the cycle they are
+        seen, so a live job's last verdict is always "healthy so far".
+        Returns the applied status, or None when the job is not warm
+        (callers fall through to the pre-degraded-mode behavior).
+        """
+        max_stale = self.config.max_stale_seconds
+        at = self._stale_state.get(doc.id)
+        if max_stale <= 0 or at is None or now - at > max_stale:
+            return None
+        age = now - at
+        self.stale_verdicts_served_total += 1
+        self.exporter.record_counter(
+            "foremastbrain:stale_verdicts_served_total", {},
+            help="verdicts re-served from warm state during source "
+                 "outages (bounded by MAX_STALE_S)")
+        reason = (f"stale verdict served (age {age:.0f}s, last judged "
+                  f"healthy): {failure}")
+        try:
+            end_time = from_rfc3339(doc.end_time)
+        except (ValueError, TypeError):
+            end_time = (float("inf")
+                        if doc.strategy in CONTINUOUS_STRATEGIES else now)
+        if doc.strategy not in CONTINUOUS_STRATEGIES and now >= end_time:
+            # the watch window closed during the outage: the job watched
+            # healthy right up to the blackout, and the last fresh verdict
+            # is younger than MAX_STALE_S — complete on it
+            if not in_postprocess:
+                self.store.advance(doc.id, J.PREPROCESS_COMPLETED,
+                                   J.POSTPROCESS_INPROGRESS, worker=worker)
+            self._stale_state.pop(doc.id, None)
+            self.store.transition(doc.id, J.COMPLETED_HEALTH, reason=reason,
+                                  worker=worker)
+            return J.COMPLETED_HEALTH
+        self.store.transition(doc.id, J.INITIAL, reason=reason, worker=worker)
+        return J.INITIAL
+
+    def _record_scoring_failure(self, job_id: str, now: float):
+        """Quarantine bookkeeping for one _isolate per-job retry failure.
+
+        QUARANTINE_AFTER consecutive failures park the job; each parking
+        doubles the re-admission backoff (QUARANTINE_BASE_S..MAX). A job
+        that was quarantined before re-parks on its FIRST post-probe
+        failure — the probe answered the only open question."""
+        qa = self.config.quarantine_after
+        if qa <= 0:
+            return
+        q = self._quarantine.setdefault(job_id, [0, 0.0, 0])
+        q[0] += 1
+        if q[2] > 0 or q[0] >= qa:
+            q[2] += 1
+            q[0] = 0
+            delay = min(QUARANTINE_BASE_S * (2.0 ** (q[2] - 1)),
+                        QUARANTINE_MAX_S)
+            q[1] = now + delay
+            self.jobs_quarantined_total += 1
+            self.exporter.record_counter(
+                "foremastbrain:jobs_quarantined_total", {},
+                help="poison-job quarantine parkings (QUARANTINE_AFTER "
+                     "consecutive scoring failures)")
+
     def run_cycle(self, worker: str = "worker-0", now: float | None = None) -> dict:
         """One engine cycle. Returns {job_id: new_status} for observability."""
         with tracing.span("engine.cycle", worker=worker):
+            now = time.time() if now is None else now
+            # degraded mode: the whole-cycle deadline budget
+            # (CYCLE_DEADLINE_S). Burns down through fetch -> preprocess ->
+            # dispatch; once expired, un-preprocessed jobs are shed in
+            # reverse priority order and carried to the next cycle.
+            cd = self.config.cycle_deadline_seconds
+            cycle_dl = Deadline.after(cd) if cd > 0 else None
             # resilience: arm a per-cycle fetch deadline so retry/backoff
             # trains inside a ResilientDataSource can never overrun the
             # cycle budget (every fetch thread shares the one Deadline;
             # plain sources have no set_cycle_deadline and skip this)
             sd = getattr(self.source, "set_cycle_deadline", None)
             budget = self.config.fetch_cycle_deadline_seconds
+            fetch_dl = Deadline.after(budget) if budget > 0 else None
+            if cycle_dl is not None:
+                # the fetch retry train must never outlive the CYCLE budget
+                fetch_dl = (cycle_dl if fetch_dl is None
+                            else Deadline(min(fetch_dl.at, cycle_dl.at)))
             if sd is not None:
-                sd(Deadline.after(budget) if budget > 0 else None)
+                sd(fetch_dl)
+            self.health.begin_cycle()
             try:
-                return self._run_cycle(worker, now)
+                outcomes = self._run_cycle(worker, now, cycle_dl)
             finally:
                 if sd is not None:
                     sd(None)
+            # end_cycle only on SUCCESS: a raising cycle must not refresh
+            # the liveness reference, so a crash-looping engine (worker
+            # loop swallows and retries) ages into STALLED instead of
+            # reporting OK on zero completed verdicts. The per-cycle
+            # deltas come straight from the cycle stats _run_cycle just
+            # published — ONE computation feeds /status and the health
+            # machine, so the two surfaces can never drift.
+            stats = self.last_cycle_stages
+            self.health.end_cycle(
+                shed=stats.get("jobs_shed", 0),
+                stale_served=stats.get("stale_verdicts_served", 0),
+                watchdog_fires=stats.get("watchdog_fires", 0),
+                quarantined=self.quarantined_count(now),
+                deadline_overrun=(cycle_dl is not None
+                                  and cycle_dl.expired()),
+            )
+            return outcomes
 
-    def _stream_prep(self, claimed: list, now: float):
+    def _job_priority(self, doc: J.Document) -> tuple:
+        """Load-shedding sort key: lower scores FIRST.
+
+        New-deployment analyses (rollingUpdate/canary/rollover) lead —
+        their verdict gates a live rollout, and they are exempt from
+        shedding entirely (_stream_prep's class gate); steady-state
+        monitors (continuous/hpa) watch forever and can carry a cycle.
+        Within the monitor class, a job shed on recent cycles sorts
+        ahead, so a permanently blown budget round-robins the fleet
+        instead of starving the tail.
+        """
+        return (1 if doc.strategy in CONTINUOUS_STRATEGIES else 0,
+                -self._shed_streak.get(doc.id, 0))
+
+    def _stream_prep(self, claimed: list, now: float,
+                     deadline: Deadline | None = None):
         """Yield (doc_id, items, failed) per job, in claim order, as the
         fetch pool completes chunks.
 
@@ -1477,10 +1724,36 @@ class Analyzer:
         packing and verdict folding — stays deterministic; consuming it
         incrementally is what lets the pipeline dispatch bucket N while
         bucket N+1 is still fetching.
+
+        `deadline` is the cycle budget (CYCLE_DEADLINE_S): once expired,
+        STEADY-STATE jobs (continuous/hpa) not yet fetched yield the
+        _SHED marker WITHOUT touching the network and carry over to the
+        next cycle. New-deployment analyses are never shed — their
+        verdict gates a live rollout, and because chunks run concurrently
+        on the fetch pool, a class-based gate is the only one that holds
+        under interleaving (a position-based cutoff could shed a canary
+        while monitors on other workers complete). A canary-heavy
+        overrun therefore shows as `deadline_overrun`, not shedding. The
+        first MONITOR-class job of the cycle is additionally exempt — the
+        guaranteed-progress floor. It must be the first SHEDDABLE job,
+        not claimed[0]: in a mixed fleet the sort puts a (class-exempt)
+        canary first, and guaranteeing that one would leave monitors with
+        no floor at all — permanently starved whenever deployment churn
+        alone burns the budget. The sort puts the longest-shed monitor at
+        the head of its class, so the floor round-robins the fleet.
         """
+        guaranteed = next(
+            (d.id for d in claimed if d.strategy in CONTINUOUS_STRATEGIES),
+            None)
+
         def prep_many(chunk):
             out = []
             for doc in chunk:
+                if (deadline is not None and doc.id != guaranteed
+                        and doc.strategy in CONTINUOUS_STRATEGIES
+                        and deadline.expired()):
+                    out.append((doc.id, None, _SHED))
+                    continue
                 try:
                     out.append((doc.id, self._preprocess(doc, now), ""))
                 except FetchError as e:
@@ -1498,16 +1771,38 @@ class Analyzer:
             for rs in ex.map(prep_many, chunks):
                 yield from rs
 
-    def _run_cycle(self, worker: str, now: float | None) -> dict:
+    def _run_cycle(self, worker: str, now: float,
+                   cycle_dl: Deadline | None = None) -> dict:
         from .pipeline import CyclePipeline
 
-        now = time.time() if now is None else now
         with tracing.span("engine.claim"):
             claimed = self.store.claim_open_jobs(
                 worker,
                 limit=self.config.max_claim_per_cycle,
                 max_stuck_seconds=self.config.max_stuck_seconds,
             )
+        outcomes: dict[str, str] = {}
+        if self._quarantine:
+            # poison-job quarantine gate: parked jobs requeue untouched —
+            # not one fetch, not one _isolate retry — until their
+            # re-admission time; everyone else proceeds normally
+            admitted = []
+            for doc in claimed:
+                q = self._quarantine.get(doc.id)
+                if q is not None and now < q[1]:
+                    self.store.transition(
+                        doc.id, J.INITIAL, worker=worker,
+                        reason=(f"quarantined: scoring poisoned; "
+                                f"re-admission in {q[1] - now:.0f}s"))
+                    outcomes[doc.id] = J.INITIAL
+                else:
+                    admitted.append(doc)
+            claimed = admitted
+        # priority order (stable, so claim order breaks ties): deployment
+        # canaries score first; steady-state monitors shed first when the
+        # cycle deadline burns down
+        if cycle_dl is not None:
+            claimed.sort(key=self._job_priority)
         states: dict[str, _JobState] = {}
         all_pairs: list[_PairItem] = []
         all_bands: list[_BandItem] = []
@@ -1518,6 +1813,9 @@ class Analyzer:
         self._lstm_budget_skipped_ids = set()
         launches0 = self.device_launches
         rescore_skips0 = self.lstm_rescore_skips
+        shed_cycle0 = self.jobs_shed_total
+        stale_cycle0 = self.stale_verdicts_served_total
+        wd_cycle0 = self.watchdog_fires_total
         pipe = CyclePipeline(self) if self.config.score_pipeline else None
         stages = {"preprocess": 0.0, "dispatch": 0.0, "collect": 0.0,
                   "fold": 0.0}
@@ -1525,7 +1823,8 @@ class Analyzer:
             for doc in claimed:
                 states[doc.id] = _JobState(doc)
             t_wait = time.perf_counter()
-            for doc_id, items, failed in self._stream_prep(claimed, now):
+            for doc_id, items, failed in self._stream_prep(
+                    claimed, now, cycle_dl):
                 stages["preprocess"] += time.perf_counter() - t_wait
                 if failed:
                     states[doc_id].failed = failed
@@ -1543,21 +1842,49 @@ class Analyzer:
                         pipe.feed(pairs, bands, bis, multis, hpas)
                 t_wait = time.perf_counter()
         for doc_id, st in states.items():
-            if st.failed:
-                if st.doc.strategy in CONTINUOUS_STRATEGIES:
-                    # perpetual jobs survive transient fetch errors: requeue
-                    # instead of dying terminally on one network blip
-                    self.store.transition(
-                        doc_id, J.INITIAL, reason=f"fetch retry: {st.failed}",
-                        worker=worker,
-                    )
-                else:
-                    self.store.transition(
-                        doc_id, J.PREPROCESS_FAILED, reason=st.failed, worker=worker
-                    )
-            else:
+            if not st.failed:
+                self._shed_streak.pop(doc_id, None)
                 self.store.advance(doc_id, J.PREPROCESS_COMPLETED,
                                    J.POSTPROCESS_INPROGRESS, worker=worker)
+                continue
+            doc = st.doc
+            if st.failed == _SHED:
+                # load shedding (CYCLE_DEADLINE_S): the budget burned down
+                # before this job's fetch started. Carry it to the next
+                # cycle — the shed streak promotes it within its class, so
+                # it completes with a verdict byte-identical to the one it
+                # would have produced unshed (tests/test_degraded.py).
+                self.jobs_shed_total += 1
+                self._shed_streak[doc_id] = self._shed_streak.get(doc_id, 0) + 1
+                self.exporter.record_counter(
+                    "foremastbrain:jobs_shed_total", {},
+                    help="jobs shed by the cycle deadline budget and "
+                         "carried to the next cycle")
+                self.store.transition(
+                    doc_id, J.INITIAL, worker=worker,
+                    reason="shed: cycle deadline budget exhausted; "
+                           "carried over")
+                outcomes[doc_id] = J.INITIAL
+                continue
+            # real fetch failure (retries exhausted / breaker open /
+            # garbage body): a warm job re-serves its last fresh verdict
+            # instead of flapping (stale-verdict serving, MAX_STALE_S)
+            served = self._serve_stale(doc, st.failed, worker, now)
+            if served is not None:
+                outcomes[doc_id] = served
+            elif doc.strategy in CONTINUOUS_STRATEGIES:
+                # perpetual jobs survive transient fetch errors: requeue
+                # instead of dying terminally on one network blip
+                self.store.transition(
+                    doc_id, J.INITIAL, reason=f"fetch retry: {st.failed}",
+                    worker=worker,
+                )
+                outcomes[doc_id] = J.INITIAL
+            else:
+                self.store.transition(
+                    doc_id, J.PREPROCESS_FAILED, reason=st.failed,
+                    worker=worker)
+                outcomes[doc_id] = J.PREPROCESS_FAILED
 
         live = {k: v for k, v in states.items() if not v.failed}
         fam_seconds: dict[str, float] = {}
@@ -1672,19 +1999,36 @@ class Analyzer:
                     )
                 )
 
-        outcomes = {}
         for job_id, st in live.items():
             doc = st.doc
             if job_id in scoring_failed:
                 reason = f"scoring failed: {scoring_failed[job_id]}"
+                if scoring_failed[job_id].startswith("WatchdogTimeout"):
+                    # watchdog fires are INFRASTRUCTURE evidence (a hung
+                    # or wedged device), not job poison: every strategy
+                    # requeues for the next cycle — quarantining the job
+                    # (or aborting a canary) would misattribute the
+                    # device's fault to the workload and blank coverage
+                    # long after the device recovers
+                    self.store.transition(
+                        job_id, J.INITIAL, reason=reason, worker=worker)
+                    outcomes[job_id] = J.INITIAL
+                    continue
                 if doc.strategy in CONTINUOUS_STRATEGIES:
-                    # perpetual jobs retry next cycle (data may heal)
+                    # perpetual jobs retry next cycle (data may heal) —
+                    # but a job that keeps poisoning its per-job retry is
+                    # parked (quarantine) instead of re-burning the
+                    # _isolate fallback every cycle forever
+                    self._record_scoring_failure(job_id, now)
                     self.store.transition(job_id, J.INITIAL, reason=reason, worker=worker)
                     outcomes[job_id] = J.INITIAL
                 else:
+                    self._quarantine.pop(job_id, None)  # terminal: moot
                     self.store.transition(job_id, J.ABORT, reason=reason, worker=worker)
                     outcomes[job_id] = J.ABORT
                 continue
+            # scored cleanly: full quarantine reset (consecutive = 0)
+            self._quarantine.pop(job_id, None)
             if doc.strategy == STRATEGY_HPA:
                 outcomes[job_id] = self._finish_hpa(st, hpa_res.get(job_id), worker, now)
                 continue
@@ -1697,6 +2041,7 @@ class Analyzer:
                 metrics = ", ".join(dict.fromkeys(m for m, _, _ in st.unhealthy))
                 reason = "; ".join(f"{m}: {d}" for m, d, _ in st.unhealthy)
                 anomaly = {m: pairs for m, _, pairs in st.unhealthy if pairs}
+                self._stale_state.pop(job_id, None)
                 self.store.transition(
                     job_id, J.COMPLETED_UNHEALTH,
                     reason=f"anomaly detected on {metrics} :: {reason}",
@@ -1705,13 +2050,26 @@ class Analyzer:
                 outcomes[job_id] = J.COMPLETED_UNHEALTH
             elif now < end_time:
                 # healthy so far; keep watching until endTime (fail-fast
-                # rule); continuous jobs loop here forever
+                # rule); continuous jobs loop here forever. A judged cycle
+                # refreshes the job's warm stale-serving state.
+                if st.judged_any:
+                    self._stale_state[job_id] = now
                 self.store.requeue(job_id, worker=worker)
                 outcomes[job_id] = J.INITIAL
             elif st.judged_any:
+                self._stale_state.pop(job_id, None)
                 self.store.transition(job_id, J.COMPLETED_HEALTH, worker=worker)
                 outcomes[job_id] = J.COMPLETED_HEALTH
             else:
+                # no judgeable data at endTime: a warm job re-serves its
+                # last fresh verdict (zero UNKNOWN flips during a bounded
+                # source blackout); cold jobs keep the reference semantics
+                served = self._serve_stale(
+                    doc, "insufficient data points to judge", worker, now,
+                    in_postprocess=True)
+                if served is not None:
+                    outcomes[job_id] = served
+                    continue
                 self.store.transition(
                     job_id, J.COMPLETED_UNKNOWN,
                     reason="insufficient data points to judge", worker=worker,
@@ -1735,16 +2093,43 @@ class Analyzer:
             "score_memo_hits": dict(pipe.memo_hits) if pipe is not None
             else {},
             "lstm_rescore_skips": self.lstm_rescore_skips - rescore_skips0,
+            # degraded-mode signals (cumulative totals live on /metrics;
+            # these are this cycle's contribution + the live park count)
+            "jobs_shed": self.jobs_shed_total - shed_cycle0,
+            "stale_verdicts_served":
+            self.stale_verdicts_served_total - stale_cycle0,
+            "watchdog_fires": self.watchdog_fires_total - wd_cycle0,
+            "quarantined_jobs": self.quarantined_count(now),
         }
+        self._prune_degraded_state(outcomes)
         self.store.put_state("breath", self.breath.export())
         self.store.flush()
         return outcomes
+
+    def _prune_degraded_state(self, outcomes: dict):
+        """Drop per-job degraded-mode state for jobs that can never come
+        back: terminal outcomes this cycle, plus jobs deleted out from
+        under the analyzer (store gc, unwatch) — without the sweep the
+        maps grow one orphan per churned canary id for the life of the
+        process. O(map sizes) per cycle; the maps hold open jobs only
+        once this runs."""
+        for jid, status in outcomes.items():
+            if status in J.TERMINAL_STATUSES:
+                self._stale_state.pop(jid, None)
+                self._quarantine.pop(jid, None)
+                self._shed_streak.pop(jid, None)
+        for table in (self._stale_state, self._quarantine,
+                      self._shed_streak):
+            for jid in [j for j in table
+                        if j not in outcomes and self.store.get(j) is None]:
+                table.pop(jid, None)
 
     def _finish_hpa(self, st: _JobState, res, worker: str, now: float) -> str:
         doc = st.doc
         if res is None:
             self.store.requeue(doc.id, worker=worker)
             return J.INITIAL
+        self._stale_state[doc.id] = now  # scored on fresh data this cycle
         gated = self.breath.apply(doc.id, res["raw_score"], now=now)
         reason_names = {0: "predicted trend", 1: "anomaly trend",
                         2: "SLA violation", 3: "SLA headroom"}

@@ -202,6 +202,38 @@ class EngineConfig:
     # finish inside this budget so a flapping backend cannot stretch the
     # cycle past its cadence. 0 disables.
     fetch_cycle_deadline_seconds: float = 8.0  # FETCH_CYCLE_DEADLINE
+    # -- degraded-mode operation (docs/resilience.md runbook) --
+    # whole-cycle deadline budget (CYCLE_DEADLINE_S): once it burns down,
+    # STEADY-STATE monitor jobs (continuous/hpa) not yet preprocessed are
+    # SHED and carry over to the next cycle instead of going
+    # COMPLETED_UNKNOWN; new-deployment analyses are exempt (their
+    # verdict gates a live rollout — a canary-heavy overrun shows as the
+    # deadline_overrun health detail, not shedding). The first
+    # monitor-class job is always guaranteed through per cycle (the
+    # floor), and a shed job sorts to the head of the monitor class next
+    # cycle, so every monitor makes progress even under a
+    # permanently-blown budget.
+    # 0 disables (unbounded cycles — the pre-degraded-mode behavior).
+    cycle_deadline_seconds: float = 0.0  # CYCLE_DEADLINE_S
+    # stale-verdict serving bound (MAX_STALE_S): when a warm job's fetch
+    # exhausts retries / hits an open breaker / returns no data, its last
+    # healthy verdict (at most this old) is re-served — stamped with its
+    # staleness age — instead of flapping the job to PREPROCESS_FAILED or
+    # COMPLETED_UNKNOWN. 0 disables stale serving.
+    max_stale_seconds: float = 300.0  # MAX_STALE_S
+    # poison-job quarantine (QUARANTINE_AFTER): a job whose per-job
+    # _isolate retry fails this many CONSECUTIVE cycles is parked with
+    # exponential re-admission backoff (30 s doubling, capped 3600 s)
+    # instead of re-burning the blast-radius fallback every cycle
+    # forever. 0 disables quarantine.
+    quarantine_after: int = 3  # QUARANTINE_AFTER
+    # hung-launch watchdog (WATCHDOG_S): bound on one bucket's device
+    # materialization in the pipeline collect phase; a stuck launch times
+    # out, fails over to the sync per-job path, and is counted on
+    # foremastbrain:watchdog_fires_total. 0 disables (the safe default:
+    # big first-cycle CPU executions can legitimately run long — enable
+    # it once the fleet's shapes are prewarmed/compile-cached).
+    watchdog_seconds: float = 0.0  # WATCHDOG_S
     policies: dict = field(default_factory=lambda: dict(DEFAULT_POLICIES))
 
     def policy_for(self, metric_name: str) -> MetricPolicy:
@@ -344,5 +376,9 @@ def from_env(env=None) -> EngineConfig:
         breaker_failure_threshold=_env_int(env, "BREAKER_FAILURE_THRESHOLD", 5),
         breaker_recovery_seconds=_env_float(env, "BREAKER_RECOVERY_SECONDS", 30.0),
         fetch_cycle_deadline_seconds=_env_float(env, "FETCH_CYCLE_DEADLINE", 8.0),
+        cycle_deadline_seconds=_env_float(env, "CYCLE_DEADLINE_S", 0.0),
+        max_stale_seconds=_env_float(env, "MAX_STALE_S", 300.0),
+        quarantine_after=_env_int(env, "QUARANTINE_AFTER", 3),
+        watchdog_seconds=_env_float(env, "WATCHDOG_S", 0.0),
         policies=policies,
     )

@@ -1,0 +1,195 @@
+"""Health state machine: the brain's own degraded-mode self-assessment.
+
+The reference brain had no notion of its own health — a slow Prometheus
+or a hung worker looked identical to "no anomalies" from the outside, and
+the operator happily rolled deployments back on verdicts computed from
+stale or shed data. This module condenses the degraded-mode layer's
+signals (load shedding, stale-verdict serving, quarantine, the collect
+watchdog, breaker states, cycle liveness) into ONE ordered state:
+
+  OK          every verdict this cycle came from fresh data, on time.
+  DEGRADED    verdicts are flowing but some are second-class: a breaker
+              is open/half-open, stale verdicts were served, the collect
+              watchdog fired, or jobs sit in poison quarantine. Consumers
+              that ACT on verdicts (operator remediation) must hold off —
+              rolling back a deployment on stale data is worse than
+              waiting a cycle.
+  OVERLOADED  the cycle deadline budget forced load shedding: the brain
+              cannot score the whole fleet inside its cadence. Verdicts
+              that were produced are trustworthy; coverage is not.
+  STALLED     no cycle has completed inside the liveness window — the
+              worker is wedged (hung device, livelocked fetch). /readyz
+              fails so traffic (and peers' adoption scans) route around.
+
+Severity is ordered OK < DEGRADED < OVERLOADED < STALLED; the machine
+reports the worst condition currently true, so DEGRADED→OK recovery is
+automatic one clean cycle after the underlying fault clears — there is no
+latched state to reset.
+
+Exposed as `/readyz` (readiness — distinct from `/healthz` liveness,
+which only answers "is the process up"), in the `/status` health section,
+and as the `foremastbrain:health_state` gauge (0 ok / 1 degraded /
+2 overloaded / 3 stalled) on `/metrics`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["HealthMonitor", "STATE_OK", "STATE_DEGRADED", "STATE_OVERLOADED",
+           "STATE_STALLED", "HEALTH_STATE_VALUES"]
+
+STATE_OK = "ok"
+STATE_DEGRADED = "degraded"
+STATE_OVERLOADED = "overloaded"
+STATE_STALLED = "stalled"
+
+# numeric encoding for the foremastbrain:health_state gauge
+HEALTH_STATE_VALUES = {
+    STATE_OK: 0, STATE_DEGRADED: 1, STATE_OVERLOADED: 2, STATE_STALLED: 3,
+}
+
+
+class HealthMonitor:
+    """Per-cycle degraded-mode signal accumulator + state computation.
+
+    The engine stamps `begin_cycle()`/`end_cycle(...)` around every cycle;
+    readers (`/readyz`, `/status`, the operator's suppression probe) call
+    `state()` at any time. Thread-safe: the engine worker writes, HTTP
+    threads read.
+
+    `breakers_fn` is wired by the runtime to the live breaker boards
+    (data source + archive); standalone analyzers (tests, prewarm) leave
+    it None and the breaker signal simply reads empty.
+    """
+
+    def __init__(self, exporter=None, cycle_seconds: float = 10.0,
+                 stall_grace_seconds: float = 30.0,
+                 clock=time.monotonic):
+        self._lock = threading.Lock()
+        self.exporter = exporter
+        self.cycle_seconds = float(cycle_seconds)
+        # liveness window floor: tiny test cadences must not flag a
+        # perfectly healthy engine STALLED between two instant cycles
+        self.stall_grace_seconds = float(stall_grace_seconds)
+        self._clock = clock
+        self.breakers_fn = None  # () -> {key: "closed"|"half-open"|"open"}
+        self._started_at: float | None = None
+        self._last_cycle_end: float | None = None
+        # last COMPLETED cycle's degraded-mode signals
+        self.last_cycle: dict = {
+            "shed": 0, "stale_served": 0, "watchdog_fires": 0,
+            "quarantined": 0, "deadline_overrun": False,
+        }
+
+    # ------------------------------------------------------------ wiring
+    def configure(self, cycle_seconds: float | None = None,
+                  breakers_fn=None):
+        with self._lock:
+            if cycle_seconds is not None:
+                self.cycle_seconds = float(cycle_seconds)
+            if breakers_fn is not None:
+                self.breakers_fn = breakers_fn
+
+    # --------------------------------------------------------- engine side
+    def begin_cycle(self):
+        with self._lock:
+            if self._started_at is None:
+                self._started_at = self._clock()
+
+    def end_cycle(self, *, shed: int = 0, stale_served: int = 0,
+                  watchdog_fires: int = 0, quarantined: int = 0,
+                  deadline_overrun: bool = False):
+        """Stamp one COMPLETED cycle. The engine calls this only when the
+        cycle returned — a raising cycle leaves the liveness reference
+        untouched, so both a hung cycle and a crash-looping worker age
+        into STALLED (the worker loop swallows exceptions and retries,
+        which would otherwise look exactly like health)."""
+        with self._lock:
+            self._last_cycle_end = self._clock()
+            self.last_cycle = {
+                "shed": int(shed),
+                "stale_served": int(stale_served),
+                "watchdog_fires": int(watchdog_fires),
+                "quarantined": int(quarantined),
+                "deadline_overrun": bool(deadline_overrun),
+            }
+        self._export()
+
+    # --------------------------------------------------------- reader side
+    # first-cycle warmup allowance: before ANY cycle has completed, the
+    # stall window stretches (10x, min 10 minutes) — a cold pod's first
+    # cycle legitimately pays the full compile storm + LSTM warm training
+    # (minutes on CPU without a compile cache), and flagging that STALLED
+    # would make the /readyz readinessProbe pull a healthy warming pod.
+    # A genuinely wedged-from-birth worker still trips it, just later.
+    FIRST_CYCLE_GRACE_FACTOR = 10.0
+    FIRST_CYCLE_GRACE_MIN_S = 600.0
+
+    def _stall_after(self, warming: bool) -> float:
+        """Liveness window: a cycle (plus its deadline slack) must complete
+        inside 3 cadences, floored by the grace so sub-second test cadences
+        don't flap; stretched while the first cycle is still warming up."""
+        base = max(3.0 * self.cycle_seconds, self.stall_grace_seconds)
+        if warming:
+            return max(self.FIRST_CYCLE_GRACE_FACTOR * base,
+                       self.FIRST_CYCLE_GRACE_MIN_S)
+        return base
+
+    def state(self, now: float | None = None) -> tuple[str, dict]:
+        """(state, detail). Worst-condition-wins; detail names every
+        contributing signal so the runbook's "which knob moves it"
+        question is answerable from the payload alone."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            last = dict(self.last_cycle)
+            started = self._started_at
+            last_end = self._last_cycle_end
+            breakers_fn = self.breakers_fn
+        open_breakers = []
+        if breakers_fn is not None:
+            try:
+                open_breakers = sorted(
+                    k for k, s in breakers_fn().items() if s != "closed")
+            except Exception:  # noqa: BLE001 - a probe must never raise
+                open_breakers = []
+        detail = dict(last)
+        detail["open_breakers"] = open_breakers
+        # STALLED: the engine has started cycling but nothing COMPLETED
+        # inside the liveness window. The reference is the last completed
+        # cycle (first begin before any completes), so it covers every
+        # wedge shape the same way: hung mid-cycle, crash-looping (raises
+        # each cadence — those never stamp end_cycle), or a dead worker.
+        stall_after = self._stall_after(warming=last_end is None)
+        reference = last_end if last_end is not None else started
+        if reference is not None and now - reference > stall_after:
+            detail["seconds_since_cycle"] = round(now - reference, 3)
+            return STATE_STALLED, detail
+        # OVERLOADED means coverage was actually cut (jobs shed). A cycle
+        # that merely OVERRAN the budget without shedding (scoring ran
+        # long after every fetch landed) produced full, fresh coverage —
+        # that is a capacity warning (`deadline_overrun` in the detail),
+        # not a reason to fail readiness or hold remediation.
+        if last["shed"] > 0:
+            return STATE_OVERLOADED, detail
+        if (open_breakers or last["stale_served"] > 0
+                or last["watchdog_fires"] > 0 or last["quarantined"] > 0):
+            return STATE_DEGRADED, detail
+        return STATE_OK, detail
+
+    # ------------------------------------------------------------- export
+    def _export(self):
+        if self.exporter is None:
+            return
+        state, _ = self.state()
+        self.exporter.record_gauge(
+            "foremastbrain:health_state", {},
+            HEALTH_STATE_VALUES[state],
+            help="degraded-mode health state: 0 ok, 1 degraded, "
+                 "2 overloaded, 3 stalled")
+
+    def refresh_metrics(self):
+        """Re-stamp the health gauge at scrape time (the STALLED
+        transition has no end_cycle() to fire it — a wedged worker is
+        exactly the case where nothing else would export)."""
+        self._export()

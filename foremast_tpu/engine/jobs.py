@@ -121,6 +121,13 @@ class Document:
     # mirror; the mark is the cut version's own stamp, never time.time(),
     # so a concurrent modification can't make a stale record look fresh).
     archived_at: float = 0.0
+    # graceful-shutdown handoff mark: a draining runtime stamps this on
+    # every open job it releases (release_leases) before its final mirror
+    # flush. A peer's adopt_stale_from_archive treats a released record as
+    # immediately adoptable — no MAX_STUCK_IN_SECONDS wait — because the
+    # owner EXPLICITLY surrendered the lease rather than going silent.
+    # Cleared the moment any worker (re)claims the job.
+    released_at: float = 0.0
 
     def to_json(self) -> dict:
         # hand-rolled (not dataclasses.asdict, which recurses + deepcopies):
@@ -151,12 +158,15 @@ class Document:
             "lease_holder": self.lease_holder,
             "lease_at": self.lease_at,
             "archived_at": self.archived_at,
+            "released_at": self.released_at,
         }
 
     @classmethod
     def from_json(cls, d: dict) -> "Document":
         d = dict(d)
         d["metrics"] = {k: MetricQueries(**v) for k, v in d.get("metrics", {}).items()}
+        # forward-compat: pre-released_at snapshots/archives load with the
+        # default (0.0 = never released)
         return cls(**d)
 
 
@@ -302,10 +312,60 @@ class JobStore:
                 doc.lease_holder = worker
                 doc.lease_at = now
                 doc.modified_at = now
+                doc.released_at = 0.0  # claimed again: handoff mark expires
                 out.append(doc)
             if out:
                 self._persist()
         return out
+
+    def release_leases(self, worker: str = "") -> int:
+        """Graceful-shutdown handoff: surrender every open lease.
+
+        In-progress jobs drop back to INITIAL (reprocess-from-scratch, the
+        same semantics a lease steal applies) and every open job is
+        stamped released_at=now, so a peer's adopt_stale_from_archive
+        takes them over IMMEDIATELY instead of waiting out the
+        MAX_STUCK_IN_SECONDS window. Status rewinds bypass the transition
+        table deliberately — this is the store's own shutdown protocol,
+        equivalent to the takeover path's reset, not an engine-visible
+        verdict transition. Returns the number of jobs released."""
+        now = time.time()
+        released = 0
+        with self._lock:
+            for doc in self._jobs.values():
+                if doc.status not in OPEN_STATUSES:
+                    continue
+                if doc.status in INPROGRESS_STATUSES:
+                    doc.status = INITIAL
+                    # only the docs actually rewound get the handoff
+                    # reason; INITIAL docs keep whatever diagnostic the
+                    # engine last stamped (stale-verdict age, quarantine
+                    # countdown, shed note) — a rolling restart must not
+                    # wipe the fleet's degraded-mode reasons
+                    if worker:
+                        doc.reason = f"released by {worker} shutdown"
+                doc.lease_holder = ""
+                doc.released_at = now
+                doc.modified_at = now
+                released += 1
+            if released:
+                # shutdown is the mirror's last chance: docs parked in
+                # failure backoff re-enter the next cut so the drain can
+                # push the handoff stamps (one attempt each — the drain's
+                # progress check still bounds a dead archive)
+                self._mirror_backoff.clear()
+                self._persist()
+        return released
+
+    def archive_dirty_count(self) -> int:
+        """Docs whose newest version the archive has not confirmed yet —
+        the write-behind backlog a graceful shutdown drains to zero.
+        Always 0 without an archive (there is nothing to drain into)."""
+        if self.archive is None:
+            return 0
+        with self._lock:
+            return sum(1 for doc in self._jobs.values()
+                       if doc.archived_at < doc.modified_at)
 
     def advance(self, job_id: str, *statuses: str, worker: str = "") -> Document:
         """Apply a chain of transitions under ONE lock acquisition.
@@ -753,7 +813,14 @@ class JobStore:
                 doc = Document.from_json(rec)
             except (TypeError, ValueError):
                 continue  # malformed/foreign record: not adoptable
-            if (now - max(doc.lease_at, doc.modified_at)
+            # a gracefully-released record (release_leases stamped it on
+            # shutdown, and nothing claimed it since) is adoptable NOW —
+            # the owner surrendered the lease explicitly, so waiting out
+            # the stuck window would only delay the takeover it asked for
+            released = (doc.released_at > 0
+                        and doc.released_at >= doc.lease_at)
+            if not released and (
+                    now - max(doc.lease_at, doc.modified_at)
                     <= max_stuck_seconds + skew_margin_seconds):
                 continue  # the owner is (or was recently) alive
             with self._lock:

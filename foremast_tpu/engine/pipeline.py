@@ -207,27 +207,54 @@ class CyclePipeline:
                    "bivariate": an._collect_bivariate, "hpa": an._collect_hpa}
         sync = {"pair": an._score_pairs, "band": an._score_bands,
                 "bivariate": an._score_bivariate, "hpa": an._score_hpa}
+        from .analyzer import WatchdogTimeout
+
         t0 = time.perf_counter()
+        # Hung-launch watchdog budget: each materialization (and each
+        # per-job retry below) runs under WATCHDOG_S (no-op when 0), and
+        # the cycle pays for at most TWO timeouts total. One timeout can
+        # be a single poisoned program; a second — from another bucket or
+        # from a fresh sync retry — is device-level evidence, after which
+        # every remaining watchdog-guarded wait is skipped instantly
+        # (buckets fall through to the requeue path). Without the cap, a
+        # wedged device would serialize one full WATCHDOG_S per pending
+        # bucket plus one per retried job into a single cycle.
+        wd0 = an.watchdog_fires_total
+
+        def wedged() -> bool:
+            return an.watchdog_fires_total - wd0 >= 2
+
         # materialize in launch order: completion order is the device's
         # business; claim-order folding happens downstream off keyed dicts
         for family, entries, st in self.pending:
             t1 = time.perf_counter()
             try:
-                results[family].update(collect[family](st))
+                if wedged():
+                    raise WatchdogTimeout(
+                        "device wedged (2+ watchdog timeouts this cycle); "
+                        "bucket skipped")
+                results[family].update(an._watchdog_call(collect[family], st))
             except Exception:  # noqa: BLE001 - deferred device error
                 self.failed.append((family, entries))
             dt = time.perf_counter() - t1
             self.family_seconds[family] = (
                 self.family_seconds.get(family, 0.0) + dt)
         # blast-radius fallback: a failed group retries per JOB through the
-        # family's synchronous scorer (same launch/collect code, barriered)
+        # family's synchronous scorer (same launch/collect code, barriered;
+        # watchdog-bounded under the same two-timeout cycle budget)
         for family, entries in self.failed:
             by_job: dict[str, list] = {}
             for it in self._entry_items(entries):
                 by_job.setdefault(it.job_id, []).append(it)
             for job_id, group in by_job.items():
+                if wedged():
+                    bad[job_id] = ("WatchdogTimeout: device wedged "
+                                   "(2+ watchdog timeouts this cycle); "
+                                   "retry skipped")
+                    continue
                 try:
-                    results[family].update(sync[family](group))
+                    results[family].update(
+                        an._watchdog_call(sync[family], group))
                 except Exception as e:  # noqa: BLE001
                     bad[job_id] = f"{type(e).__name__}: {e}"
         if self.memo is not None:

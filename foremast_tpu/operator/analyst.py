@@ -21,6 +21,7 @@ Three implementations share the mapping:
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 
@@ -104,6 +105,43 @@ class HttpAnalyst:
             hpa_logs=doc.get("hpalogs", []) or [],
         )
 
+    def probe_ready(self) -> tuple[int, dict]:
+        """(http_status, payload) from /readyz. The 503 states
+        (overloaded/stalled) carry their payload in the ERROR response,
+        so this reads HTTPError bodies directly instead of going through
+        _do (which flattens any non-200 into AnalystError and would lose
+        exactly the most-degraded states). Raises AnalystError when the
+        brain is unreachable or answers garbage. Shared transport for
+        the operator's suppression probe AND the `foremast-tpu health`
+        CLI — one copy of the readyz semantics."""
+        url = f"{self.endpoint}/readyz"
+        try:
+            if self.do_func is not None:
+                status, payload = self.do_func("GET", url, None)
+            else:
+                req = urllib.request.Request(url, method="GET")
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=self.timeout) as r:
+                        status, payload = r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    status, payload = e.code, e.read()  # 503 has a body
+            return status, json.loads(payload)
+        except Exception as e:  # noqa: BLE001 - one probe-failure shape
+            raise AnalystError(f"GET {url}: {e}") from e
+
+    def get_health(self) -> str:
+        """Brain degraded-mode state from /readyz (ok / degraded /
+        overloaded / stalled). Raises AnalystError when the brain is
+        unreachable — the CALLER owns the fail-open policy: an
+        overloaded/stalled brain answers 503 on the very probe k8s uses
+        for readiness, so "unreachable" often MEANS "most degraded"
+        (endpoint pulled from the Service), and silently reporting it as
+        "ok" here would dispatch held remediations at the worst moment
+        (see OperatorLoop._probe_health for the bounded hold)."""
+        _, payload = self.probe_ready()
+        return str(payload.get("state", "ok"))
+
 
 class GrpcAnalyst:
     """gRPC sibling of HttpAnalyst (north star: dispatch over gRPC).
@@ -139,6 +177,9 @@ class GrpcAnalyst:
             anomaly=doc.get("anomaly", {}) or {},
             hpa_logs=doc.get("hpalogs", []) or [],
         )
+
+    # no get_health: the gRPC dispatch surface has no readiness RPC; the
+    # operator loop treats an absent probe as "ok" (fail-open)
 
     def close(self):
         self.client.close()
@@ -181,3 +222,9 @@ class InProcessAnalyst:
             anomaly=doc.get("anomaly", {}) or {},
             hpa_logs=doc.get("hpalogs", []) or [],
         )
+
+    def get_health(self) -> str:
+        """Zero-hop readiness probe (service.readyz). Failures propagate
+        like the HTTP analyst's — the operator loop owns the policy."""
+        _, payload = self.service.readyz()
+        return str(payload.get("state", "ok"))

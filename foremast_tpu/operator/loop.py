@@ -24,7 +24,8 @@ log = logging.getLogger("foremast_tpu.operator")
 
 class OperatorLoop:
     def __init__(self, kube, analyst, mode: str = "hpa_and_healthy_monitoring",
-                 hpa_strategy: str = "hpa_exists", watch_namespaces=None):
+                 hpa_strategy: str = "hpa_exists", watch_namespaces=None,
+                 health_probe=None):
         self.kube = kube
         self.barrelman = Barrelman(kube, analyst, mode=mode,
                                    hpa_strategy=hpa_strategy,
@@ -37,16 +38,61 @@ class OperatorLoop:
         self._monitor_phases: dict[tuple, str] = {}
         self._primed = False
         self._stop_requested = False  # signal-handler seam (request_stop)
+        # degraded-mode remediation gate: () -> brain health state
+        # ("ok"/"degraded"/"overloaded"/"stalled"). Defaults to the
+        # analyst's /readyz probe when it has one; absent probe = always
+        # "ok" (fail-open — suppression must never outlive its evidence).
+        if health_probe is None:
+            health_probe = getattr(analyst, "get_health", None)
+        self.health_probe = health_probe
+        self.remediations_suppressed_total = 0
+        self._brain_health = "ok"  # probed once per tick
+        self._health_unreachable_since: float | None = None
+        # flips currently being held: one event + one count per flip,
+        # however many ticks the brain stays degraded (cleared when the
+        # remediation finally dispatches or the phase recovers)
+        self._suppressed_flips: set[tuple] = set()
+
+    # how long a last-known NON-ok brain state keeps suppressing after the
+    # probe goes unreachable. An overloaded/stalled brain fails /readyz —
+    # the same probe k8s readiness uses — so its Service endpoint drops
+    # and the operator's probe sees connection-refused at exactly the
+    # moment suppression matters most; naive fail-open there would
+    # dispatch the held rollback on the worst data. Bounded so a brain
+    # that dies for good cannot suppress remediation forever.
+    HEALTH_HOLD_S = 300.0
 
     def tick(self, now: float | None = None) -> dict:
         """One full reconcile pass. Returns the status sweep's touches."""
         now = time.time() if now is None else now
+        self._brain_health = self._probe_health(now)
         self._diff_deployments()
         self._diff_hpas()
         touched = self.barrelman.check_running_status(now)
         self._sweep_monitors()
         self._primed = True
         return touched
+
+    def _probe_health(self, now: float) -> str:
+        if self.health_probe is None:
+            return "ok"
+        try:
+            state = str(self.health_probe())
+        except Exception:  # noqa: BLE001 - probe boundary
+            # unreachable. Last seen healthy -> fail open (an unreachable
+            # brain produced no NEW verdict flips, and failing closed
+            # would let a dead endpoint suppress remediation forever).
+            # Last seen NON-ok -> hold that state for a bounded window:
+            # unreachability right after a degraded reading is usually
+            # the readiness gate pulling the pod, not recovery.
+            if self._brain_health != "ok":
+                if self._health_unreachable_since is None:
+                    self._health_unreachable_since = now
+                if now - self._health_unreachable_since <= self.HEALTH_HOLD_S:
+                    return self._brain_health
+            return "ok"
+        self._health_unreachable_since = None
+        return state
 
     # -- deployments --
     def _diff_deployments(self):
@@ -140,6 +186,26 @@ class OperatorLoop:
             key = (m.namespace, m.name)
             old_phase = self._monitor_phases.get(key)
             if m.status.phase == PHASE_UNHEALTHY and old_phase != PHASE_UNHEALTHY:
+                if self._brain_health != "ok":
+                    # degraded-mode suppression: while the brain reports
+                    # DEGRADED/OVERLOADED/STALLED its verdicts may rest on
+                    # stale or shed data — rolling a deployment back on
+                    # them is the one failure mode worse than no verdict.
+                    # The phase is NOT advanced, so the flip re-dispatches
+                    # the first tick the brain is healthy again; the event
+                    # and counter fire once per HELD FLIP, not per tick (a
+                    # half-hour degradation must not emit 180 duplicates).
+                    if key not in self._suppressed_flips:
+                        self._suppressed_flips.add(key)
+                        self.remediations_suppressed_total += 1
+                        self.kube.record_event(
+                            "DeploymentMonitor", m.namespace, m.name,
+                            "RemediationSuppressed",
+                            f"brain health is {self._brain_health}; "
+                            "holding rollback/pause until it recovers",
+                        )
+                    continue
+                self._suppressed_flips.discard(key)
                 prev = None
                 if old_phase is not None:
                     prev = copy.deepcopy(m)
@@ -156,6 +222,8 @@ class OperatorLoop:
                         "RemediationError", str(e)
                     )
                     continue
+            if m.status.phase != PHASE_UNHEALTHY:
+                self._suppressed_flips.discard(key)  # flip resolved itself
             self._monitor_phases[key] = m.status.phase
 
     def request_stop(self):

@@ -257,9 +257,16 @@ def wilcoxon_signed_rank(x, x_mask, y, y_mask):
     Pairs are valid where both masks hold; zero differences are dropped
     (wilcox zero method). Returns (W, pvalue) with W = min(T+, T-).
     p-value: the EXACT null when the sample is untied, zero-free, and
-    n <= WILCOXON_EXACT_MAX_N — mirroring scipy's auto dispatch — else
-    the tie-corrected normal approximation computed from T+ (scipy
-    "approx", which scipy auto also selects whenever ties/zeros exist).
+    n <= WILCOXON_EXACT_MAX_N, else the tie-corrected normal
+    approximation computed from T+ (scipy method="approx"). Note on
+    scipy parity: scipy >= 1.13's AUTO dispatch selects the exact null
+    for n <= 50 even WITH ties — an exact distribution that assumes
+    distinct ranks, fed a midrank statistic (scipy's own docs call the
+    exact method inappropriate for ties). This kernel deliberately keeps
+    the tie-corrected approximation for tied samples — the defensible
+    branch, and what the reference brain's scipy-1.x era default did —
+    so tied-window parity is pinned against scipy method="approx"
+    (tests/test_pairwise_parity.py), not auto.
     """
     both = x_mask & y_mask
     d = jnp.where(both, x.astype(_F) - y.astype(_F), 0.0)

@@ -21,6 +21,15 @@ FOREMAST_CHAOS grammar (full reference: docs/resilience.md):
              | 'outage'  '=' FROM '..' TO    every call in [FROM, TO)
                                              (0-based call index) fails —
                                              the "error burst" primitive
+             | 'spike'   '=' FROM '..' TO ':' SECS
+                                             latency spike window: every
+                                             call in [FROM, TO) sleeps
+                                             SECS then SUCCEEDS (the
+                                             slow-then-healthy backend)
+             | 'hang'    '=' PROB ':' SECS   hung socket: the call holds
+                                             for SECS — the transport
+                                             timeout, nothing returned
+                                             sooner — then fails
 
     example: "seed=42;fetch.error=0.3;fetch.latency=0.2:0.05;archive.outage=40..80"
 
@@ -78,11 +87,19 @@ class FaultPlan:
     flap_up: int = 0
     flap_down: int = 0
     outages: list = field(default_factory=list)  # [(from_call, to_call)]
+    # latency-spike windows: [(from_call, to_call, seconds)] — every call
+    # in the window sleeps, then succeeds (slow-then-healthy)
+    spikes: list = field(default_factory=list)
+    # hung sockets: hold for hang_seconds (the caller's transport timeout
+    # — nothing comes back sooner), then fail
+    hang_rate: float = 0.0
+    hang_seconds: float = 0.0
 
     def active(self) -> bool:
         return bool(
             self.error_rate or self.latency_rate or self.timeout_rate
             or self.garbage_rate or self.flap_down or self.outages
+            or self.spikes or self.hang_rate
         )
 
 
@@ -133,6 +150,14 @@ def parse_chaos_spec(spec: str) -> tuple[int, dict[str, FaultPlan]]:
             if not sep2:
                 raise ValueError(f"outage needs FROM..TO, got {value!r}")
             plan.outages.append((int(lo), int(hi)))
+        elif fault == "spike":
+            window, sep3, secs = value.partition(":")
+            lo, sep2, hi = window.partition("..")
+            if not sep2 or not sep3:
+                raise ValueError(f"spike needs FROM..TO:SECONDS, got {value!r}")
+            plan.spikes.append((int(lo), int(hi), float(secs)))
+        elif fault == "hang":
+            plan.hang_rate, plan.hang_seconds = _parse_pair(value, fault)
         else:
             raise ValueError(f"chaos clause {clause!r}: unknown fault {fault!r}")
     return seed, plans
@@ -179,11 +204,31 @@ class FaultInjector:
                 if (i % period) >= p.flap_up:
                     self.injected_errors += 1
                     return ERROR
+            # latency-spike window: slow-then-succeed, deterministically —
+            # the backend that answers correctly but late, the shape retry
+            # storms and cycle overruns are made of. Consumes no
+            # randomness, so adding a spike clause never shifts the
+            # stream's other decisions.
+            spike_secs = 0.0
+            for lo, hi, secs in p.spikes:
+                if lo <= i < hi:
+                    spike_secs = secs
+                    break
             # randomized faults, drawn in a fixed order so the stream is
-            # stable under a fixed plan
+            # stable under a fixed plan (a zero-rate fault draws nothing).
+            # A spike window layers its latency ON TOP of whatever the
+            # chain decides (it consumes no randomness and skips none, so
+            # adding a spike clause never shifts any other decision —
+            # before, inside, or after the window); on a plan with no
+            # other faults that is exactly slow-then-succeed.
             delay = 0.0
             outcome = OK
-            if p.timeout_rate > 0 and self._rng.random() < p.timeout_rate:
+            if p.hang_rate > 0 and self._rng.random() < p.hang_rate:
+                # hung socket: the call HOLDS for the full transport
+                # timeout — no bytes, no early error — then fails
+                delay = p.hang_seconds
+                outcome = ERROR
+            elif p.timeout_rate > 0 and self._rng.random() < p.timeout_rate:
                 delay = p.timeout_seconds
                 outcome = ERROR
             elif p.error_rate > 0 and self._rng.random() < p.error_rate:
@@ -193,6 +238,7 @@ class FaultInjector:
             if outcome == OK and p.latency_rate > 0 \
                     and self._rng.random() < p.latency_rate:
                 delay = p.latency_seconds
+            delay = max(delay, spike_secs)
             if outcome == ERROR:
                 self.injected_errors += 1
             elif outcome == GARBAGE:

@@ -40,6 +40,12 @@ Env surface (union of the reference services'):
   RETRY_* / BREAKER_* /  resilience knobs: retry train, per-window retry
   FETCH_CYCLE_DEADLINE   budget, breaker trip/recovery, per-cycle fetch
                          deadline (engine/config.py, docs/resilience.md)
+  CYCLE_DEADLINE_S /     degraded-mode operation: whole-cycle deadline
+  MAX_STALE_S /          budget with priority-aware load shedding,
+  QUARANTINE_AFTER /     stale-verdict serving bound, poison-job
+  WATCHDOG_S             quarantine, hung-launch watchdog. Health state
+                         machine on /readyz + /status + /metrics
+                         (docs/resilience.md degraded-mode runbook)
   FOREMAST_CHAOS         deterministic fault-injection spec wrapping the
                          raw fetch/archive boundaries — soak runs and the
                          demo turn chaos on without code changes
@@ -209,6 +215,22 @@ class Runtime:
         self.analyzer = Analyzer(
             self.config, self.source, self.store, exporter=self.exporter
         )
+        # health state machine wiring (engine/health.py): merge every live
+        # breaker board (data source + archive) into the DEGRADED signal;
+        # cycle cadence lands in start() where it is known
+        boards = []
+        if self.resilience is not None:
+            boards.append(self.resilience.breakers)
+        if archive is not None and hasattr(archive, "breakers"):
+            boards.append(archive.breakers)
+        if boards:
+            def _breaker_states(_boards=tuple(boards)):
+                states = {}
+                for b in _boards:
+                    states.update(b.states())
+                return states
+
+            self.analyzer.health.configure(breakers_fn=_breaker_states)
         # LSTM model-cache warm-start (LSTM_CACHE_PATH): trained AE params
         # persist across restarts so a bounced pod skips the budgeted
         # re-training warm-up for every known app
@@ -231,6 +253,8 @@ class Runtime:
         self._stop_requested = False  # signal-handler seam (request_stop)
         self._stopped = False
         self._threads: list[threading.Thread] = []
+        self._worker_thread: threading.Thread | None = None
+        self._worker_name = "worker-0"
         self._server = None
         self._grpc_server = None
         self.grpc_bound_port: int | None = None
@@ -247,6 +271,8 @@ class Runtime:
         grpc_bound_port); None disables the gRPC front. The admission-gate
         knobs default to the service layer's own defaults when None (env
         parsing lives in main(), like every other runtime knob)."""
+        self.cycle_seconds = cycle_seconds
+        self.analyzer.health.configure(cycle_seconds=cycle_seconds)
         http_kw = {} if http_max_inflight is None else {
             "max_in_flight": http_max_inflight}
         self._server = make_server(self.service, host, port, **http_kw)
@@ -267,6 +293,8 @@ class Runtime:
             target=self._worker_loop, args=(cycle_seconds, worker), daemon=True
         )
         t_eng.start()
+        self._worker_thread = t_eng
+        self._worker_name = worker
         self._threads = [t_http, t_eng]
         if self.config.prewarm_on_start:
             # background prewarm (PREWARM_ON_START): compile the standard
@@ -335,15 +363,59 @@ class Runtime:
         requests."""
         self._stop_requested = True
 
-    def stop(self):
+    def stop(self, drain_seconds: float | None = None):
+        """Graceful shutdown: drain, hand off, then exit.
+
+        1. The in-flight engine cycle finishes (bounded by the degraded-
+           mode deadline budget — a cycle that honors CYCLE_DEADLINE_S
+           cannot hold shutdown hostage past it).
+        2. The HTTP/gRPC fronts stop accepting work.
+        3. Every open job's lease is RELEASED (released_at handoff mark)
+           and the archive write-behind backlog drains, so a peer's
+           adopt_stale_from_archive takes the fleet over immediately
+           instead of waiting out MAX_STUCK_IN_SECONDS.
+        4. The store closes (final snapshot flush).
+        """
         if self._stopped:
             return
         self._stopped = True
         self._stop.set()
+        if drain_seconds is None:
+            drain_seconds = max(self.config.cycle_deadline_seconds,
+                                self.config.fetch_cycle_deadline_seconds,
+                                5.0)
+        t = self._worker_thread
+        if (t is not None and t.is_alive()
+                and t is not threading.current_thread()):
+            t.join(timeout=drain_seconds)
+            if t.is_alive():
+                log.warning("engine cycle did not drain within %.1fs; "
+                            "proceeding with shutdown", drain_seconds)
         if self._server is not None:
             self._server.shutdown()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=2.0)
+        if self.store.archive is not None:
+            released = self.store.release_leases(worker=self._worker_name)
+            if released:
+                log.info("released %d open lease(s) for peer adoption",
+                         released)
+            # drain the write-behind mirror: the release stamps above (and
+            # any backlog) must actually REACH the archive for a peer to
+            # adopt them. Bounded two ways: the drain budget, and a
+            # PROGRESS check — when a flush leaves the dirty count where
+            # it was (archive down, or docs the archive rejects), more
+            # flushes are no-ops and shutdown must not spin them until
+            # the deadline.
+            deadline = time.time() + drain_seconds
+            prev = None
+            while time.time() < deadline:
+                n = self.store.archive_dirty_count()
+                if n == 0 or (prev is not None and n >= prev):
+                    break
+                prev = n
+                self.store.flush()
+                time.sleep(0.05)
         self.store.close()
 
     def run_forever(self, **kw):
@@ -443,9 +515,12 @@ def main():
 
     import signal
 
-    # K8s terminates pods with SIGTERM: exit the wait loop and run the
-    # full stop() path (final snapshot flush) instead of dying mid-write
+    # K8s terminates pods with SIGTERM (and operators ^C with SIGINT):
+    # exit the wait loop and run the full graceful stop() path — drain
+    # the in-flight cycle, release leases + flush the archive mirror for
+    # immediate peer adoption, final snapshot — instead of dying mid-write
     signal.signal(signal.SIGTERM, lambda *_: rt.request_stop())
+    signal.signal(signal.SIGINT, lambda *_: rt.request_stop())
     log.info(
         "serving :%d%s, cycle=%ss",
         port, f" grpc :{grpc_port}" if grpc_port else "", cycle,

@@ -7,8 +7,12 @@ Endpoints (reference: foremast-service/cmd/manager/main.go:326-346):
   GET  /api/v1/<queryproxy>?...        CORS proxy to the metric store
   GET  /metrics                        foremastbrain:* verdict series
   GET  /status                         degradation view: job counts +
-                                       breaker states + retry counters
-  GET  /healthz                        liveness
+                                       breaker states + retry counters +
+                                       health state machine
+  GET  /healthz                        liveness (is the process up)
+  GET  /readyz                         readiness: the degraded-mode health
+                                       state (ok/degraded -> 200,
+                                       overloaded/stalled -> 503)
 
 Behavior contracts preserved:
   * job ids — HMAC-SHA256 over the canonical request; HPA jobs get the
@@ -460,6 +464,18 @@ class ForemastService:
                 f"{getattr(self.store.archive, 'compactions_skipped_unlocked', 0)}"
             )
         if self.analyzer is not None:
+            # degraded-mode gauges: the counters themselves
+            # (jobs_shed_total, stale_verdicts_served_total,
+            # watchdog_fires_total, jobs_quarantined_total, health_state)
+            # live on the exporter registry and render above; the live
+            # park count is a point-in-time gauge stamped per scrape
+            health = getattr(self.analyzer, "health", None)
+            if health is not None:
+                health.refresh_metrics()
+            lines.append(
+                "foremastbrain:quarantined_jobs "
+                f"{self.analyzer.quarantined_count()}"
+            )
             # rising skips = the LSTM train-on-miss budget is too small for
             # the fleet's identity churn (jobs stuck warming up); zero =
             # multi-metric jobs are simply in progress
@@ -560,12 +576,32 @@ class ForemastService:
                 "misses": self.cache_source.misses,
                 "single_flight_waits": self.cache_source.single_flight_waits,
             }
+        health = getattr(self.analyzer, "health", None)
+        if health is not None:
+            state, detail = health.state()
+            out["health"] = {"state": state, **detail}
+            if state != "ok":
+                out["status"] = "degraded"
         if self.resilience is not None:
             snap = self.resilience.snapshot()
             out["resilience"] = snap
             if any(state != "closed" for state in snap["breakers"].values()):
                 out["status"] = "degraded"
         return 200, out
+
+    def readyz(self):
+        """GET /readyz — readiness, distinct from /healthz liveness.
+
+        ok/degraded answer 200 (the brain is serving, possibly on
+        second-class verdicts — consumers read `state` to decide how much
+        to trust them); overloaded/stalled answer 503 so load balancers
+        and peers route around a brain that is shedding or wedged."""
+        health = getattr(self.analyzer, "health", None)
+        if health is None:
+            return 200, {"state": "ok", "detail": {}}
+        state, detail = health.state()
+        code = 200 if state in ("ok", "degraded") else 503
+        return code, {"state": state, "detail": detail}
 
     def debug_traces(self, limit: int = 50):
         from ..utils.tracing import tracer
@@ -611,6 +647,8 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
             try:
                 if parsed.path == "/healthz":
                     self._send(200, {"status": "ok"})
+                elif parsed.path == "/readyz":
+                    self._send(*service.readyz())
                 elif parsed.path == "/status":
                     self._send(*service.status_summary())
                 elif parsed.path in ("/", "/dashboard") or parsed.path.startswith(

@@ -110,6 +110,11 @@ def test_chaos_soak_engine_survives_seeded_fault_plan(tmp_path):
         fetch_cycle_deadline_seconds=5.0,
         # takeover must not fight the soak's rapid synthetic clock
         max_stuck_seconds=1e9,
+        # this soak pins the PR 1 resilience contract (exhausted fetches
+        # fail canaries terminally); stale-verdict serving — which now
+        # keeps warm canaries alive through exactly these faults — has
+        # its own acceptance soak below
+        max_stale_seconds=0.0,
     )
     analyzer = Analyzer(config, source, store, exporter)
     service = ForemastService(store, exporter=exporter, analyzer=analyzer,
@@ -243,3 +248,112 @@ def test_chaos_soak_is_deterministic_and_breaker_lifecycle_observable(tmp_path):
             '{host="prom:9090",to="open"}') in text
     assert ('foremastbrain:breaker_transitions_total'
             '{host="prom:9090",to="closed"}') in text
+
+
+def test_blackout_serves_stale_verdicts_suppresses_remediation_recovers():
+    """ISSUE 4 acceptance: with the metric source blacked out for 3
+    cycles, warm jobs serve stale verdicts (ZERO UNKNOWN flips,
+    stale_verdicts_served_total > 0), /readyz reports DEGRADED, operator
+    remediation is suppressed — and everything recovers to OK within one
+    cycle of the fault clearing, at which point the held remediation
+    finally dispatches."""
+    from foremast_tpu.operator.analyst import InProcessAnalyst
+    from foremast_tpu.operator.kube import FakeKube
+    from foremast_tpu.operator.loop import OperatorLoop
+    from foremast_tpu.operator.types import (
+        PHASE_UNHEALTHY,
+        DeploymentMonitor,
+        MonitorSpec,
+        MonitorStatus,
+        RemediationAction,
+    )
+    from foremast_tpu.resilience.faults import FaultPlan
+
+    rng = np.random.default_rng(SEED)
+    plan = FaultPlan()  # windows appended live below (the blackout switch)
+    inj = FaultInjector(plan, seed=SEED, target="fetch",
+                        sleep=lambda s: None)
+    fixtures = {}
+    exporter = VerdictExporter()
+    source = ResilientDataSource(
+        FaultyDataSource(FixtureDataSource(fixtures), inj),
+        retry=RetryPolicy(max_attempts=2, base_delay=0.0, seed=SEED,
+                          sleep=lambda s: None),
+        breakers=BreakerBoard(failure_threshold=3, recovery_seconds=0.0),
+        exporter=exporter,
+    )
+    store = JobStore()
+    analyzer = Analyzer(
+        EngineConfig(fetch_concurrency=1, max_stuck_seconds=1e9),
+        source, store, exporter)
+    analyzer.health.configure(breakers_fn=source.breakers.states)
+    service = ForemastService(store, exporter=exporter, analyzer=analyzer,
+                              resilience=source)
+
+    # one canary whose window ENDS mid-blackout (the UNKNOWN-flip victim)
+    # and one continuous monitor (the verdict-flap victim)
+    _mk_job(store, fixtures, "canary", bad=False, continuous=False,
+            end_time=140.0, rng=rng)
+    _mk_job(store, fixtures, "watch", bad=False, continuous=True,
+            end_time=0.0, rng=rng)
+
+    # warm cycles: both jobs judged on fresh data
+    analyzer.run_cycle(worker="w", now=100.0)
+    analyzer.run_cycle(worker="w", now=110.0)
+    code, body = service.readyz()
+    assert code == 200 and body["state"] == "ok"
+
+    # an unhealthy monitor flip arrives while the brain is degraded: the
+    # operator must HOLD remediation, not roll back on stale data
+    kube = FakeKube()
+    kube.deployments[("default", "demo")] = {
+        "metadata": {"name": "demo", "namespace": "default",
+                     "labels": {"app": "demo"}},
+        "spec": {"selector": {"matchLabels": {"app": "demo"}},
+                 "template": {"spec": {"containers": []}}},
+    }
+    kube.upsert_monitor(DeploymentMonitor(
+        name="demo", namespace="default",
+        annotations={"deployment.foremast.ai/name": "demo"},
+        spec=MonitorSpec(remediation=RemediationAction(option="AutoPause")),
+        status=MonitorStatus(phase=PHASE_UNHEALTHY),
+    ))
+    loop = OperatorLoop(kube, InProcessAnalyst(service))
+
+    # -- blackout: every fetch from here fails, for 3 cycles --
+    plan.outages.append((inj.calls, 10 ** 9))
+    for now in (120.0, 130.0, 140.0):
+        outcomes = analyzer.run_cycle(worker="w", now=now)
+        assert J.COMPLETED_UNKNOWN not in outcomes.values(), (now, outcomes)
+    # the canary's window closed at 140 mid-blackout: completed on its
+    # last fresh verdict instead of flipping COMPLETED_UNKNOWN
+    assert store.get("canary").status == J.COMPLETED_HEALTH
+    assert "stale verdict" in store.get("canary").reason
+    # the monitor keeps cycling (parked for retry), reason stamped stale
+    assert store.get("watch").status == J.INITIAL
+    assert "stale verdict" in store.get("watch").reason
+    assert analyzer.stale_verdicts_served_total > 0
+    code, body = service.readyz()
+    assert code == 200 and body["state"] == "degraded"
+    assert body["detail"]["open_breakers"]  # the blacked-out source
+    code, text = service.metrics()
+    assert "foremastbrain:stale_verdicts_served_total" in text
+    assert "foremastbrain:health_state" in text
+
+    loop.tick()
+    m = kube.get_monitor("default", "demo")
+    assert not m.status.remediation_taken
+    assert kube.patches == []
+    assert any(e["reason"] == "RemediationSuppressed" for e in kube.events)
+    assert loop.remediations_suppressed_total == 1
+
+    # -- fault clears: one clean cycle returns the brain to OK --
+    plan.outages.clear()
+    analyzer.run_cycle(worker="w", now=150.0)
+    code, body = service.readyz()
+    assert code == 200 and body["state"] == "ok", body
+    # the held flip now dispatches: remediation applies exactly once
+    loop.tick()
+    m = kube.get_monitor("default", "demo")
+    assert m.status.remediation_taken
+    assert any(kind == "deployment" for kind, *_ in kube.patches)

@@ -182,7 +182,9 @@ def test_e2e_fetch_failure_marks_preprocess_failed():
     store.create(doc)
     analyzer = Analyzer(EngineConfig(), FixtureDataSource({}), store)
     out = analyzer.run_cycle()
-    assert out == {}  # failed in preprocess, not judged
+    # failed in preprocess, never judged — and the outcome is REPORTED
+    # (degraded-mode bookkeeping prunes warm state off these outcomes)
+    assert out == {"j": J.PREPROCESS_FAILED}
     assert store.get("j").status == J.PREPROCESS_FAILED
     assert J.to_external(store.get("j").status) == "abort"
 

@@ -51,15 +51,35 @@ def test_mann_whitney(seed, ties, shift):
 @pytest.mark.parametrize("ties", [False, True])
 @pytest.mark.parametrize("shift", [0.0, 1.0])
 def test_wilcoxon(seed, ties, shift):
-    """Parity against scipy's AUTO dispatch: exact null for untied,
-    zero-free n <= 50 (where the engine's live windows sit and the
-    normal approximation drifts up to ~0.02), approx beyond/with ties."""
+    """Parity against the branch the kernel documents: exact null for
+    untied, zero-free n <= 50 (where the engine's live windows sit and
+    the normal approximation drifts up to ~0.02), TIE-CORRECTED normal
+    approximation with ties.
+
+    Tied windows pin scipy method='approx', not the default auto
+    dispatch. Root cause of the former 14 red cases: scipy >= 1.13
+    changed auto to select the EXACT null for n <= 50 even when ties are
+    present — an exact distribution derived assuming distinct ranks, fed
+    a midrank statistic (scipy documents the exact method as "not
+    appropriate" for ties; older scipy, and the reference brain's
+    scipy-1.x era default, used the normal approximation there). Our
+    kernel keeps the tie-corrected approximation — the statistically
+    defensible branch for tied data and the reference-era behavior — and
+    matches scipy's own approx method to float32 precision, so the test
+    now pins THAT equivalence instead of chasing scipy's auto heuristic
+    across versions."""
     x, xm, y, ym = _windows(seed, ties=ties, shift=shift)
     both = xm & ym
     W, p = wilcoxon_signed_rank(x, xm, y, ym)
-    d = (x - y)[both]
-    d = d[d != 0]
-    ref = sps.wilcoxon(d, zero_method="wilcox", correction=False)
+    d_all = (x - y)[both]
+    d = d_all[d_all != 0]
+    # the kernel's documented branch condition: exact only for untied,
+    # zero-free samples (n <= WILCOXON_EXACT_MAX_N); ties among |d| or
+    # dropped zero pairs select the tie-corrected approximation
+    approx = (len(d) < len(d_all)
+              or len(np.unique(np.abs(d))) < len(d))
+    ref = sps.wilcoxon(d, zero_method="wilcox", correction=False,
+                       method="approx" if approx else "auto")
     np.testing.assert_allclose(float(W), ref.statistic, rtol=1e-5)
     np.testing.assert_allclose(float(p), ref.pvalue, atol=ATOL, rtol=1e-3)
 

@@ -1,0 +1,148 @@
+"""Live-runtime chaos soak (`make soak`, ISSUE 4): a short (<120 s) soak
+against a REAL runtime process — HTTP server, worker loop, exporter — with
+the new chaos fault shapes (latency spikes, hung sockets, an outage burst)
+injected under the resilience layer. Asserts the health state machine
+degrades and recovers END TO END over the wire (/readyz), stale verdicts
+are served during the blackout, and graceful shutdown drains cleanly with
+the lease handoff mirrored for peer adoption.
+
+Marked slow+chaos so tier-1 (-m 'not slow') stays fast.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from foremast_tpu.dataplane import FixtureDataSource
+from foremast_tpu.engine import Document, EngineConfig, MetricQueries
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.archive import FileArchive
+from foremast_tpu.runtime import Runtime
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+SEED = 20260805
+STEP = 60
+
+# warm cycles first (calls 0..29), then a hard blackout long enough to
+# span several cycles, plus latency spikes early and a low-rate hung
+# socket throughout — the two new fault shapes, live
+CHAOS_SPEC = (
+    f"seed={SEED};"
+    "fetch.spike=0..10:0.01;"
+    "fetch.hang=0.05:0.03;"
+    "fetch.outage=30..110"
+)
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _wait_for(predicate, budget_s, interval=0.1):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _series(rng, level, n):
+    ts = np.arange(n) * STEP
+    vals = np.clip(rng.normal(level, level * 0.1 + 0.01, n), 0, None)
+    return ts.tolist(), vals.tolist()
+
+
+def test_live_runtime_soak_degrades_and_recovers(tmp_path):
+    rng = np.random.default_rng(SEED)
+    threads_before = threading.active_count()
+    fixtures = {}
+    archive = FileArchive(str(tmp_path / "archive.jsonl"))
+    rt = Runtime(
+        config=EngineConfig(
+            fetch_concurrency=2,
+            max_stuck_seconds=1e9,
+            retry_max_attempts=2,
+            retry_base_delay=0.001,
+            retry_max_delay=0.01,
+            # the breaker must keep probing fast enough for the soak's
+            # outage window to be consumed and recovery observed live
+            breaker_failure_threshold=3,
+            breaker_recovery_seconds=0.1,
+            fetch_cycle_deadline_seconds=2.0,
+        ),
+        data_source=FixtureDataSource(fixtures),
+        cache=False,  # the TTL cache would hide the blackout from jobs
+        archive=archive,
+        chaos_spec=CHAOS_SPEC,
+    )
+    for i in range(3):
+        jid = f"watch{i}"
+        cur = f"http://prom:9090/{jid}/cur"
+        hist = f"http://prom:9090/{jid}/hist"
+        fixtures[cur] = _series(rng, 0.5, 30)
+        fixtures[hist] = _series(rng, 0.5, 600)
+        rt.store.create(Document(
+            id=jid, app_name=f"app-{jid}", namespace="soak",
+            strategy="continuous",
+            start_time=to_rfc3339(0.0), end_time="",
+            metrics={"error5xx": MetricQueries(current=cur,
+                                               historical=hist)},
+        ))
+
+    rt.start(host="127.0.0.1", port=0, cycle_seconds=0.2)
+    try:
+        port = rt._server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+
+        def readyz_state():
+            code, payload = _get(f"{base}/readyz")
+            return json.loads(payload)["state"]
+
+        # liveness vs readiness are distinct endpoints
+        code, payload = _get(f"{base}/healthz")
+        assert code == 200
+
+        # phase 1: the blackout (outage calls 30..110) drives the brain
+        # DEGRADED — warm jobs serve stale verdicts instead of flapping
+        assert _wait_for(lambda: readyz_state() == "degraded", 30.0), \
+            readyz_state()
+        code, payload = _get(f"{base}/metrics")
+        text = payload.decode()
+        assert "foremastbrain:stale_verdicts_served_total" in text
+        assert "foremastbrain:health_state" in text
+        assert rt.analyzer.stale_verdicts_served_total > 0
+        # no UNKNOWN flips: every monitor is still cycling
+        for i in range(3):
+            assert rt.store.get(f"watch{i}").status not in (
+                J.COMPLETED_UNKNOWN, J.PREPROCESS_FAILED)
+
+        # the CLI health gate reads the same state over the wire
+        from foremast_tpu.cli import main as cli_main
+
+        assert cli_main(["health", "--endpoint", base]) == 0
+
+        # phase 2: the outage window drains (breaker half-open probes keep
+        # consuming calls) and one clean cycle recovers the brain to OK
+        assert _wait_for(lambda: readyz_state() == "ok", 60.0), \
+            readyz_state()
+    finally:
+        rt.stop(drain_seconds=10.0)
+
+    # graceful shutdown: leases released + mirrored for immediate adoption
+    rec = archive.get("watch0")
+    assert rec is not None and rec["released_at"] > 0
+    # and no wedged threads (the worker, flusher, and server all joined)
+    assert _wait_for(
+        lambda: threading.active_count() <= threads_before + 2, 10.0), \
+        threading.enumerate()
