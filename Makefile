@@ -5,13 +5,21 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos soak clean
+.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos soak clean
 
-test:            ## full suite (CPU, 8 virtual devices via conftest)
+test: lint       ## full suite (CPU, 8 virtual devices via conftest), gated on lint
 	$(PY) -m pytest tests/ -q
 
 test-fast:       ## fail-fast variant for inner loops
 	$(PY) -m pytest tests/ -x -q
+
+lint:            ## invariant lint suite (devtools; docs/development.md) + ruff when installed
+	$(PY) -m foremast_tpu.devtools
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check foremast_tpu tests; \
+	else \
+		echo "ruff not installed; skipped (pyproject [tool.ruff] is the config)"; \
+	fi
 
 native:          ## (re)build the C++ data-plane extension
 	$(CPU_ENV) $(PY) -c "from foremast_tpu import native; assert native.available(), 'build failed'; print(native.lib_path())"

@@ -1,3 +1,4 @@
+# lint: disable-file=knob-registry -- bench-only BENCH_* knobs, not a deployment surface (docs/benchmarks.md)
 """Host-path cycle benchmark: fetch -> parse -> resample -> pack -> score -> verdict.
 
 The device kernel's pairs/s (bench.py headline) bounds only the score

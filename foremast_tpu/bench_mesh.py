@@ -1,3 +1,4 @@
+# lint: disable-file=knob-registry -- bench-only BENCH_* knobs, not a deployment surface (docs/benchmarks.md)
 """Virtual-mesh measurement of the fleet scorer's collective tail.
 
 The 100k-pair headline pro-rates one chip's shard across a v5e-8 on the

@@ -28,8 +28,9 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
+
+from .utils import knobs
 
 
 def _kube():
@@ -86,8 +87,7 @@ def build_operator_loop(args, kube=None):
 
         cfg = from_env()
         kube = _kube()
-        inj = safe_injectors(
-            os.environ.get("FOREMAST_CHAOS", "")).get("kube")
+        inj = safe_injectors(knobs.read("FOREMAST_CHAOS")).get("kube")
         if inj is not None:
             from .resilience.faults import FaultyKube
 
@@ -105,24 +105,24 @@ def build_operator_loop(args, kube=None):
             ),
         )
 
-    endpoint = args.analyst or os.environ.get("ANALYST_ENDPOINT", "")
+    endpoint = args.analyst or knobs.read("ANALYST_ENDPOINT")
     transport = (
         getattr(args, "analyst_transport", "")
-        or os.environ.get("ANALYST_TRANSPORT", "")
+        or knobs.read("ANALYST_TRANSPORT")
     )
     analyst = make_analyst(endpoint, transport)
-    watch = [n.strip() for n in os.environ.get("WATCH_NAMESPACES", "").split(",")
+    watch = [n.strip() for n in knobs.read("WATCH_NAMESPACES").split(",")
              if n.strip()]
     loop = OperatorLoop(
         kube,
         analyst,
-        mode=os.environ.get("MODE", "hpa_and_healthy_monitoring"),
-        hpa_strategy=os.environ.get("HPA_STRATEGY", "hpa_exists"),
+        mode=knobs.read("MODE"),
+        hpa_strategy=knobs.read("HPA_STRATEGY"),
         watch_namespaces=watch or None,
     )
     # NAMESPACE keeps the reference's meaning (Barrelman.go:402): where the
     # deployment-metadata-default fallback record lives
-    ns = os.environ.get("OPERATOR_NAMESPACE") or os.environ.get("NAMESPACE", "")
+    ns = knobs.read("OPERATOR_NAMESPACE") or knobs.read("NAMESPACE")
     if ns:
         loop.barrelman.operator_namespace = ns
     desc = f"analyst={type(analyst).__name__}({endpoint or 'default'})"
@@ -133,7 +133,7 @@ def cmd_operator(args) -> int:
     import signal
 
     loop, desc = build_operator_loop(args)
-    tick = float(os.environ.get("TICK_SECONDS", "10"))
+    tick = knobs.read("TICK_SECONDS")
     # pod termination finishes the current tick instead of cutting a
     # remediation in half (SIGTERM -> graceful loop exit)
     signal.signal(signal.SIGTERM, lambda *_: loop.request_stop())
@@ -225,7 +225,7 @@ def cmd_health(args) -> int:
     operator's remediation-suppression gate."""
     from .operator.analyst import AnalystError, HttpAnalyst
 
-    endpoint = (args.endpoint or os.environ.get("ANALYST_ENDPOINT", "")
+    endpoint = (args.endpoint or knobs.read("ANALYST_ENDPOINT")
                 or "http://localhost:8099")
     analyst = HttpAnalyst(endpoint, timeout=5.0)
     try:

@@ -53,6 +53,7 @@ from ..ops.windowing import (
     resample_to_grid,
 )
 from .fetch import TS_SPAN_CAP, grid_from_series
+from ..utils.locks import make_lock
 
 __all__ = ["DeltaWindowSource", "strip_range_params", "parse_range_params"]
 
@@ -166,7 +167,7 @@ class DeltaWindowSource:
         self.overlap_steps = max(int(overlap_steps), 1)
         self.step = int(step)
         self._cache: OrderedDict[str, _Entry] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("dataplane.delta.cache")
         # splice/grid work is pure Python+numpy on small arrays: the GIL
         # serializes it anyway, but letting the engine's 16 fetch threads
         # CONTEND for it causes a switch convoy (measured ~49 ms/fetch at
@@ -174,7 +175,7 @@ class DeltaWindowSource:
         # lock makes threads queue on a futex instead; only the inner
         # (network) fetch runs outside it, which is the part that
         # genuinely parallelizes.
-        self._cpu_lock = threading.Lock()
+        self._cpu_lock = make_lock("dataplane.delta.splice_cpu")
         # observability (served on /metrics and /status)
         self.delta_hits = 0        # spliced windows
         self.full_fetches = 0      # misses + fallbacks + non-capable URLs

@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..utils.locks import make_lock
 from ..utils.promtext import escape_label_value as _esc
 from ..utils.promtext import sanitize_metric_name as _sanitize_name
 
@@ -31,7 +32,7 @@ class VerdictExporter:
     MAX_COUNTER_KEYS = 4096
 
     def __init__(self, stale_seconds: float = 3600.0):
-        self._lock = threading.Lock()
+        self._lock = make_lock("dataplane.exporter")
         self._gauges: dict[tuple, tuple[float, float]] = {}  # key -> (value, at)
         # counters are monotone and never TIME-staled: a counter that
         # vanishes mid-scrape makes rate() windows lie. They are bounded

@@ -37,6 +37,7 @@ from urllib.parse import urljoin, urlsplit
 import numpy as np
 
 from .. import native
+from ..utils.locks import make_lock
 from ..ops.windowing import MAX_WINDOW_STEPS, Window, align_step, resample_to_grid
 
 
@@ -66,7 +67,7 @@ class HttpConnectionPool:
     def __init__(self, max_per_host: int = 8):
         self.max_per_host = max_per_host
         self._idle: dict[tuple, list] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("dataplane.fetch.conn_pool")
         self.connections_opened = 0  # observability: new TCP handshakes
         self.requests_served = 0
         # env proxies (http_proxy/https_proxy/no_proxy): urlopen honored
@@ -432,7 +433,7 @@ class CachingDataSource:
         self.max_entries = max_entries
         self.ttl_seconds = ttl_seconds
         self._cache: OrderedDict[str, tuple] = OrderedDict()  # url -> (res, at)
-        self._lock = threading.Lock()
+        self._lock = make_lock("dataplane.fetch.ttl_cache")
         self._flights: dict = {}  # key -> _Flight (in-progress miss)
         self.hits = 0
         self.misses = 0

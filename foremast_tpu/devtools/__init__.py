@@ -1,0 +1,10 @@
+"""Project-native static analysis + runtime lock tracing.
+
+`python -m foremast_tpu.devtools` runs the invariant lint suite (five
+rules grounded in PRs 1-4's hand-found bugs; see docs/development.md);
+`locktrace` is the FOREMAST_DEBUG_LOCKS=1 runtime lock-order detector
+behind the utils/locks.py factory. Stdlib-only: importing this package
+must never pull jax (the lint gate runs before anything compiles).
+"""
+from .linter import Baseline, Checker, Finding, LintRun, run_lint  # noqa: F401
+from .checks import default_checkers  # noqa: F401

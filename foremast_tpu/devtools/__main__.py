@@ -1,0 +1,91 @@
+"""CLI: `python -m foremast_tpu.devtools [paths...]` (also `make lint`).
+
+Exit codes: 0 clean (baselined/suppressed findings allowed), 1 actionable
+findings or checker errors, 2 usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .checks import default_checkers
+from .linter import Baseline, iter_py_files, load_module, run_lint, \
+    write_baseline
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+_DEFAULT_BASELINE = os.path.join(_PKG_ROOT, "devtools", "lint_baseline.txt")
+_DEFAULT_DOCS = os.path.join(_REPO_ROOT, "docs", "configuration.md")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m foremast_tpu.devtools",
+        description="foremast-tpu invariant lint suite "
+                    "(docs/development.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
+                    help="baseline file (default: devtools/lint_baseline"
+                         ".txt); 'none' disables")
+    ap.add_argument("--docs", default=_DEFAULT_DOCS,
+                    help="configuration doc for the knob-registry row "
+                         "check; 'none' disables")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.write_baseline and args.baseline == "none":
+        print("--write-baseline needs a real --baseline path",
+              file=sys.stderr)
+        return 2
+
+    roots = args.paths or [_PKG_ROOT]
+    modules = []
+    errors = []
+    for root in roots:
+        root = os.path.abspath(root)
+        if not os.path.exists(root):
+            print(f"no such path: {root}", file=sys.stderr)
+            return 2
+        for ap_, rel in iter_py_files(root):
+            # anchor repo files at the repo root whatever path the caller
+            # gave: the path-scoped rules (allowlists, exemptions) and
+            # baseline keys all speak 'foremast_tpu/...' relpaths
+            if ap_.startswith(_REPO_ROOT + os.sep):
+                rel = os.path.relpath(ap_, _REPO_ROOT)
+            try:
+                modules.append(load_module(ap_, rel))
+            except SyntaxError as e:
+                errors.append(f"{rel}: unparsable: {e}")
+
+    docs_text = None
+    if args.docs != "none" and os.path.exists(args.docs):
+        with open(args.docs, encoding="utf-8") as f:
+            docs_text = f.read()
+
+    baseline = Baseline() if args.baseline == "none" \
+        else Baseline.load(args.baseline)
+    run = run_lint(default_checkers(docs_text=docs_text), modules, baseline)
+    run.errors = errors + run.errors
+
+    if args.write_baseline:
+        n = write_baseline(args.baseline, run, modules)
+        print(f"wrote {n} baseline entrie(s) to {args.baseline}")
+        return 0
+
+    for f in run.findings:
+        print(f.render())
+    for e in run.errors:
+        print(f"ERROR: {e}")
+    if not args.quiet:
+        print(f"{len(modules)} files: {len(run.findings)} finding(s), "
+              f"{len(run.baselined)} baselined, "
+              f"{len(run.suppressed)} suppressed")
+    return 0 if run.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

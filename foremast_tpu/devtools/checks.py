@@ -1,0 +1,600 @@
+"""The five invariant checkers. Each rule is a bug class PRs 1-4 hit by
+hand; docs/development.md pairs every rule with its motivating incident.
+
+rule              invariant
+----------------  -------------------------------------------------------
+lock-discipline   no blocking call lexically inside a ``with <lock>``
+                  body; the static held-before graph (lexical nesting +
+                  one level of same-class/same-module calls) stays
+                  acyclic. Runtime complement: devtools/locktrace.py.
+knob-registry     every env read outside engine/config.py resolves
+                  through utils/knobs.py; every registered knob has a
+                  default and a docs/configuration.md row; reads name
+                  registered knobs. Suppressions must carry a reason.
+metrics-lint      exporter emissions carry the foremastbrain: prefix and
+                  non-empty HELP; scrape-path iteration over private
+                  mutable collections happens under a lock or on a
+                  list()/dict() snapshot.
+thread-hygiene    threading.Thread constructions pass daemon= explicitly
+                  and are join-or-register (no anonymous
+                  Thread(...).start()); no bare print() outside
+                  CLI/bench/examples/devtools.
+jit-hygiene       no jax.jit construction inside loop bodies; jit static
+                  args are literal (hashable by construction); no Python
+                  `if`/`while` on traced values in ops/ and models/.
+"""
+from __future__ import annotations
+
+import ast
+
+from .linter import Checker, Finding, ModuleInfo
+
+__all__ = ["default_checkers", "LockDiscipline", "KnobRegistry",
+           "MetricsLint", "ThreadHygiene", "JitHygiene"]
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> str | None:
+    """'self._lock' / 'os.environ.get' for Name/Attribute chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lock_name(name: str) -> bool:
+    last = name.rsplit(".", 1)[-1].lstrip("_")
+    return last in ("lock", "mutex", "flock") or last.endswith("lock")
+
+
+def _lock_expr_id(expr: ast.AST, modbase: str, cls: str | None) -> str | None:
+    """Identity of a lock acquired by a `with` item, or None if the
+    expression does not look like a lock. `with self._flock():` counts."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted(expr)
+    if name is None or not _is_lock_name(name):
+        return None
+    if name.startswith("self."):
+        rest = name[len("self."):]
+        if cls:
+            return f"{modbase}.{cls}.{rest}"
+        return f"{modbase}.{rest}"
+    return f"{modbase}.{name}"
+
+
+def _iter_body(node: ast.AST):
+    """Walk a statement body WITHOUT descending into nested function /
+    class definitions (deferred code does not run under the lock)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+def _modbase(relpath: str) -> str:
+    return relpath.removeprefix("foremast_tpu/").removesuffix(".py") \
+        .replace("/", ".")
+
+
+# ---------------------------------------------------------------------------
+# (1) lock-discipline
+# ---------------------------------------------------------------------------
+
+# calls that block (or launch device work) and therefore must not run
+# while holding a hot lock. Matched on the LAST dotted component, plus the
+# subprocess module prefix.
+_BLOCKING_LAST = {
+    "urlopen", "fetch_series", "fetch_window", "sleep", "result",
+    "block_until_ready", "device_put", "getaddrinfo",
+}
+_SUBPROCESS_ATTRS = {"run", "Popen", "call", "check_call", "check_output"}
+
+
+class LockDiscipline(Checker):
+    name = "lock-discipline"
+
+    def __init__(self):
+        # edge -> (path, line) of first sighting
+        self._edges: dict[tuple[str, str], tuple[str, int]] = {}
+        # method/function -> locks acquired at its (non-nested) top level
+        self._fn_locks: dict[str, set[str]] = {}
+        # deferred call edges: (held_lock, callee_key, path, line)
+        self._calls: list[tuple[str, str, str, int]] = []
+
+    def _blocking(self, call: ast.Call) -> str | None:
+        name = dotted(call.func)
+        if name is None:
+            return None
+        last = name.rsplit(".", 1)[-1]
+        if name.startswith("subprocess.") and last in _SUBPROCESS_ATTRS:
+            return name
+        if last in _BLOCKING_LAST:
+            # `.result()` only as a zero/low-arg method call (futures),
+            # not e.g. a field named result
+            return name
+        return None
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        modbase = _modbase(module.relpath)
+
+        def visit_fn(fn: ast.AST, cls: str | None):
+            fn_key = f"{modbase}.{cls + '.' if cls else ''}{fn.name}"
+
+            def visit(node: ast.AST, held: tuple[str, ...]):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda, ast.ClassDef)):
+                    return  # deferred code does not run under the lock
+                if isinstance(node, ast.With):
+                    locks = []
+                    for item in node.items:
+                        lid = _lock_expr_id(item.context_expr, modbase, cls)
+                        if lid is not None:
+                            locks.append(lid)
+                    for lid in locks:
+                        if not held:
+                            self._fn_locks.setdefault(fn_key, set()).add(lid)
+                        for h in held:
+                            if h != lid:
+                                self._edges.setdefault(
+                                    (h, lid), (module.relpath, node.lineno))
+                    inner = held + tuple(locks)
+                    for child in ast.iter_child_nodes(node):
+                        visit(child, inner)
+                    return
+                if held and isinstance(node, ast.Call):
+                    blk = self._blocking(node)
+                    if blk is not None:
+                        findings.append(Finding(
+                            self.name, module.relpath, node.lineno,
+                            f"blocking call {blk}() while holding "
+                            f"{held[-1]} — move the I/O outside the lock "
+                            f"or snapshot under it"))
+                    callee = dotted(node.func)
+                    if callee is not None:
+                        if callee.startswith("self.") and cls:
+                            self._calls.append(
+                                (held[-1], f"{modbase}.{cls}.{callee[5:]}",
+                                 module.relpath, node.lineno))
+                        elif "." not in callee:
+                            self._calls.append(
+                                (held[-1], f"{modbase}.{callee}",
+                                 module.relpath, node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+
+            for stmt in fn.body:
+                visit(stmt, ())
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        visit_fn(item, node.name)
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_fn(node, None)
+        return findings
+
+    def finish(self) -> list[Finding]:
+        # resolve one level of call edges into lock->lock edges
+        for held, callee, path, line in self._calls:
+            for lid in self._fn_locks.get(callee, ()):
+                if lid != held:
+                    self._edges.setdefault((held, lid), (path, line))
+        # cycle detection over the static graph
+        adj: dict[str, set[str]] = {}
+        for (a, b) in self._edges:
+            adj.setdefault(a, set()).add(b)
+        findings: list[Finding] = []
+        seen_cycles: set[tuple[str, ...]] = set()
+        for start in sorted(adj):
+            path_stack = [(start, (start,))]
+            visited = set()
+            while path_stack:
+                node, path = path_stack.pop()
+                for nxt in adj.get(node, ()):
+                    if nxt == start:
+                        cyc = path + (start,)
+                        norm = tuple(sorted(set(cyc)))
+                        if norm in seen_cycles:
+                            continue
+                        seen_cycles.add(norm)
+                        src, line = self._edges[(node, nxt)]
+                        findings.append(Finding(
+                            self.name, src, line,
+                            "static lock-order cycle: "
+                            + " -> ".join(cyc)))
+                    elif nxt not in visited:
+                        visited.add(nxt)
+                        path_stack.append((nxt, path + (nxt,)))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# (2) knob-registry
+# ---------------------------------------------------------------------------
+
+_ENV_ALLOWLIST = {
+    "foremast_tpu/engine/config.py",
+    "foremast_tpu/utils/knobs.py",
+}
+
+
+class KnobRegistry(Checker):
+    name = "knob-registry"
+    require_reason = True
+
+    def __init__(self, docs_text: str | None = None):
+        self.docs_text = docs_text
+        self._registered: dict[str, tuple[str, int, bool]] = {}
+        self._reads: list[tuple[str, str, int]] = []
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        in_registry = module.relpath in _ENV_ALLOWLIST
+        for node in ast.walk(module.tree):
+            # NOTE: bare `environ` is deliberately not matched — WSGI
+            # handlers take a request dict named environ.
+            if isinstance(node, ast.Subscript):
+                if dotted(node.value) == "os.environ":
+                    if not in_registry:
+                        findings.append(Finding(
+                            self.name, module.relpath, node.lineno,
+                            "direct os.environ read — register the knob in "
+                            "utils/knobs.py and use knobs.read()"))
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname in ("os.getenv", "getenv", "os.environ.get"):
+                if not in_registry:
+                    findings.append(Finding(
+                        self.name, module.relpath, node.lineno,
+                        f"direct {fname}() read — register the knob in "
+                        "utils/knobs.py and use knobs.read()"))
+            elif fname == "knobs.read" or (
+                    in_registry and fname == "read"):
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    self._reads.append((node.args[0].value, module.relpath,
+                                        node.lineno))
+            elif fname == "knobs.register" or (
+                    module.relpath == "foremast_tpu/utils/knobs.py"
+                    and fname == "register"):
+                if not node.args or not isinstance(node.args[0],
+                                                   ast.Constant):
+                    continue
+                knob = str(node.args[0].value)
+                has_default = len(node.args) >= 2 or any(
+                    kw.arg == "default" for kw in node.keywords)
+                self._registered[knob] = (module.relpath, node.lineno,
+                                          has_default)
+        return findings
+
+    def finish(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for knob, (path, line, has_default) in sorted(
+                self._registered.items()):
+            if not has_default:
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"knob {knob} registered without a default"))
+            if self.docs_text is not None \
+                    and f"`{knob}`" not in self.docs_text:
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"knob {knob} has no docs/configuration.md row"))
+        for knob, path, line in self._reads:
+            if knob not in self._registered:
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"knobs.read({knob!r}) but {knob} is never registered"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# (3) metrics-lint
+# ---------------------------------------------------------------------------
+
+_SCRAPE_MODULES = {
+    "foremast_tpu/service/api.py",
+    "foremast_tpu/dataplane/exporter.py",
+    "foremast_tpu/engine/health.py",
+}
+_SNAPSHOT_WRAPPERS = {"list", "dict", "tuple", "sorted", "sum", "len",
+                      "frozenset", "set"}
+
+
+class MetricsLint(Checker):
+    name = "metrics-lint"
+
+    def _name_ok(self, arg: ast.AST) -> tuple[bool, str]:
+        """(prefix ok, rendered name) for literal / f-string names;
+        dynamic names pass (resolved by the caller's own literal)."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value.startswith("foremastbrain:"), arg.value
+        if isinstance(arg, ast.JoinedStr) and arg.values:
+            first = arg.values[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                              str):
+                return first.value.startswith("foremastbrain:"), first.value
+            return False, "<f-string>"
+        return True, ""
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            last = fname.rsplit(".", 1)[-1] if fname else ""
+            if last not in ("record_gauge", "record_counter"):
+                continue
+            # skip the method definitions' own module internals? no —
+            # every call site must conform.
+            if not node.args:
+                continue
+            ok, rendered = self._name_ok(node.args[0])
+            if not ok:
+                findings.append(Finding(
+                    self.name, module.relpath, node.lineno,
+                    f"metric {rendered!r} missing the foremastbrain: "
+                    "naming convention"))
+            help_idx = 3
+            help_arg = None
+            if len(node.args) > help_idx:
+                help_arg = node.args[help_idx]
+            for kw in node.keywords:
+                if kw.arg == "help":
+                    help_arg = kw.value
+            if help_arg is None or (
+                    isinstance(help_arg, ast.Constant)
+                    and not help_arg.value):
+                findings.append(Finding(
+                    self.name, module.relpath, node.lineno,
+                    f"metric {rendered or '<dynamic>'} emitted without "
+                    "HELP text (pass help=...)"))
+        if module.relpath in _SCRAPE_MODULES:
+            findings.extend(self._check_scrape_snapshots(module))
+        return findings
+
+    def _check_scrape_snapshots(self, module: ModuleInfo) -> list[Finding]:
+        """Iteration over a private mutable collection in a scrape module
+        must happen under a lock or on a snapshot — the PR 4
+        quarantined_count bug class."""
+        findings: list[Finding] = []
+
+        def private_attr_iter(expr: ast.AST) -> str | None:
+            """dotted name when expr iterates a private attr collection
+            (self._x / self._x.items()/values()/keys()), else None."""
+            if isinstance(expr, ast.Call):
+                fname = dotted(expr.func)
+                if fname and fname.rsplit(".", 1)[-1] in (
+                        "items", "values", "keys"):
+                    expr = expr.func.value
+                else:
+                    return None
+            name = dotted(expr)
+            if name and any(p.startswith("_")
+                            for p in name.split(".")[1:]):
+                return name
+            return None
+
+        def walk(node: ast.AST, locked: bool):
+            for child in ast.iter_child_nodes(node):
+                child_locked = locked
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        src = dotted(item.context_expr) or dotted(
+                            getattr(item.context_expr, "func", ast.Pass()))
+                        if src and _is_lock_name(src):
+                            child_locked = True
+                targets = []
+                if isinstance(child, ast.For):
+                    targets.append(child.iter)
+                elif isinstance(child, (ast.ListComp, ast.SetComp,
+                                        ast.DictComp, ast.GeneratorExp)):
+                    targets.extend(gen.iter for gen in child.generators)
+                for t in targets:
+                    if not child_locked:
+                        name = private_attr_iter(t)
+                        if name is not None:
+                            findings.append(Finding(
+                                self.name, module.relpath, t.lineno,
+                                f"scrape-path iteration over mutable "
+                                f"{name} outside a lock — snapshot it "
+                                f"(list()/dict() under the owner's lock)"))
+                walk(child, child_locked)
+
+        walk(module.tree, False)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# (4) thread-hygiene
+# ---------------------------------------------------------------------------
+
+_PRINT_EXEMPT_PREFIXES = (
+    "foremast_tpu/cli.py",
+    "foremast_tpu/__main__.py",
+    "foremast_tpu/bench_",
+    "foremast_tpu/examples/",
+    "foremast_tpu/devtools/",
+    "foremast_tpu/trigger/",
+)
+
+
+class ThreadHygiene(Checker):
+    name = "thread-hygiene"
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        print_exempt = module.relpath.startswith(_PRINT_EXEMPT_PREFIXES)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname in ("threading.Thread", "Thread"):
+                if not any(kw.arg == "daemon" for kw in node.keywords):
+                    findings.append(Finding(
+                        self.name, module.relpath, node.lineno,
+                        "threading.Thread without an explicit daemon= — "
+                        "decide shutdown semantics at the construction "
+                        "site"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "start" \
+                    and isinstance(node.func.value, ast.Call):
+                inner = dotted(node.func.value.func)
+                if inner in ("threading.Thread", "Thread"):
+                    findings.append(Finding(
+                        self.name, module.relpath, node.lineno,
+                        "anonymous Thread(...).start() — keep a reference "
+                        "so the thread can be joined or registered"))
+            elif fname == "print" and not print_exempt:
+                findings.append(Finding(
+                    self.name, module.relpath, node.lineno,
+                    "bare print() in library code — use the module "
+                    "logger (logging.getLogger('foremast_tpu...'))"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# (5) jit-hygiene
+# ---------------------------------------------------------------------------
+
+_TRACED_MODULE_PREFIXES = ("foremast_tpu/ops/", "foremast_tpu/models/")
+_TRACED_CALL_PREFIXES = ("jnp.", "lax.", "jax.numpy.", "jax.lax.")
+_CONCRETIZERS = {"float", "int", "bool", "item"}
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literal(el) for el in node.elts)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    (ast.USub, ast.UAdd)):
+        return _is_literal(node.operand)
+    return False
+
+
+class JitHygiene(Checker):
+    name = "jit-hygiene"
+
+    def _is_jit_call(self, node: ast.Call) -> bool:
+        fname = dotted(node.func)
+        if fname in ("jax.jit", "jit"):
+            return True
+        if fname in ("partial", "functools.partial") and node.args:
+            return dotted(node.args[0]) in ("jax.jit", "jit")
+        return False
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+
+        # (a) jit construction inside loop bodies; (b) static args literal
+        def walk(node: ast.AST, loop_depth: int):
+            for child in ast.iter_child_nodes(node):
+                depth = loop_depth
+                if isinstance(child, (ast.For, ast.While, ast.ListComp,
+                                      ast.SetComp, ast.DictComp,
+                                      ast.GeneratorExp)):
+                    depth += 1
+                if isinstance(child, ast.Call) and self._is_jit_call(child):
+                    if depth > 0:
+                        findings.append(Finding(
+                            self.name, module.relpath, child.lineno,
+                            "jax.jit constructed inside a loop body — "
+                            "every iteration makes a fresh wrapper whose "
+                            "compile cache starts empty; hoist it"))
+                    for kw in child.keywords:
+                        if kw.arg in ("static_argnums", "static_argnames",
+                                      "donate_argnums") \
+                                and not _is_literal(kw.value):
+                            findings.append(Finding(
+                                self.name, module.relpath, child.lineno,
+                                f"jit {kw.arg} is not a literal — static "
+                                "args must be hashable by construction"))
+                walk(child, depth)
+
+        walk(module.tree, 0)
+
+        # (c) Python control flow on traced values in kernel modules
+        if module.relpath.startswith(_TRACED_MODULE_PREFIXES):
+            for fn in ast.walk(module.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings.extend(self._check_traced_if(module, fn))
+        return findings
+
+    def _check_traced_if(self, module: ModuleInfo,
+                         fn: ast.AST) -> list[Finding]:
+        traced: set[str] = set()
+        findings: list[Finding] = []
+
+        def expr_traced(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in traced
+            if isinstance(expr, ast.Call):
+                fname = dotted(expr.func) or ""
+                if fname.rsplit(".", 1)[-1] in _CONCRETIZERS:
+                    return False  # explicit concretization
+                if fname.startswith(_TRACED_CALL_PREFIXES):
+                    return True
+                return False
+            if isinstance(expr, ast.Compare):
+                return expr_traced(expr.left) or any(
+                    expr_traced(c) for c in expr.comparators)
+            if isinstance(expr, ast.BoolOp):
+                return any(expr_traced(v) for v in expr.values)
+            if isinstance(expr, ast.UnaryOp):
+                return expr_traced(expr.operand)
+            if isinstance(expr, ast.BinOp):
+                return expr_traced(expr.left) or expr_traced(expr.right)
+            return False
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                val = node.value
+                if isinstance(val, ast.Call):
+                    fname = dotted(val.func) or ""
+                    if fname.startswith(_TRACED_CALL_PREFIXES) \
+                            and fname.rsplit(".", 1)[-1] not in (
+                                "asarray", "array", "shape", "arange"):
+                        traced.add(node.targets[0].id)
+                    elif fname.rsplit(".", 1)[-1] in _CONCRETIZERS:
+                        traced.discard(node.targets[0].id)
+                    else:
+                        traced.discard(node.targets[0].id)
+                else:
+                    traced.discard(node.targets[0].id)
+            elif isinstance(node, (ast.If, ast.While)):
+                if expr_traced(node.test):
+                    findings.append(Finding(
+                        self.name, module.relpath, node.lineno,
+                        "Python control flow on a traced value — use "
+                        "jnp.where / lax.cond (or concretize explicitly "
+                        "with float()/bool() outside jit)"))
+        return findings
+
+
+def default_checkers(docs_text: str | None = None) -> list[Checker]:
+    return [
+        LockDiscipline(),
+        KnobRegistry(docs_text=docs_text),
+        MetricsLint(),
+        ThreadHygiene(),
+        JitHygiene(),
+    ]

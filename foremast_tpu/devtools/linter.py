@@ -1,0 +1,245 @@
+"""Project-native AST lint framework.
+
+Not a general-purpose linter: each rule encodes an invariant this
+codebase established by hand across PRs 1-4 (see devtools/checks.py for
+the rules and docs/development.md for the motivating bugs). The framework
+gives every rule the same three affordances reviewers had:
+
+  * findings with file:line and a message (``Finding``);
+  * inline suppression with a named reason —
+    ``# lint: disable=<rule>[,<rule>] -- <why>`` on the offending line
+    (or ``# lint: disable-file=<rule> -- <why>`` anywhere — by
+    convention the top — for a whole module, e.g. bench scripts whose
+    knobs are deliberately outside the registry);
+  * a committed baseline (``lint_baseline.txt``) for grandfathered
+    findings, keyed on (path, rule, source text) so line drift does not
+    resurrect them. New code cannot hide behind the baseline: any finding
+    not in it fails the run.
+
+Stdlib-only on purpose: the lint gate must run in every environment the
+tests run in, including containers with no dev-tool wheels.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Finding", "Checker", "ModuleInfo", "Baseline", "LintRun",
+    "iter_py_files", "load_module", "run_lint",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*(disable|disable-file)\s*=\s*([a-z0-9_,\- ]+?)"
+    r"\s*(?:--\s*(.*))?$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def baseline_key(self, source_line: str) -> str:
+        return f"{self.path}|{self.rule}|{source_line.strip()}"
+
+
+@dataclass
+class Suppression:
+    rules: tuple[str, ...]
+    reason: str
+    file_wide: bool
+    used: bool = False
+
+
+class ModuleInfo:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> Suppression for inline; rule set for file-wide
+        self.suppressions: dict[int, Suppression] = {}
+        self.file_suppressions: list[Suppression] = []
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            kind, rules_raw, reason = m.group(1), m.group(2), m.group(3)
+            sup = Suppression(
+                rules=tuple(r.strip() for r in rules_raw.split(",")
+                            if r.strip()),
+                reason=(reason or "").strip(),
+                file_wide=(kind == "disable-file"),
+            )
+            if sup.file_wide:
+                self.file_suppressions.append(sup)
+            else:
+                self.suppressions[i] = sup
+
+    def source_line(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def suppressed(self, rule: str, line: int) -> Suppression | None:
+        for sup in self.file_suppressions:
+            if rule in sup.rules:
+                return sup
+        sup = self.suppressions.get(line)
+        if sup is not None and rule in sup.rules:
+            return sup
+        return None
+
+
+class Checker:
+    """Base class for one lint rule.
+
+    Subclasses set ``name`` (the rule id used in suppressions and the
+    baseline) and implement ``check``. ``finish`` runs after every module
+    has been seen — rules that build cross-module state (the static
+    held-before graph, the knob registry cross-reference) emit their
+    findings there.
+    """
+
+    name = "abstract"
+    #: suppressions of this rule must carry a `-- reason` (typed
+    #: suppression); used by knob-registry so every bypassed env read
+    #: names why it is legitimate.
+    require_reason = False
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        raise NotImplementedError
+
+    def finish(self) -> list[Finding]:
+        return []
+
+
+class Baseline:
+    """Multiset of grandfathered finding keys (see Finding.baseline_key)."""
+
+    def __init__(self, entries: list[str] | None = None):
+        self._counts: dict[str, int] = {}
+        for e in entries or []:
+            e = e.strip()
+            if e and not e.startswith("#"):
+                self._counts[e] = self._counts.get(e, 0) + 1
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            return cls(f.readlines())
+
+    def claim(self, key: str) -> bool:
+        n = self._counts.get(key, 0)
+        if n <= 0:
+            return False
+        self._counts[key] = n - 1
+        return True
+
+
+@dataclass
+class LintRun:
+    findings: list[Finding] = field(default_factory=list)  # actionable
+    baselined: list[Finding] = field(default_factory=list)
+    suppressed: list[tuple[Finding, Suppression]] = field(
+        default_factory=list)
+    errors: list[str] = field(default_factory=list)  # unparsable files etc.
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+
+def iter_py_files(root: str):
+    """Yield (abspath, relpath) for package .py files under root, skipping
+    caches and generated protobuf modules."""
+    if os.path.isfile(root):
+        yield root, os.path.basename(root)
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and not d.startswith("."))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py") or fn.endswith("_pb2.py") \
+                    or fn.endswith("_pb2_grpc.py"):
+                continue
+            ap = os.path.join(dirpath, fn)
+            yield ap, os.path.relpath(ap, os.path.dirname(root))
+
+
+def load_module(abspath: str, relpath: str) -> ModuleInfo:
+    with open(abspath, encoding="utf-8") as f:
+        return ModuleInfo(abspath, relpath, f.read())
+
+
+def run_lint(checkers: list[Checker], modules: list[ModuleInfo],
+             baseline: Baseline | None = None) -> LintRun:
+    """Run every checker over every module, then the cross-module finish
+    passes; route each finding through suppressions and the baseline."""
+    baseline = baseline or Baseline()
+    run = LintRun()
+    by_rel = {m.relpath: m for m in modules}
+
+    def route(checker: Checker, findings: list[Finding]):
+        for f in findings:
+            mod = by_rel.get(f.path)
+            sup = mod.suppressed(f.rule, f.line) if mod else None
+            if sup is not None:
+                if checker.require_reason and not sup.reason:
+                    run.findings.append(Finding(
+                        f.rule, f.path, f.line,
+                        f"suppression needs a reason "
+                        f"(`# lint: disable={f.rule} -- why`): {f.message}"))
+                    continue
+                sup.used = True
+                run.suppressed.append((f, sup))
+                continue
+            src = mod.source_line(f.line) if mod else ""
+            if baseline.claim(f.baseline_key(src)):
+                run.baselined.append(f)
+                continue
+            run.findings.append(f)
+
+    for checker in checkers:
+        for mod in modules:
+            try:
+                route(checker, checker.check(mod))
+            except Exception as e:  # noqa: BLE001 - a rule crash is a finding
+                run.errors.append(
+                    f"{mod.relpath}: checker {checker.name} crashed: "
+                    f"{type(e).__name__}: {e}")
+        route(checker, checker.finish())
+    run.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return run
+
+
+def write_baseline(path: str, run: LintRun,
+                   modules: list[ModuleInfo]) -> int:
+    """Regenerate the baseline from the current findings — actionable
+    ones AND still-present grandfathered ones (dropping the latter would
+    resurrect them as failures on the very next run)."""
+    by_rel = {m.relpath: m for m in modules}
+    keys = []
+    for f in run.findings + run.baselined:
+        mod = by_rel.get(f.path)
+        keys.append(f.baseline_key(mod.source_line(f.line) if mod else ""))
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("# Grandfathered lint findings (see docs/development.md).\n"
+                 "# Regenerate: python -m foremast_tpu.devtools "
+                 "--write-baseline\n")
+        for k in sorted(keys):
+            fh.write(k + "\n")
+    return len(keys)

@@ -1,0 +1,208 @@
+"""Runtime lock-order tracer: the dynamic half of the lock-discipline rule.
+
+PR 3's splice-lock GIL convoy and PR 4's unlocked scrape read were both
+found late, by hand. The static checker (devtools/checks.py) catches the
+lexically-visible class of those bugs; this module catches the rest at
+runtime: ``DebugLock``/``DebugRLock`` wrap the real primitives and record,
+per thread, which locks were already held when each lock was acquired —
+a global *held-before* graph. A cycle in that graph (A held while taking
+B in one thread, B held while taking A in another — ever, not necessarily
+simultaneously) is a latent deadlock even if the run never wedged; the
+soak asserts the graph stays acyclic. Hold-time histograms per lock name
+surface convoy locks (the PR 3 bug class: milliseconds of CPU work under
+a hot mutex) without a profiler.
+
+Enabled through the ``utils/locks.py`` factory when
+``FOREMAST_DEBUG_LOCKS=1``; otherwise the factory hands out plain
+``threading`` primitives and this module is never imported.
+
+All tracer bookkeeping happens under its own plain ``threading.Lock`` —
+the tracer must never participate in the graph it is judging.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["DebugLock", "DebugRLock", "tracer", "LockTracer"]
+
+# hold-time histogram bucket upper bounds (seconds); the last bucket is
+# +inf. A healthy hot lock lives in the first two buckets; the PR 3
+# splice convoy would have lit up >=10ms.
+_BUCKETS = (0.0001, 0.001, 0.01, 0.1, 1.0, float("inf"))
+
+
+class LockTracer:
+    """Global held-before graph + per-lock hold-time histograms."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        # edges: (held, acquired) -> count
+        self._edges: dict[tuple[str, str], int] = {}
+        # cycles observed at acquire time: list of (path tuple, thread)
+        self._cycles: list[tuple[tuple[str, ...], str]] = []
+        self._hold: dict[str, list[int]] = {}
+        self._hold_max: dict[str, float] = {}
+
+    # -- per-thread held stack --
+    def _stack(self) -> list[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _find_path(self, src: str, dst: str) -> tuple[str, ...] | None:
+        """Shortest-ish path src -> dst in the edge graph (DFS), called
+        under self._mu."""
+        adj: dict[str, set[str]] = {}
+        for a, b in self._edges:
+            adj.setdefault(a, set()).add(b)
+        seen = {src}
+        stack = [(src, (src,))]
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == dst:
+                    return path + (nxt,)
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + (nxt,)))
+        return None
+
+    # -- wrapper callbacks --
+    def note_acquired(self, name: str):
+        st = self._stack()
+        held = [h for h in st if h != name]
+        with self._mu:
+            for h in held:
+                key = (h, name)
+                first = key not in self._edges
+                self._edges[key] = self._edges.get(key, 0) + 1
+                if first:
+                    # new edge h -> name: a pre-existing path name ~> h
+                    # closes a cycle
+                    back = self._find_path(name, h)
+                    if back is not None:
+                        self._cycles.append(
+                            (back + (name,),
+                             threading.current_thread().name))
+        st.append(name)
+
+    def note_released(self, name: str, held_seconds: float):
+        st = self._stack()
+        # release order need not be LIFO; drop the innermost matching entry
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                break
+        with self._mu:
+            hist = self._hold.get(name)
+            if hist is None:
+                hist = self._hold[name] = [0] * len(_BUCKETS)
+            for i, ub in enumerate(_BUCKETS):
+                if held_seconds <= ub:
+                    hist[i] += 1
+                    break
+            if held_seconds > self._hold_max.get(name, 0.0):
+                self._hold_max[name] = held_seconds
+
+    # -- reporting --
+    def report(self) -> dict:
+        """{edges, cycles, hold} snapshot. ``cycles`` empty = no lock-order
+        inversion was ever observed (the soak's acceptance gate)."""
+        with self._mu:
+            return {
+                "edges": {f"{a} -> {b}": n
+                          for (a, b), n in sorted(self._edges.items())},
+                "cycles": [{"path": " -> ".join(path), "thread": thr}
+                           for path, thr in self._cycles],
+                "hold": {
+                    name: {
+                        "buckets_le": list(_BUCKETS),
+                        "counts": list(hist),
+                        "max_seconds": self._hold_max.get(name, 0.0),
+                    }
+                    for name, hist in sorted(self._hold.items())
+                },
+            }
+
+    def assert_no_cycles(self):
+        rep = self.report()
+        if rep["cycles"]:
+            raise AssertionError(
+                "lock-order cycles observed: "
+                + "; ".join(c["path"] for c in rep["cycles"]))
+
+    def reset(self):
+        with self._mu:
+            self._edges.clear()
+            self._cycles.clear()
+            self._hold.clear()
+            self._hold_max.clear()
+
+
+tracer = LockTracer()
+
+
+class DebugLock:
+    """threading.Lock wrapper feeding the global tracer. Supports the
+    subset of the Lock API the codebase uses (with / acquire / release /
+    locked)."""
+
+    _inner_factory = staticmethod(threading.Lock)
+    _reentrant = False
+
+    def __init__(self, name: str, _tracer: LockTracer | None = None):
+        self.name = name
+        self._tracer = _tracer or tracer
+        self._inner = self._inner_factory()
+        self._tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            depth = self._depth()
+            if depth == 0 or not self._reentrant:
+                # re-entrant re-acquisition adds no ordering information
+                self._tracer.note_acquired(self.name)
+                self._tls.t0 = time.monotonic()
+            self._tls.depth = depth + 1
+        return got
+
+    def release(self):
+        depth = self._depth() - 1
+        self._tls.depth = depth
+        if depth == 0 or not self._reentrant:
+            held = time.monotonic() - getattr(self._tls, "t0", time.monotonic())
+            self._tracer.note_released(self.name, held)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class DebugRLock(DebugLock):
+    """Re-entrant variant: nested acquisitions by the owning thread are
+    counted but recorded once (no self-edges, one hold-time sample per
+    outermost hold)."""
+
+    _inner_factory = staticmethod(threading.RLock)
+    _reentrant = True
+
+    def locked(self):  # RLock has no locked(); nobody calls it, keep parity
+        raise NotImplementedError("RLock exposes no locked()")

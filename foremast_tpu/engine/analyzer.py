@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..utils.locks import make_lock
 from ..dataplane.exporter import VerdictExporter
 from ..dataplane.fetch import FetchError, grid_from_series
 from ..dataplane.promql import (
@@ -328,7 +329,7 @@ class Analyzer:
         # count of abandoned sacrificial threads (each still parked on a
         # hung device call); bounded by _WATCHDOG_MAX_ABANDONED
         self.watchdog_fires_total = 0
-        self._wd_lock = threading.Lock()
+        self._wd_lock = make_lock("engine.analyzer.watchdog")
         self._watchdog_abandoned = 0
 
     def _memo_put(self, table: OrderedDict, key, val):

@@ -32,6 +32,8 @@ try:
 except ImportError:  # Windows: no flock; single-process archives only
     fcntl = None
 
+from ..utils.locks import make_lock
+
 __all__ = ["FileArchive", "EsArchive"]
 
 # jobs.py's TERMINAL_STATUSES, duplicated here because jobs.py imports
@@ -92,7 +94,7 @@ class FileArchive:
         # records are never aged (they are adoptable state, bounded by
         # fleet size); state blobs are last-per-key.
         self.keep_terminal_seconds = keep_terminal_seconds
-        self._lock = threading.Lock()
+        self._lock = make_lock("engine.archive.file")
         # times a lock-free scan exhausted its rescans and fell back to a
         # locked scan (sustained-rotation churn); exposed for observability
         self.locked_scan_fallbacks = 0

@@ -36,6 +36,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ..utils.locks import make_lock
+
 __all__ = ["HealthMonitor", "STATE_OK", "STATE_DEGRADED", "STATE_OVERLOADED",
            "STATE_STALLED", "HEALTH_STATE_VALUES"]
 
@@ -66,7 +68,7 @@ class HealthMonitor:
     def __init__(self, exporter=None, cycle_seconds: float = 10.0,
                  stall_grace_seconds: float = 30.0,
                  clock=time.monotonic):
-        self._lock = threading.Lock()
+        self._lock = make_lock("engine.health")
         self.exporter = exporter
         self.cycle_seconds = float(cycle_seconds)
         # liveness window floor: tiny test cadences must not flag a

@@ -21,12 +21,16 @@ implement the same four methods.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from dataclasses import asdict, dataclass, field
 
 from .archive import _match
+from ..utils.locks import make_lock, make_rlock
+
+log = logging.getLogger("foremast_tpu.engine.jobs")
 
 
 # --- internal status machine -------------------------------------------------
@@ -193,7 +197,7 @@ class JobStore:
 
     def __init__(self, snapshot_path: str | None = None, archive=None,
                  mirror_open: bool = True):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("engine.jobs.store")
         self._jobs: dict[str, Document] = {}
         self._hpalogs: list[HpaLog] = []
         self._state: dict = {}  # engine-owned durable blobs (breath timers)
@@ -231,7 +235,7 @@ class JobStore:
         # background flusher: serialization/IO happen off the callers'
         # threads (see _persist); writes are ordered by a sequence number so
         # a slow older flush can never clobber a newer snapshot
-        self._write_lock = threading.Lock()
+        self._write_lock = make_lock("engine.jobs.snapshot_write")
         self._flush_seq = 0  # bumped under _lock when a payload is cut
         self._written_seq = 0  # last seq that reached disk (under _write_lock)
         self._flush_cost = 0.0  # last serialize+write seconds (adaptive cadence)
@@ -593,7 +597,7 @@ class JobStore:
                 # non-JSON-safe state blob: stay alive — a dead flusher
                 # silently downgrades bounded staleness to cycle-length gaps.
                 # The next synchronous flush() surfaces the error to a caller.
-                print(f"[foremast-tpu] snapshot flush failed: {e}", flush=True)
+                log.warning("snapshot flush failed: %s", e)
                 time.sleep(1.0)
                 # flush() re-marked dirty; re-arm the (cleared) wake so the
                 # retry happens even if the store goes quiescent

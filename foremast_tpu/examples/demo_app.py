@@ -1,3 +1,4 @@
+# lint: disable-file=knob-registry -- demo-only env surface (examples/k8s manifests), not production config
 """Demo app: instrumented WSGI service with configurable fault injection.
 
 The reference's acceptance tests hinge on a demo Spring Boot app whose

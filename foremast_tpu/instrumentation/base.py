@@ -7,8 +7,7 @@ at zero from boot, starter parity), the uri-tag cardinality bound, and the
 """
 from __future__ import annotations
 
-import os
-
+from ..utils import knobs
 from .registry import MetricsRegistry
 
 HTTP_SERVER_REQUESTS = "http_server_requests"
@@ -25,7 +24,7 @@ class MetricsMiddlewareBase:
                  uri_templates: list | None = None,
                  max_uris: int = 100):
         self.app = app
-        name = app_name or os.environ.get("APP_NAME", "")
+        name = app_name or knobs.read("APP_NAME")
         common = {"app": name} if name else {}
         self.registry = registry or MetricsRegistry(common_tags=common)
         self.caller_enabled = caller_enabled

@@ -15,6 +15,8 @@ import threading
 
 import numpy as np
 
+from ..utils import knobs
+
 __all__ = ["available", "parse_series", "parse_grid", "resample", "lib_path"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -22,7 +24,7 @@ _SRC = os.path.join(_DIR, "src", "foremast_native.cpp")
 # FOREMAST_NATIVE_SO points the loader at an alternate build (the ASAN
 # fuzz leg in tests/test_native_fuzz.py); default is the cached in-package
 # artifact. Read at import: the override is a per-process test seam.
-_SO = (os.environ.get("FOREMAST_NATIVE_SO")
+_SO = (knobs.read("FOREMAST_NATIVE_SO")
        or os.path.join(_DIR, "foremast_native.so"))
 
 _lock = threading.Lock()
@@ -38,8 +40,8 @@ def lib_path() -> str:
 
 
 def _build() -> bool:
-    cxx = os.environ.get("CXX", "g++")
-    extra = os.environ.get("FOREMAST_NATIVE_CXXFLAGS", "").split()
+    cxx = knobs.read("CXX")
+    extra = knobs.read("FOREMAST_NATIVE_CXXFLAGS").split()
     cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
            *extra, _SRC, "-o", _SO]
     try:
@@ -74,7 +76,7 @@ def _load():
 
 def _try_load():
     global _lib, _state
-    if os.environ.get("FOREMAST_NATIVE", "1") == "0":
+    if not knobs.read("FOREMAST_NATIVE"):
         return None
     if not os.path.exists(_SO) or (
         os.path.exists(_SRC)

@@ -23,6 +23,7 @@ import urllib.request
 from typing import Callable
 
 from .types import DeploymentMetadata, DeploymentMonitor
+from ..utils import knobs
 
 
 class KubeError(Exception):
@@ -172,8 +173,8 @@ class KubeClient:
     def __init__(self, base_url: str | None = None, token: str | None = None,
                  ca_path: str | None = None, timeout: float = 10.0):
         sa = "/var/run/secrets/kubernetes.io/serviceaccount"
-        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
-        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        host = knobs.read("KUBERNETES_SERVICE_HOST")
+        port = knobs.read("KUBERNETES_SERVICE_PORT")
         self.base = base_url or f"https://{host}:{port}"
         if token is None and os.path.exists(f"{sa}/token"):
             with open(f"{sa}/token") as f:

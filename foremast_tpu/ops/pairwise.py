@@ -23,8 +23,6 @@ rank sums.
 """
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 
@@ -36,6 +34,7 @@ from .ranks import (
     rank_sum_stats,
 )
 from .stats import chi2_sf, kolmogorov_sf, norm_sf
+from ..utils import knobs
 
 __all__ = [
     "mann_whitney_u",
@@ -64,7 +63,7 @@ def _safe_div(a, b):
 # a sparsely-masked long bucket still gets exactness); larger samples use
 # the Stephens-corrected asymptotic, where its drift is far below verdict
 # relevance. The DP is O(K^2) work per pair at grid bound K.
-KS_EXACT_MAX_T = int(os.environ.get("FOREMAST_KS_EXACT_MAX_T", "256"))
+KS_EXACT_MAX_T = knobs.read("FOREMAST_KS_EXACT_MAX_T")
 
 
 def _ks_exact_sf(t, n1, n2, Ti: int, Tj: int):
@@ -200,8 +199,7 @@ def mann_whitney_u(x, x_mask, y, y_mask):
 # MIN_WILCOXON_DATA_POINTS=20 gate puts live canary windows squarely in
 # that regime, where the normal approximation drifts up to ~0.02 absolute
 # — the same verdict-flip magnitude the round-3 judge flagged for KS.
-WILCOXON_EXACT_MAX_N = int(os.environ.get("FOREMAST_WILCOXON_EXACT_MAX_N",
-                                          "50"))
+WILCOXON_EXACT_MAX_N = knobs.read("FOREMAST_WILCOXON_EXACT_MAX_N")
 
 
 def _wilcoxon_exact_p(r_plus, n):

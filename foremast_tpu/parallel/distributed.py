@@ -23,12 +23,15 @@ inputs are created per-host, sharded with `jax.make_array_from_process_local_dat
 """
 from __future__ import annotations
 
-import os
+import logging
 from dataclasses import dataclass
 
 import jax
 
 from .mesh import fleet_mesh
+from ..utils import knobs
+
+log = logging.getLogger("foremast_tpu.parallel")
 
 __all__ = ["initialize", "HostInfo", "host_info", "global_fleet_mesh",
            "process_batch_slice"]
@@ -48,32 +51,35 @@ def initialize(coordinator: str | None = None, num_processes: int | None = None,
     global _initialized
     if _initialized:
         return False
-    env = os.environ if env is None else env
-    coordinator = coordinator or env.get("COORDINATOR_ADDRESS", "")
-    n = num_processes if num_processes is not None else int(env.get("NUM_PROCESSES", "0") or 0)
-    pid = process_id if process_id is not None else int(env.get("PROCESS_ID", "-1") or -1)
+    # env reads resolve through the knob registry (defaults + tolerant
+    # parse live there): a templated NUM_PROCESSES=garbage falls back to
+    # 0 with a log line instead of a ValueError at boot
+    coordinator = coordinator or knobs.read("COORDINATOR_ADDRESS", env)
+    n = num_processes if num_processes is not None \
+        else knobs.read("NUM_PROCESSES", env)
+    pid = process_id if process_id is not None \
+        else knobs.read("PROCESS_ID", env)
     if not coordinator or n <= 1:
         # single-host, or Cloud TPU pod where jax auto-detects: only call
         # into jax.distributed when the pod metadata says we are multi-host.
         # A partial config (coordinator without world size or vice versa,
         # or a templated NUM_PROCESSES=1) must not kill a runtime that
         # works fine single-host — warn and proceed local.
-        if env.get("TPU_WORKER_HOSTNAMES"):
+        if knobs.read("TPU_WORKER_HOSTNAMES", env):
             jax.distributed.initialize()
             _initialized = True
             return True
         if coordinator or n > 1:
-            print(
-                "[foremast-tpu] incomplete multi-host config "
-                f"(COORDINATOR_ADDRESS={coordinator!r}, NUM_PROCESSES={n}); "
-                "need both — continuing single-host",
-                flush=True,
+            log.warning(
+                "incomplete multi-host config (COORDINATOR_ADDRESS=%r, "
+                "NUM_PROCESSES=%s); need both — continuing single-host",
+                coordinator, n,
             )
         return False
     kwargs = {"coordinator_address": coordinator, "num_processes": n}
     if pid >= 0:
         kwargs["process_id"] = pid
-    local = env.get("LOCAL_DEVICE_IDS", "")
+    local = knobs.read("LOCAL_DEVICE_IDS", env)
     if local:
         kwargs["local_device_ids"] = [int(x) for x in local.split(",")]
     jax.distributed.initialize(**kwargs)

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Callable
 
+from ..utils.locks import make_lock
+
 STATE_CLOSED = "closed"
 STATE_HALF_OPEN = "half_open"
 STATE_OPEN = "open"
@@ -38,7 +40,7 @@ class CircuitBreaker:
         self.recovery_seconds = float(recovery_seconds)
         self.half_open_max_calls = max(1, int(half_open_max_calls))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.breaker")
         self._state = STATE_CLOSED
         self._failures = 0  # consecutive failures while closed
         self._opened_at = 0.0
@@ -159,7 +161,7 @@ class BreakerBoard:
         # hostile create flood cannot grow the board without limit
         self.max_keys = max_keys
         self._breakers: dict[str, CircuitBreaker] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.breaker.board")
         self._hooks: list[Callable[[str, str, str], None]] = []
 
     def subscribe(self, hook: Callable[[str, str, str], None]):

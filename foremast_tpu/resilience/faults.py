@@ -38,6 +38,7 @@ hash), so adding a kube clause cannot shift the fetch stream's decisions.
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
@@ -45,7 +46,10 @@ import zlib
 from dataclasses import dataclass, field
 
 from ..dataplane.fetch import FetchError
+from ..utils.locks import make_lock
 from ..operator.kube import KubeError
+
+log = logging.getLogger("foremast_tpu.resilience")
 
 # injected-garbage response bodies, cycled deterministically: a truncated
 # JSON document, valid JSON of the wrong shape, and raw non-JSON bytes —
@@ -180,7 +184,7 @@ class FaultInjector:
         # not shift another's decisions
         self._rng = random.Random(seed ^ zlib.crc32(target.encode()))
         self._sleep = sleep
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.faults.injector")
         self.calls = 0
         self.injected_errors = 0
         self.injected_latency = 0
@@ -388,5 +392,5 @@ def safe_injectors(spec: str,
     try:
         return injectors_from_spec(spec)
     except ValueError as e:
-        print(f"[{context}] ignoring invalid FOREMAST_CHAOS: {e}", flush=True)
+        log.warning("[%s] ignoring invalid FOREMAST_CHAOS: %s", context, e)
         return {}

@@ -22,6 +22,8 @@ import time
 from collections import deque
 from typing import Callable
 
+from ..utils.locks import make_lock
+
 
 class Deadline:
     """Monotonic-clock deadline threaded through a fetch and its retries.
@@ -63,7 +65,7 @@ class RetryBudget:
         self.window_seconds = window_seconds
         self._clock = clock
         self._spent: deque[float] = deque()
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.retry_budget")
         self.denials = 0
 
     def try_spend(self) -> bool:
@@ -100,7 +102,7 @@ class RetryPolicy:
         self.budget = budget
         self._sleep = sleep
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()  # RNG + counters shared across threads
+        self._lock = make_lock("resilience.retry_policy")  # RNG + counters shared across threads
         self.attempts_total = 0
         self.retries_total = 0
         self.deadline_clips = 0

@@ -72,7 +72,6 @@ Env surface (union of the reference services'):
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 
@@ -82,6 +81,7 @@ from .engine.analyzer import Analyzer
 from .engine.config import EngineConfig, from_env
 from .engine.jobs import JobStore
 from .service.api import ForemastService, make_server
+from .utils import knobs
 
 __all__ = ["Runtime"]
 
@@ -125,7 +125,7 @@ class Runtime:
         # wraps the RAW boundaries, so the resilience layer above it is
         # exercised exactly as it would be by a real outage --
         if chaos_spec is None:
-            chaos_spec = os.environ.get("FOREMAST_CHAOS", "")
+            chaos_spec = knobs.read("FOREMAST_CHAOS")
         self.chaos_injectors = {}
         if chaos_spec:
             from .resilience import FaultyArchive, FaultyDataSource
@@ -431,10 +431,10 @@ class Runtime:
 
 
 def _tolerant(raw: str, cast, default, label: str):
-    """Tolerant knob parse: empty/malformed values fall back to the
-    default with a log line — a templated-empty or garbage value must not
-    crashloop the pod. ONE implementation so the policy cannot drift
-    between knob families (env vars and compound specs alike)."""
+    """Tolerant parse for COMPOUND spec pieces (e.g. the port half of
+    WAVEFRONT_PROXY): empty/malformed values fall back to the default with
+    a log line — a garbage value must not crashloop the pod. Whole-knob
+    reads route through utils/knobs.py, which applies the same policy."""
     try:
         return cast(raw) if raw else default
     except ValueError:
@@ -442,24 +442,12 @@ def _tolerant(raw: str, cast, default, label: str):
         return default
 
 
-def _env_parse(name: str, cast, default):
-    return _tolerant(os.environ.get(name, ""), cast, default, name)
-
-
-def _env_seconds(name: str, default: float) -> float:
-    return _env_parse(name, float, default)
-
-
-def _env_int(name: str, default: int) -> int:
-    return _env_parse(name, int, default)
-
-
 def main():
     # one logging config for the whole process (worker loop, operator
     # modules, this banner); no-op when the embedding app configured
     # handlers already. LOG_LEVEL parses tolerantly like every other env
     # knob here — a typo'd level must not crashloop the pod.
-    name = os.environ.get("LOG_LEVEL", "INFO").strip().upper()
+    name = knobs.read("LOG_LEVEL").strip().upper()
     level = getattr(logging, name, None)
     logging.basicConfig(
         level=level if isinstance(level, int) else logging.INFO,
@@ -478,8 +466,8 @@ def main():
             hi.global_devices,
         )
     archive = None
-    es = os.environ.get("ES_ENDPOINT", "")
-    archive_path = os.environ.get("ARCHIVE_PATH", "")
+    es = knobs.read("ES_ENDPOINT")
+    archive_path = knobs.read("ARCHIVE_PATH")
     if es:
         from .engine.archive import EsArchive
 
@@ -489,15 +477,15 @@ def main():
 
         archive = FileArchive(archive_path)
     rt = Runtime(
-        snapshot_path=os.environ.get("SNAPSHOT_PATH") or None,
-        query_endpoint=os.environ.get("QUERY_SERVICE_ENDPOINT", ""),
+        snapshot_path=knobs.read("SNAPSHOT_PATH") or None,
+        query_endpoint=knobs.read("QUERY_SERVICE_ENDPOINT"),
         archive=archive,
-        job_retention_seconds=_env_seconds("JOB_RETENTION_SECONDS", 24 * 3600.0),
-        adopt_interval_seconds=_env_seconds("ARCHIVE_ADOPT_INTERVAL", 30.0),
-        adopt_skew_margin_seconds=_env_seconds("ARCHIVE_ADOPT_SKEW_MARGIN", 15.0),
-        lstm_cache_path=os.environ.get("LSTM_CACHE_PATH") or None,
+        job_retention_seconds=knobs.read("JOB_RETENTION_SECONDS"),
+        adopt_interval_seconds=knobs.read("ARCHIVE_ADOPT_INTERVAL"),
+        adopt_skew_margin_seconds=knobs.read("ARCHIVE_ADOPT_SKEW_MARGIN"),
+        lstm_cache_path=knobs.read("LSTM_CACHE_PATH") or None,
     )
-    proxy = os.environ.get("WAVEFRONT_PROXY", "")
+    proxy = knobs.read("WAVEFRONT_PROXY")
     if proxy:
         from .dataplane.wavefront_sink import WavefrontSink
 
@@ -506,12 +494,9 @@ def main():
             rt.exporter, host=host,
             port=_tolerant(wf_port, int, 2878, "WAVEFRONT_PROXY port"),
         )
-    port = _env_int("PORT", 8099)
-    grpc_port = _env_int("GRPC_PORT", 0) or None
-    cycle = _env_seconds("CYCLE_SECONDS", 10.0)
-
-    def _env_opt_int(name: str) -> int | None:
-        return _env_parse(name, int, None)
+    port = knobs.read("PORT")
+    grpc_port = knobs.read("GRPC_PORT") or None
+    cycle = knobs.read("CYCLE_SECONDS")
 
     import signal
 
@@ -527,9 +512,9 @@ def main():
     )
     rt.run_forever(
         port=port, cycle_seconds=cycle, grpc_port=grpc_port,
-        http_max_inflight=_env_opt_int("HTTP_MAX_INFLIGHT"),
-        grpc_workers=_env_opt_int("GRPC_WORKERS"),
-        grpc_max_concurrent=_env_opt_int("GRPC_MAX_CONCURRENT"),
+        http_max_inflight=knobs.read("HTTP_MAX_INFLIGHT"),
+        grpc_workers=knobs.read("GRPC_WORKERS"),
+        grpc_max_concurrent=knobs.read("GRPC_MAX_CONCURRENT"),
     )
 
 

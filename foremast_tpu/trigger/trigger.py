@@ -29,6 +29,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..dataplane.wavefront_sink import mirror_name
+from ..utils import knobs
 from ..utils.timeutils import to_rfc3339
 
 _REASON_METRIC = re.compile(r"anomaly detected on ([\w.:-]+)")
@@ -253,12 +254,12 @@ class TriggerService:
 def main():
     from ..operator.analyst import HttpAnalyst
 
-    requests_file = os.environ.get("REQUESTS_FILE", "requests.csv")
-    endpoint = os.environ.get("FOREMAST_ENDPOINT", "http://127.0.0.1:8099")
+    requests_file = knobs.read("REQUESTS_FILE")
+    endpoint = knobs.read("FOREMAST_ENDPOINT")
     svc = TriggerService(
         analyst=HttpAnalyst(endpoint),
-        wavefront_endpoint=os.environ.get("WAVEFRONT_ENDPOINT", ""),
-        volume_path=os.environ.get("VOLUME_PATH", "."),
+        wavefront_endpoint=knobs.read("WAVEFRONT_ENDPOINT"),
+        volume_path=knobs.read("VOLUME_PATH"),
     )
     import signal
 

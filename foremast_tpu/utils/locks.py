@@ -1,0 +1,46 @@
+"""Lock factory: the one seam between runtime locks and the lock tracer.
+
+Every lock in the threaded modules (runtime, engine/, dataplane/,
+resilience/) is constructed through ``make_lock``/``make_rlock`` with a
+stable dotted name. Normally these return plain ``threading.Lock`` /
+``RLock`` objects — zero wrapper, zero overhead (pinned by
+tests/test_locktrace.py). With ``FOREMAST_DEBUG_LOCKS=1`` they return
+``devtools.locktrace`` wrappers that record per-thread acquisition order
+into a global held-before graph with cycle detection and hold-time
+histograms — the runtime half of the lock-discipline story (the static
+half lives in ``devtools/checks.py``). The chaos soak and the
+concurrency suite run with the tracer on.
+
+The env knob is read at construction time (through the knob registry),
+so tests can flip it per-fixture; long-lived singletons constructed at
+import keep whatever the env said then.
+"""
+from __future__ import annotations
+
+import threading
+
+from . import knobs
+
+__all__ = ["make_lock", "make_rlock", "debug_locks_enabled"]
+
+
+def debug_locks_enabled() -> bool:
+    return bool(knobs.read("FOREMAST_DEBUG_LOCKS"))
+
+
+def make_lock(name: str):
+    """A mutex for ``with``/acquire/release use, named for the tracer."""
+    if debug_locks_enabled():
+        from ..devtools.locktrace import DebugLock
+
+        return DebugLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """Re-entrant variant of make_lock."""
+    if debug_locks_enabled():
+        from ..devtools.locktrace import DebugRLock
+
+        return DebugRLock(name)
+    return threading.RLock()

@@ -32,6 +32,20 @@ from foremast_tpu.utils.timeutils import to_rfc3339
 
 pytestmark = [pytest.mark.slow, pytest.mark.chaos]
 
+
+@pytest.fixture(autouse=True)
+def _debug_locks(monkeypatch):
+    """Soak under the lock-order tracer (FOREMAST_DEBUG_LOCKS=1): the
+    acceptance gate is not just 'survived the fault plan' but 'and no
+    held-before cycle was ever observed while doing so'."""
+    from foremast_tpu.devtools.locktrace import tracer
+
+    monkeypatch.setenv("FOREMAST_DEBUG_LOCKS", "1")
+    tracer.reset()
+    yield
+    rep = tracer.report()
+    assert not rep["cycles"], rep["cycles"]
+
 STEP = 60
 SEED = 20260803
 N_CYCLES = 30

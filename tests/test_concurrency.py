@@ -18,8 +18,26 @@ from __future__ import annotations
 import threading
 import time
 
+import pytest
+
 from foremast_tpu.engine import Document, JobStore, MetricQueries
 from foremast_tpu.engine import jobs as J
+
+
+@pytest.fixture(autouse=True)
+def _debug_locks(monkeypatch):
+    """Run every concurrency test with the lock-order tracer on
+    (FOREMAST_DEBUG_LOCKS=1): the stores/exporters built inside the tests
+    get DebugLock/DebugRLock wrappers, and a held-before cycle observed
+    by ANY test here fails it — the runtime complement of the static
+    lock-discipline rule (docs/development.md)."""
+    from foremast_tpu.devtools.locktrace import tracer
+
+    monkeypatch.setenv("FOREMAST_DEBUG_LOCKS", "1")
+    tracer.reset()
+    yield
+    rep = tracer.report()
+    assert not rep["cycles"], rep["cycles"]
 
 TERMINAL_CHAIN = (J.PREPROCESS_INPROGRESS, J.PREPROCESS_COMPLETED,
                   J.POSTPROCESS_INPROGRESS, J.COMPLETED_HEALTH)

@@ -1,0 +1,156 @@
+"""DebugLock / lock-order tracer unit tests (devtools/locktrace.py).
+
+Covers the ISSUE 5 satellite contract: deterministic two-thread AB/BA
+cycle detection, re-entrant RLock handling, and zero-overhead
+pass-through when FOREMAST_DEBUG_LOCKS is off.
+"""
+from __future__ import annotations
+
+import threading
+
+from foremast_tpu.devtools.locktrace import DebugLock, DebugRLock, LockTracer
+from foremast_tpu.utils.locks import make_lock, make_rlock
+
+
+def test_ab_ba_two_thread_cycle_detected_deterministically():
+    """Thread 1 takes A then B; thread 2 takes B then A — serialized with
+    an event so the test can never actually deadlock, yet the held-before
+    graph must still record the inversion (that is the point of the
+    tracer: the ordering bug is latent even when the run got lucky)."""
+    tr = LockTracer()
+    a = DebugLock("A", _tracer=tr)
+    b = DebugLock("B", _tracer=tr)
+    first_done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        first_done.set()
+
+    def t2():
+        first_done.wait(5)
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1, daemon=True)
+    th2 = threading.Thread(target=t2, daemon=True)
+    th1.start()
+    th2.start()
+    th1.join(5)
+    th2.join(5)
+
+    rep = tr.report()
+    assert "A -> B" in rep["edges"] and "B -> A" in rep["edges"]
+    assert len(rep["cycles"]) == 1
+    path = rep["cycles"][0]["path"]
+    assert "A" in path and "B" in path
+    try:
+        tr.assert_no_cycles()
+    except AssertionError:
+        pass
+    else:
+        raise AssertionError("assert_no_cycles passed despite a cycle")
+
+
+def test_consistent_order_has_no_cycle():
+    tr = LockTracer()
+    a = DebugLock("A", _tracer=tr)
+    b = DebugLock("B", _tracer=tr)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = tr.report()
+    assert rep["edges"] == {"A -> B": 3}
+    assert rep["cycles"] == []
+    tr.assert_no_cycles()
+
+
+def test_rlock_reentrancy_no_self_edges_one_hold_sample():
+    tr = LockTracer()
+    r = DebugRLock("R", _tracer=tr)
+    with r:
+        with r:  # re-entrant: no new ordering info, no self edge
+            with r:
+                pass
+    rep = tr.report()
+    assert rep["edges"] == {}
+    assert rep["cycles"] == []
+    # exactly ONE hold-time sample: the outermost hold
+    assert sum(rep["hold"]["R"]["counts"]) == 1
+
+
+def test_rlock_under_lock_records_edge_once_per_outer_hold():
+    tr = LockTracer()
+    a = DebugLock("A", _tracer=tr)
+    r = DebugRLock("R", _tracer=tr)
+    with a:
+        with r:
+            with r:
+                pass
+    rep = tr.report()
+    assert rep["edges"] == {"A -> R": 1}
+
+
+def test_hold_time_histogram_buckets():
+    tr = LockTracer()
+    a = DebugLock("A", _tracer=tr)
+    with a:
+        pass
+    hold = tr.report()["hold"]["A"]
+    assert sum(hold["counts"]) == 1
+    assert hold["max_seconds"] >= 0.0
+    assert len(hold["counts"]) == len(hold["buckets_le"])
+
+
+def test_acquire_release_api_parity():
+    """The codebase uses plain acquire()/release() in one place
+    (engine/archive._flock); the wrapper must support it."""
+    tr = LockTracer()
+    a = DebugLock("A", _tracer=tr)
+    assert a.acquire()
+    assert a.locked()
+    a.release()
+    assert not a.locked()
+    assert a.acquire(blocking=False)
+    a.release()
+
+
+def test_factory_pass_through_when_disabled(monkeypatch):
+    """FOREMAST_DEBUG_LOCKS off (the production default) must hand out
+    the BARE threading primitives — not a wrapper with a no-op tracer.
+    Zero overhead means zero wrapper."""
+    monkeypatch.delenv("FOREMAST_DEBUG_LOCKS", raising=False)
+    lk = make_lock("x")
+    rk = make_rlock("x")
+    assert type(lk) is type(threading.Lock())
+    assert type(rk) is type(threading.RLock())
+
+    monkeypatch.setenv("FOREMAST_DEBUG_LOCKS", "0")
+    assert type(make_lock("x")) is type(threading.Lock())
+
+
+def test_factory_returns_wrappers_when_enabled(monkeypatch):
+    monkeypatch.setenv("FOREMAST_DEBUG_LOCKS", "1")
+    assert isinstance(make_lock("x"), DebugLock)
+    assert isinstance(make_rlock("x"), DebugRLock)
+
+
+def test_wrapped_jobstore_records_its_locks(monkeypatch):
+    """End to end through the factory seam: a JobStore built with the
+    tracer on shows its named locks in the hold histograms."""
+    from foremast_tpu.devtools import locktrace
+    from foremast_tpu.engine import Document, JobStore
+
+    monkeypatch.setenv("FOREMAST_DEBUG_LOCKS", "1")
+    locktrace.tracer.reset()
+    store = JobStore()
+    store.create(Document(id="j1", app_name="a", strategy="canary",
+                          start_time="", end_time=""))
+    store.close()
+    rep = locktrace.tracer.report()
+    assert "engine.jobs.store" in rep["hold"]
+    assert rep["cycles"] == []
+    locktrace.tracer.reset()

@@ -418,17 +418,23 @@ def test_trigger_run_forever_exits_on_request_stop(tmp_path):
 
 def test_env_knob_parsing_tolerates_garbage(monkeypatch):
     """Malformed/templated-empty env knobs must fall back with a log line,
-    never crashloop the pod (runtime.py's stated policy, now applied to
-    the int family too — PORT='garbage' used to raise at startup)."""
-    from foremast_tpu.runtime import _env_int, _env_seconds
+    never crashloop the pod (runtime.py's stated policy, now owned by the
+    knob registry — PORT='garbage' used to raise at startup)."""
+    from foremast_tpu.utils import knobs
 
-    monkeypatch.setenv("X_INT", "garbage")
-    monkeypatch.setenv("X_EMPTY", "")
-    monkeypatch.setenv("X_OK", "17")
-    assert _env_int("X_INT", 8099) == 8099
-    assert _env_int("X_EMPTY", 8099) == 8099
-    assert _env_int("X_OK", 8099) == 17
-    monkeypatch.delenv("X_ABSENT", raising=False)
-    assert _env_int("X_ABSENT", 8099) == 8099
-    monkeypatch.setenv("X_SEC", "not-a-float")
-    assert _env_seconds("X_SEC", 30.0) == 30.0
+    assert knobs.read("PORT", {"PORT": "garbage"}) == 8099
+    assert knobs.read("PORT", {"PORT": ""}) == 8099
+    assert knobs.read("PORT", {"PORT": "17"}) == 17
+    assert knobs.read("PORT", {}) == 8099
+    assert knobs.read("CYCLE_SECONDS", {"CYCLE_SECONDS": "not-a-float"}) \
+        == 10.0
+    # optional knobs (no configured value) stay None
+    assert knobs.read("HTTP_MAX_INFLIGHT", {}) is None
+    # and the registry refuses reads of knobs nobody registered
+    import pytest
+
+    with pytest.raises(KeyError):
+        knobs.read("NOT_A_KNOB", {})
+    # process env is the default source
+    monkeypatch.setenv("PORT", "1234")
+    assert knobs.read("PORT") == 1234

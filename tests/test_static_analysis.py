@@ -1,0 +1,537 @@
+"""Invariant lint suite tests (foremast_tpu/devtools/).
+
+Two halves:
+  * the GATE: the shipped tree lints clean — zero non-baselined findings
+    with the committed baseline and docs (this is what `make lint` runs);
+  * per-rule fixture tests: each of the five rules fires on a seeded
+    violation and stays quiet on the idiomatic fix, and the CLI exits
+    non-zero on each seeded violation (ISSUE 5 acceptance).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import foremast_tpu
+from foremast_tpu.devtools.checks import (
+    JitHygiene,
+    KnobRegistry,
+    LockDiscipline,
+    MetricsLint,
+    ThreadHygiene,
+    default_checkers,
+)
+from foremast_tpu.devtools.linter import (
+    Baseline,
+    ModuleInfo,
+    iter_py_files,
+    load_module,
+    run_lint,
+)
+
+PKG_ROOT = os.path.dirname(os.path.abspath(foremast_tpu.__file__))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+BASELINE = os.path.join(PKG_ROOT, "devtools", "lint_baseline.txt")
+DOCS = os.path.join(REPO_ROOT, "docs", "configuration.md")
+
+
+def lint_src(checker, src, relpath="foremast_tpu/engine/fixture.py",
+             docs_text=None):
+    mod = ModuleInfo("<fixture>", relpath, textwrap.dedent(src))
+    return run_lint([checker], [mod], Baseline())
+
+
+# ---------------------------------------------------------------- the gate
+
+def test_repo_tree_lints_clean():
+    """The committed tree has zero non-baselined findings — the tier-1
+    half of `make lint`. A finding here means new code violated one of
+    the five invariants; fix it (or, for a deliberate exception, add an
+    inline `# lint: disable=<rule> -- reason`)."""
+    modules = [load_module(a, r) for a, r in iter_py_files(PKG_ROOT)]
+    docs_text = open(DOCS, encoding="utf-8").read() \
+        if os.path.exists(DOCS) else None
+    run = run_lint(default_checkers(docs_text=docs_text), modules,
+                   Baseline.load(BASELINE))
+    assert not run.errors, run.errors
+    assert not run.findings, "\n".join(f.render() for f in run.findings)
+
+
+def test_devtools_imports_stay_stdlib_only():
+    """The lint gate must run before anything compiles: importing
+    foremast_tpu.devtools must not pull jax (or numpy)."""
+    code = ("import sys; import foremast_tpu.devtools; "
+            "bad = [m for m in ('jax', 'numpy') if m in sys.modules]; "
+            "raise SystemExit(1 if bad else 0)")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO_ROOT,
+                          capture_output=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+# ------------------------------------------------------- (1) lock-discipline
+
+def test_lock_discipline_flags_blocking_call_under_lock():
+    run = lint_src(LockDiscipline(), """
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """)
+    assert any("blocking call time.sleep" in f.message for f in run.findings)
+
+
+def test_lock_discipline_quiet_on_snapshot_idiom():
+    run = lint_src(LockDiscipline(), """
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}
+
+            def good(self):
+                with self._lock:
+                    snap = dict(self._d)
+                time.sleep(0.1)
+                return snap
+    """)
+    assert run.findings == []
+
+
+def test_lock_discipline_detects_static_ab_ba_cycle():
+    run = lint_src(LockDiscipline(), """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """)
+    assert any("lock-order cycle" in f.message for f in run.findings)
+
+
+def test_lock_discipline_resolves_one_level_call_edges():
+    """two() holds B and calls helper() which takes A — combined with
+    one()'s A-before-B, that is a cycle even though no function nests
+    both inversions lexically."""
+    run = lint_src(LockDiscipline(), """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def helper(self):
+                with self._a_lock:
+                    pass
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._b_lock:
+                    self.helper()
+    """)
+    assert any("lock-order cycle" in f.message for f in run.findings)
+
+
+def test_lock_discipline_deferred_code_not_under_lock():
+    """A function DEFINED under a lock runs later — its body must not
+    count as executing while the lock is held."""
+    run = lint_src(LockDiscipline(), """
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def good(self):
+                with self._lock:
+                    def later():
+                        time.sleep(1.0)
+                return later
+    """)
+    assert run.findings == []
+
+
+# -------------------------------------------------------- (2) knob-registry
+
+def test_knob_registry_flags_direct_env_reads():
+    run = lint_src(KnobRegistry(), """
+        import os
+        A = os.environ.get("SOME_KNOB", "x")
+        B = os.getenv("OTHER_KNOB")
+        C = os.environ["THIRD_KNOB"]
+    """)
+    assert len([f for f in run.findings if "direct" in f.message]) == 3
+
+
+def test_knob_registry_allowlists_config_and_registry_modules():
+    src = """
+        import os
+        A = os.environ.get("SOME_KNOB", "x")
+    """
+    for rel in ("foremast_tpu/engine/config.py",
+                "foremast_tpu/utils/knobs.py"):
+        run = lint_src(KnobRegistry(), src, relpath=rel)
+        assert run.findings == [], rel
+
+
+def test_knob_registry_suppression_requires_reason():
+    bare = lint_src(KnobRegistry(), """
+        import os
+        A = os.environ.get("SOME_KNOB")  # lint: disable=knob-registry
+    """)
+    assert any("needs a reason" in f.message for f in bare.findings)
+    typed = lint_src(KnobRegistry(), """
+        import os
+        A = os.environ.get("SOME_KNOB")  # lint: disable=knob-registry -- test-only seam
+    """)
+    assert typed.findings == []
+    assert len(typed.suppressed) == 1
+
+
+def test_knob_registry_registered_knobs_need_default_and_docs_row():
+    checker = KnobRegistry(docs_text="| `DOCUMENTED` | `1` | yes |\n")
+    run = lint_src(checker, """
+        from foremast_tpu.utils import knobs
+        knobs.register("DOCUMENTED", 1, int, "fine")
+        knobs.register("UNDOCUMENTED", 2, int, "no row")
+    """)
+    msgs = [f.message for f in run.findings]
+    assert any("UNDOCUMENTED has no docs" in m for m in msgs)
+    assert not any("DOCUMENTED has no docs" in m and "UN" not in m
+                   for m in msgs)
+    # a register() without a default is flagged in the registry module
+    run2 = lint_src(KnobRegistry(docs_text="`NAKED`"), """
+        register("NAKED")
+    """, relpath="foremast_tpu/utils/knobs.py")
+    assert any("without a default" in f.message for f in run2.findings)
+
+
+def test_knob_registry_read_of_unregistered_knob_flagged():
+    run = lint_src(KnobRegistry(), """
+        from foremast_tpu.utils import knobs
+        x = knobs.read("NEVER_REGISTERED")
+    """)
+    assert any("never registered" in f.message for f in run.findings)
+
+
+def test_every_registered_knob_reads_back_its_default():
+    """Runtime complement of the static default check: reading every
+    registered knob from an empty env returns its declared default."""
+    from foremast_tpu.utils import knobs
+
+    for name, knob in knobs.all_knobs().items():
+        assert knob.read({}) == knob.default, name
+
+
+# --------------------------------------------------------- (3) metrics-lint
+
+def test_metrics_lint_flags_prefix_and_missing_help():
+    run = lint_src(MetricsLint(), """
+        def emit(exporter):
+            exporter.record_gauge("wrong_name", {}, 1.0)
+            exporter.record_counter("foremastbrain:ok_total", {}, 1.0)
+    """)
+    msgs = [f.message for f in run.findings]
+    assert any("naming convention" in m for m in msgs)
+    assert sum("without HELP" in m for m in msgs) == 2
+
+
+def test_metrics_lint_quiet_on_conformant_emission():
+    run = lint_src(MetricsLint(), """
+        def emit(exporter):
+            exporter.record_gauge("foremastbrain:x", {}, 1.0, help="x")
+            exporter.record_counter(f"foremastbrain:{name}_total", {},
+                                    help=text)
+    """)
+    assert run.findings == []
+
+
+def test_metrics_lint_scrape_path_snapshot_rule():
+    src = """
+        class Svc:
+            def status_summary(self):
+                return [v for v in self.analyzer._quarantine.values()]
+    """
+    run = lint_src(MetricsLint(), src,
+                   relpath="foremast_tpu/service/api.py")
+    assert any("outside a lock" in f.message for f in run.findings)
+    # same read under the owner's lock is fine
+    locked = """
+        class Svc:
+            def status_summary(self):
+                with self._lock:
+                    return [v for v in self._quarantine.values()]
+    """
+    run2 = lint_src(MetricsLint(), locked,
+                    relpath="foremast_tpu/service/api.py")
+    assert run2.findings == []
+    # and the rule only applies to scrape modules
+    run3 = lint_src(MetricsLint(), src,
+                    relpath="foremast_tpu/engine/fixture.py")
+    assert run3.findings == []
+
+
+# ------------------------------------------------------- (4) thread-hygiene
+
+def test_thread_hygiene_requires_explicit_daemon():
+    run = lint_src(ThreadHygiene(), """
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            return t
+    """)
+    assert any("explicit daemon=" in f.message for f in run.findings)
+    ok = lint_src(ThreadHygiene(), """
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+    """)
+    assert ok.findings == []
+
+
+def test_thread_hygiene_flags_anonymous_start():
+    run = lint_src(ThreadHygiene(), """
+        import threading
+
+        def spawn(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """)
+    assert any("anonymous Thread" in f.message for f in run.findings)
+
+
+def test_thread_hygiene_print_rule_and_exemptions():
+    src = """
+        def f():
+            print("hello")
+    """
+    run = lint_src(ThreadHygiene(), src)
+    assert any("bare print()" in f.message for f in run.findings)
+    for rel in ("foremast_tpu/cli.py", "foremast_tpu/bench_cycle.py",
+                "foremast_tpu/examples/demo_app.py"):
+        assert lint_src(ThreadHygiene(), src, relpath=rel).findings == [], rel
+
+
+# ---------------------------------------------------------- (5) jit-hygiene
+
+def test_jit_hygiene_flags_jit_in_loop():
+    run = lint_src(JitHygiene(), """
+        import jax
+
+        def per_cycle(fns):
+            return [jax.jit(f) for f in fns]
+    """)
+    assert any("inside a loop body" in f.message for f in run.findings)
+    hoisted = lint_src(JitHygiene(), """
+        import jax
+
+        def build(f):
+            g = jax.jit(f)
+
+            def per_cycle(batches):
+                return [g(b) for b in batches]
+            return per_cycle
+    """)
+    assert hoisted.findings == []
+
+
+def test_jit_hygiene_static_args_must_be_literal():
+    run = lint_src(JitHygiene(), """
+        import jax
+
+        def build(f, names):
+            return jax.jit(f, static_argnames=names)
+    """)
+    assert any("not a literal" in f.message for f in run.findings)
+    ok = lint_src(JitHygiene(), """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("period",))
+        def f(x, period):
+            return x
+    """)
+    assert ok.findings == []
+
+
+def test_jit_hygiene_traced_if_in_ops_modules():
+    src = """
+        import jax.numpy as jnp
+
+        def bad(x):
+            s = jnp.sum(x)
+            if s > 0:
+                return 1
+            return 0
+    """
+    run = lint_src(JitHygiene(), src, relpath="foremast_tpu/ops/fix.py")
+    assert any("traced value" in f.message for f in run.findings)
+    # explicit concretization is the documented escape hatch
+    ok = lint_src(JitHygiene(), """
+        import jax.numpy as jnp
+
+        def good(x):
+            s = float(jnp.sum(x))
+            if s > 0:
+                return 1
+            return 0
+    """, relpath="foremast_tpu/ops/fix.py")
+    assert ok.findings == []
+    # host code outside ops//models/ may branch freely
+    host = lint_src(JitHygiene(), src,
+                    relpath="foremast_tpu/engine/fixture.py")
+    assert host.findings == []
+
+
+# ----------------------------------------------- suppressions and baseline
+
+def test_inline_and_file_wide_suppressions():
+    inline = lint_src(ThreadHygiene(), """
+        def f():
+            print("x")  # lint: disable=thread-hygiene -- operator-facing banner
+    """)
+    assert inline.findings == [] and len(inline.suppressed) == 1
+    file_wide = lint_src(ThreadHygiene(), """
+        # lint: disable-file=thread-hygiene -- fixture module
+        def f():
+            print("x")
+
+        def g():
+            print("y")
+    """)
+    assert file_wide.findings == [] and len(file_wide.suppressed) == 2
+    wrong_rule = lint_src(ThreadHygiene(), """
+        def f():
+            print("x")  # lint: disable=knob-registry -- wrong rule named
+    """)
+    assert len(wrong_rule.findings) == 1
+
+
+def test_baseline_grandfathers_exact_finding_only():
+    src = """
+        def f():
+            print("x")
+
+        def g():
+            print("y")
+    """
+    mod = ModuleInfo("<fixture>", "foremast_tpu/engine/fixture.py",
+                     textwrap.dedent(src))
+    # baseline only the print("x") finding
+    key = 'foremast_tpu/engine/fixture.py|thread-hygiene|print("x")'
+    run = run_lint([ThreadHygiene()], [mod], Baseline([key]))
+    assert len(run.baselined) == 1
+    assert len(run.findings) == 1
+    assert 'print("y")' in mod.source_line(run.findings[0].line)
+
+
+# ------------------------------------------------------------------ the CLI
+
+_SEEDED_VIOLATIONS = {
+    "lock-discipline": """
+        import threading, time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """,
+    "knob-registry": """
+        import os
+        A = os.environ.get("SOME_KNOB")
+    """,
+    "metrics-lint": """
+        def emit(exporter):
+            exporter.record_gauge("wrong_name", {}, 1.0)
+    """,
+    "thread-hygiene": """
+        import threading
+
+        def f():
+            t = threading.Thread(target=f)
+            return t
+    """,
+    "jit-hygiene": """
+        import jax
+
+        def f(fns):
+            return [jax.jit(g) for g in fns]
+    """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_SEEDED_VIOLATIONS))
+def test_cli_exits_nonzero_on_each_seeded_rule_violation(rule, tmp_path):
+    """ISSUE 5 acceptance: `make lint` (the devtools CLI) exits non-zero
+    on a seeded violation of each of the five rules."""
+    target = tmp_path / f"{rule.replace('-', '_')}.py"
+    target.write_text(textwrap.dedent(_SEEDED_VIOLATIONS[rule]))
+    proc = subprocess.run(
+        [sys.executable, "-m", "foremast_tpu.devtools", str(target),
+         "--baseline", "none", "--docs", "none"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, (rule, proc.stdout, proc.stderr)
+    assert f"[{rule}]" in proc.stdout, (rule, proc.stdout)
+
+
+def test_cli_exits_zero_on_repo_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "foremast_tpu.devtools"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_write_baseline_roundtrip(tmp_path):
+    """--write-baseline grandfathers current findings; a rerun against
+    that baseline is clean; a NEW violation still fails."""
+    target = tmp_path / "legacy.py"
+    target.write_text("def f():\n    print('x')\n")
+    base = tmp_path / "base.txt"
+    subprocess.run(
+        [sys.executable, "-m", "foremast_tpu.devtools", str(target),
+         "--baseline", str(base), "--docs", "none", "--write-baseline"],
+        cwd=REPO_ROOT, capture_output=True, timeout=120, check=True)
+    clean = subprocess.run(
+        [sys.executable, "-m", "foremast_tpu.devtools", str(target),
+         "--baseline", str(base), "--docs", "none"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stdout
+    target.write_text("def f():\n    print('x')\n    print('new')\n")
+    dirty = subprocess.run(
+        [sys.executable, "-m", "foremast_tpu.devtools", str(target),
+         "--baseline", str(base), "--docs", "none"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert dirty.returncode == 1
+    assert "print('new')" not in open(base).read()

@@ -144,15 +144,19 @@ def test_initialize_single_host_is_noop():
     assert distributed.initialize(env={}) is False  # no coordinator config
 
 
-def test_initialize_partial_config_degrades_to_single_host(capsys):
+def test_initialize_partial_config_degrades_to_single_host(caplog):
     """A templated NUM_PROCESSES=1 or a lone COORDINATOR_ADDRESS must not
-    crash the runtime at boot — warn and continue local."""
+    crash the runtime at boot — warn (through logging, the lint suite's
+    thread-hygiene rule bans bare print) and continue local."""
+    import logging
+
     from foremast_tpu.parallel import distributed
 
-    assert distributed.initialize(env={"NUM_PROCESSES": "1"}) is False
-    assert distributed.initialize(
-        env={"COORDINATOR_ADDRESS": "10.0.0.2:8476"}) is False
-    assert "incomplete multi-host config" in capsys.readouterr().out
+    with caplog.at_level(logging.WARNING, logger="foremast_tpu.parallel"):
+        assert distributed.initialize(env={"NUM_PROCESSES": "1"}) is False
+        assert distributed.initialize(
+            env={"COORDINATOR_ADDRESS": "10.0.0.2:8476"}) is False
+    assert "incomplete multi-host config" in caplog.text
 
 
 def test_initialize_passes_explicit_world(monkeypatch):
