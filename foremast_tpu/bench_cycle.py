@@ -48,7 +48,7 @@ def _prom_body(ts0: int, values, step: int = 60) -> bytes:
 
 
 def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
-        mix: bool = False) -> dict:
+        mix: bool = False, provenance: bool = True) -> dict:
     """mix=False: a pure pair-job fleet (round-over-round continuity with
     the r1-r3 artifacts). mix=True: a realistic model-family mix — 60%
     pair, 20% band, 10% bivariate, 5% 3-metric LSTM-AE, 5% HPA — with the
@@ -180,7 +180,8 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
                          # instead of scoring — the steady-state figure
                          # lives in run_steady. Off here by default,
                          # env-overridable for A/B.
-                         score_memo=_eb(os.environ, "SCORE_MEMO", False)),
+                         score_memo=_eb(os.environ, "SCORE_MEMO", False),
+                         provenance=provenance),
             source, store)
 
         with CompileCounter() as cc_warm:
@@ -216,6 +217,17 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
             for _ in range(cycles):
                 engine.run_cycle(now=t_end)
         wall = time.perf_counter() - t0
+        # verdict digest over status/reason/anomaly (NOT processing_content,
+        # which is the provenance attachment itself): the provenance A/B
+        # pins this byte-identical with recording on and off
+        import hashlib
+
+        dig = hashlib.blake2b(digest_size=16)
+        every = store.by_status(*J.OPEN_STATUSES, *J.TERMINAL_STATUSES)
+        for d in sorted(every, key=lambda d: d.id):
+            dig.update(repr((d.id, d.status, d.reason,
+                             sorted(d.anomaly.items()))).encode())
+        verdict_digest = dig.hexdigest()
 
     stats = tracing.tracer.stats()
     per_cycle = lambda name: round(  # noqa: E731
@@ -229,10 +241,9 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
     # parser comparison into machine-load noise. wall - score is exactly
     # the part of the cycle this bench exists to measure:
     # fetch -> parse -> resample -> pack -> verdict -> snapshot.
-    # Clock-domain caveat: tracer spans are time.time()-based while wall is
-    # perf_counter-based; a clock step during the run could push the
-    # subtraction non-positive. Omit the field then (bench.py falls back to
-    # the raw number) rather than record an absurd rate.
+    # (Both clocks are steady since the tracer moved to time.monotonic()
+    # durations; the guard below only covers the degenerate zero-score
+    # case.)
     score_total = stats.get("engine.score", {}).get("total_seconds", 0.0)
     host_wall = wall - score_total
     host_fields = (
@@ -283,6 +294,48 @@ def run(n_jobs: int = 10_000, cycles: int = 2, window_steps: int = 128,
         "score_s_per_cycle": per_cycle("engine.score"),
         "wall_s": round(wall, 3),
         "unhealthy_or_terminal": not_requeued,
+        "provenance": provenance,
+        "verdict_digest": verdict_digest,
+    }
+
+
+def run_provenance_ab(n_jobs: int = 1500, cycles: int = 6,
+                      rounds: int = 3) -> dict:
+    """Provenance A/B on the mixed 1500-job bench fleet: identical fleet
+    and cycles with PROVENANCE on vs off. Pins the two claims the feature
+    ships under — verdicts byte-identical (recording only observes), and
+    cycle overhead under 3%.
+
+    Legs INTERLEAVE (on/off per round) and each side reports its best
+    round: on a shared/preemptible host the run-to-run spread of the
+    fetch-pool preprocess stage (thread scheduling) dwarfs the
+    recording cost, and a single sequential pair routinely misattributes
+    tens of percent of noise to whichever leg ran in the worse slot
+    (measured both signs on the 2-core sandbox). Best-of-N against
+    best-of-N cancels the slot lottery; the digest identity is checked
+    on every round."""
+    best_on = best_off = None
+    identical = True
+    for _ in range(max(rounds, 1)):
+        on = run(n_jobs, cycles, mix=True, provenance=True)
+        off = run(n_jobs, cycles, mix=True, provenance=False)
+        identical &= on["verdict_digest"] == off["verdict_digest"]
+        if best_on is None or on["value"] > best_on["value"]:
+            best_on = on
+        if best_off is None or off["value"] > best_off["value"]:
+            best_off = off
+    overhead = (best_off["value"] - best_on["value"]) \
+        / max(best_off["value"], 1e-9)
+    return {
+        "metric": "provenance_overhead_pct",
+        "value": round(100.0 * overhead, 2),
+        "unit": "%",
+        "rounds": rounds,
+        "verdicts_identical": identical,
+        "jobs_per_sec_on": best_on["value"],
+        "jobs_per_sec_off": best_off["value"],
+        "on": best_on,
+        "off": best_off,
     }
 
 
@@ -454,6 +507,10 @@ def main() -> None:
     cycles = int(os.environ.get("BENCH_CYCLE_REPS", "2"))
     if _env_bool(os.environ, "BENCH_CYCLE_STEADY", False):
         print(json.dumps(run_steady_ab(n, cycles)))
+        return
+    if _env_bool(os.environ, "BENCH_CYCLE_PROVENANCE", False):
+        n = int(os.environ.get("BENCH_CYCLE_JOBS", "1500"))
+        print(json.dumps(run_provenance_ab(n, max(cycles, 4))))
         return
     mix = _env_bool(os.environ, "BENCH_CYCLE_MIX", False)
     print(json.dumps(run(n, cycles, mix=mix)))

@@ -1,4 +1,4 @@
-"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | prewarm | demo.
+"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | health | explain | prewarm | demo.
 
 One entrypoint covers the reference's process zoo and kubectl plugins:
 
@@ -237,6 +237,124 @@ def cmd_health(args) -> int:
     return 0 if status == 200 else 1
 
 
+def _render_explain(payload: dict) -> str:
+    """Human-readable decision chain for one job's latest provenance
+    record (the docs/operations.md "debugging a verdict" runbook walks
+    each path through this rendering)."""
+    lines = []
+    job = payload.get("job") or {}
+    if job:
+        lines.append(
+            f"job {job.get('jobId', '')} "
+            f"[{job.get('strategy', '?')}] "
+            f"{job.get('appName', '?')}/{job.get('namespace', '?')} — "
+            f"status {job.get('status', '?')} "
+            f"({job.get('internalStatus', '?')})")
+        if job.get("reason"):
+            lines.append(f"  reason: {job['reason']}")
+    rec = payload.get("provenance")
+    if not rec:
+        if not payload.get("provenance_enabled", True):
+            lines.append("  provenance recording is DISABLED "
+                         "(PROVENANCE=0)")
+        else:
+            lines.append("  no provenance record (job not judged since "
+                         "this runtime started, or record evicted)")
+        return "\n".join(lines)
+    cyc = rec.get("cycle") or {}
+    cycle_id = cyc.get("cycle_id") or rec.get("cycle_id", "")
+    src = (" (from archive)" if rec.get("from_archive")
+           else " (from document summary)" if rec.get("from_document")
+           else "")
+    lines.append(f"  verdict path: {rec.get('path', '?')}"
+                 + (f" — {rec['detail']}" if rec.get("detail") else "")
+                 + src)
+    lines.append(f"  cycle: {cycle_id}"
+                 + (f" ({cyc.get('jobs')} jobs, "
+                    f"{cyc.get('device_launches')} device launches)"
+                    if cyc.get("jobs") is not None else ""))
+    if rec.get("reason"):
+        lines.append(f"  recorded reason: {rec['reason']}")
+    for f in rec.get("families", []):
+        fam = f.get("family", "?")
+        verdict = "UNHEALTHY" if f.get("unhealthy") else "healthy"
+        if fam == "pair":
+            desc = (f"min_p {f.get('min_p')} vs alpha {f.get('alpha')}")
+        elif fam == "band":
+            desc = (f"{f.get('anomalous_points')} anomalous point(s), "
+                    f"band {f.get('band')}")
+        elif fam == "bivariate":
+            desc = f"{f.get('anomalous_points')} point(s) outside ellipse"
+        elif fam == "lstm":
+            desc = f"z {f.get('z')} vs threshold {f.get('threshold')}"
+        elif fam == "hpa":
+            desc = (f"score {f.get('gated_score')} "
+                    f"(raw {f.get('raw_score')}), "
+                    f"sla {f.get('sla_current')}/{f.get('sla_limit')}")
+        else:
+            desc = json.dumps(f)
+        lines.append(f"    {fam} {f.get('metric', '')}: {desc} "
+                     f"-> {verdict}")
+    if rec.get("families_dropped"):
+        lines.append(f"    ... {rec['families_dropped']} more "
+                     "(truncated)")
+    fetch = rec.get("fetch") or {}
+    if fetch:
+        parts = []
+        if fetch.get("fetches"):
+            parts.append(f"{int(fetch['fetches'])} fetch(es)")
+        mode = []
+        if fetch.get("fetch_delta"):
+            mode.append(f"{int(fetch['fetch_delta'])} delta")
+        if fetch.get("fetch_full"):
+            mode.append(f"{int(fetch['fetch_full'])} full")
+        if fetch.get("fetch_cached"):
+            mode.append(f"{int(fetch['fetch_cached'])} cached")
+        if mode:
+            parts.append("/".join(mode))
+        if fetch.get("points"):
+            parts.append(f"{int(fetch['points'])} points")
+        if fetch.get("fetch_seconds") is not None:
+            parts.append(f"{fetch['fetch_seconds']:.3f}s")
+        lines.append("  fetch: " + ", ".join(parts))
+    stages = cyc.get("stage_seconds") or {}
+    if stages:
+        lines.append("  cycle stages: " + ", ".join(
+            f"{k} {v:.3f}s" for k, v in stages.items()))
+    return "\n".join(lines)
+
+
+def cmd_explain(args) -> int:
+    """Fetch and render one job's verdict provenance (/jobs/<id>/explain)."""
+    import urllib.error
+    import urllib.request
+
+    endpoint = (args.endpoint or knobs.read("ANALYST_ENDPOINT")
+                or "http://localhost:8099")
+    # analyst endpoints are often configured with the /v1/healthcheck/
+    # suffix; explain lives at the server root
+    base = endpoint.split("/v1/")[0].rstrip("/")
+    url = f"{base}/jobs/{args.job}/explain"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            payload = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read().decode()).get("error", str(e))
+        except Exception:  # noqa: BLE001 - non-JSON error body
+            msg = str(e)
+        print(f"explain failed ({e.code}): {msg}", file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001 - CLI boundary: diagnose, don't trace
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    else:
+        print(_render_explain(payload))
+    return 0
+
+
 def cmd_trigger(args) -> int:
     from .trigger.trigger import main
 
@@ -315,6 +433,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="runtime base URL (env ANALYST_ENDPOINT; "
                          "default http://localhost:8099)")
     hp.set_defaults(func=cmd_health)
+    ex = sub.add_parser(
+        "explain",
+        help="render a job's verdict provenance (which path produced the "
+             "verdict, scores vs thresholds, fetch mode)",
+    )
+    ex.add_argument("job", help="job id (/v1/healthcheck/create's jobId)")
+    ex.add_argument("--endpoint", default="",
+                    help="runtime base URL (env ANALYST_ENDPOINT; "
+                         "default http://localhost:8099)")
+    ex.add_argument("--json", action="store_true",
+                    help="print the raw /jobs/<id>/explain payload")
+    ex.set_defaults(func=cmd_explain)
     for name, fn, help_ in (
         ("watch", cmd_watch, "enable continuous monitoring for an app"),
         ("unwatch", cmd_unwatch, "disable continuous monitoring for an app"),

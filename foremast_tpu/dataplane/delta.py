@@ -53,6 +53,7 @@ from ..ops.windowing import (
     resample_to_grid,
 )
 from .fetch import TS_SPAN_CAP, grid_from_series
+from ..utils import tracing
 from ..utils.locks import make_lock
 
 __all__ = ["DeltaWindowSource", "strip_range_params", "parse_range_params"]
@@ -231,6 +232,7 @@ class DeltaWindowSource:
             # source's fused byte->Window fast path when it has one
             with self._lock:
                 self.full_fetches += 1
+            tracing.tracer.add_note("fetch_full")
             fw = getattr(self.inner, "fetch_window", None)
             if fw is not None:
                 win = fw(url)
@@ -257,6 +259,7 @@ class DeltaWindowSource:
         if entry is None:
             with self._lock:
                 self.full_fetches += 1
+            tracing.tracer.add_note("fetch_full")
             return self._full(url, key, rng)
         win = self._try_delta(url, key, rng, entry)
         with self._lock:
@@ -264,6 +267,10 @@ class DeltaWindowSource:
                 self.delta_hits += 1
             else:
                 self.full_fetches += 1
+        # per-job fetch provenance (thread-local note, read by the engine's
+        # preprocess bracket): delta splice vs full refetch
+        tracing.tracer.add_note("fetch_delta" if win is not None
+                                else "fetch_full")
         if win is not None:
             return win
         return self._full(url, key, rng)

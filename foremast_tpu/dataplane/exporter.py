@@ -16,12 +16,21 @@ the same registry via `samples()`.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 
 from ..utils.locks import make_lock
 from ..utils.promtext import escape_label_value as _esc
 from ..utils.promtext import sanitize_metric_name as _sanitize_name
+
+# default latency buckets (seconds) for record_histogram: spans the
+# engine's dynamic range from sub-ms memo-hit fetches to multi-minute
+# cold-compile cycles; p50/p99 of anything in between interpolates sanely
+DEFAULT_TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
 
 
 class VerdictExporter:
@@ -40,6 +49,11 @@ class VerdictExporter:
         # is dropped (a reset rate() window on a hostile flood beats
         # unbounded growth).
         self._counters: dict[tuple, float] = {}
+        # histograms: key -> [bucket_counts (+Inf implicit last), sum,
+        # count]; bucket EDGES are per metric NAME (first registration
+        # wins — one le= grid per series family, a Prometheus requirement)
+        self._hists: dict[tuple, list] = {}
+        self._hist_buckets: dict[str, tuple] = {}
         # metric name -> (prom type, help text); only metrics registered
         # here get `# HELP`/`# TYPE` exposition lines (the legacy verdict
         # gauges stay bare — their scrape contract predates the metadata)
@@ -74,6 +88,31 @@ class VerdictExporter:
             else:
                 self._meta.setdefault(name, ("counter", ""))
 
+    def record_histogram(self, name: str, labels: dict, value: float,
+                         help: str = "",
+                         buckets: tuple = DEFAULT_TIME_BUCKETS):
+        """One histogram observation; rendered as the Prometheus
+        `_bucket`/`_sum`/`_count` triplet so p50/p99 are a PromQL
+        histogram_quantile away instead of only a running max. Bounded by
+        the same key ceiling as counters (label sets can derive from
+        user-submitted jobs)."""
+        key = (name, tuple(sorted(labels.items())))
+        v = float(value)
+        with self._lock:
+            edges = self._hist_buckets.setdefault(name, tuple(buckets))
+            h = self._hists.get(key)
+            if h is None:
+                if len(self._hists) >= self.MAX_COUNTER_KEYS:
+                    del self._hists[next(iter(self._hists))]
+                h = self._hists[key] = [[0] * (len(edges) + 1), 0.0, 0]
+            h[0][bisect.bisect_left(edges, v)] += 1
+            h[1] += v
+            h[2] += 1
+            if help:
+                self._meta.setdefault(name, ("histogram", help))
+            else:
+                self._meta.setdefault(name, ("histogram", ""))
+
     def record_bounds(self, app: str, namespace: str, metric: str,
                       upper: float, lower: float, anomaly: float):
         labels = {"app": app, "namespace": namespace}
@@ -95,6 +134,12 @@ class VerdictExporter:
                 "foremastbrain:cycle_stage_seconds", {"stage": stage},
                 round(float(secs), 6),
                 help="Seconds spent per engine-cycle stage (last cycle).")
+            # distribution companion to the last-cycle gauge: p50/p99 per
+            # stage instead of only the latest sample
+            self.record_histogram(
+                "foremastbrain:cycle_stage_duration_seconds",
+                {"stage": stage}, float(secs),
+                help="Per-stage engine-cycle seconds (histogram).")
         for family, secs in families.items():
             self.record_gauge(
                 "foremastbrain:cycle_family_score_seconds",
@@ -132,6 +177,17 @@ class VerdictExporter:
                 for (name, labels), value in self._counters.items()
             ]
 
+    def histogram_samples(self):
+        """Point-in-time snapshot: [(name, labels, edges, counts, sum,
+        count)] — counts copied under the lock (scrape threads race the
+        cycle thread's observations)."""
+        with self._lock:
+            return [
+                (name, dict(labels), self._hist_buckets[name],
+                 list(h[0]), h[1], h[2])
+                for (name, labels), h in self._hists.items()
+            ]
+
     def render(self) -> str:
         """Prometheus text exposition (0.0.4). Samples are grouped per
         metric name (an exposition requirement once metadata lines exist),
@@ -157,4 +213,26 @@ class VerdictExporter:
                 # ':' is legal in prometheus metric names (recording-rule
                 # style)
                 lines.append(f"{name}{{{lab}}} {value}")
+        hists = sorted(self.histogram_samples(),
+                       key=lambda s: (s[0], sorted(s[1].items())))
+        seen_meta: set[str] = set()
+        for name, labels, edges, counts, total, n in hists:
+            if name not in seen_meta:
+                seen_meta.add(name)
+                kind_help = meta.get(name)
+                if kind_help is not None and kind_help[1]:
+                    lines.append(f"# HELP {name} {kind_help[1]}")
+                lines.append(f"# TYPE {name} histogram")
+            base = ",".join(
+                f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
+            sep = "," if base else ""
+            cum = 0
+            for edge, c in zip(edges, counts):
+                cum += c
+                lines.append(
+                    f'{name}_bucket{{{base}{sep}le="{edge:g}"}} {cum}')
+            cum += counts[-1]
+            lines.append(f'{name}_bucket{{{base}{sep}le="+Inf"}} {cum}')
+            lines.append(f"{name}_sum{{{base}}} {round(total, 6)}")
+            lines.append(f"{name}_count{{{base}}} {n}")
         return "\n".join(lines) + "\n"

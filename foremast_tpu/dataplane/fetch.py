@@ -37,6 +37,7 @@ from urllib.parse import urljoin, urlsplit
 import numpy as np
 
 from .. import native
+from ..utils import tracing
 from ..utils.locks import make_lock
 from ..ops.windowing import MAX_WINDOW_STEPS, Window, align_step, resample_to_grid
 
@@ -468,6 +469,8 @@ class CachingDataSource:
                 if now - at <= self.ttl_seconds:
                     self._cache.move_to_end(key)
                     self.hits += 1
+                    # per-job fetch provenance: served from the TTL cache
+                    tracing.tracer.add_note("fetch_cached")
                     return res
                 del self._cache[key]
             flight = self._flights.get(key)

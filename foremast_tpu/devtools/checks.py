@@ -7,6 +7,13 @@ lock-discipline   no blocking call lexically inside a ``with <lock>``
                   body; the static held-before graph (lexical nesting +
                   one level of same-class/same-module calls) stays
                   acyclic. Runtime complement: devtools/locktrace.py.
+trace-registry    tracing span names, flight-recorder event types, and
+                  verdict-provenance path tags come from registered
+                  constants (utils/tracing.py SPAN_NAMES,
+                  engine/flightrec.py EVENT_*, engine/provenance.py
+                  PATH_*) — no inline f-string or unregistered literal
+                  names, so the observability vocabulary stays a stable
+                  greppable inventory.
 knob-registry     every env read outside engine/config.py resolves
                   through utils/knobs.py; every registered knob has a
                   default and a docs/configuration.md row; reads name
@@ -30,7 +37,8 @@ import ast
 from .linter import Checker, Finding, ModuleInfo
 
 __all__ = ["default_checkers", "LockDiscipline", "KnobRegistry",
-           "MetricsLint", "ThreadHygiene", "JitHygiene"]
+           "MetricsLint", "ThreadHygiene", "JitHygiene",
+           "TraceNameRegistry"]
 
 
 # ---------------------------------------------------------------------------
@@ -340,7 +348,8 @@ class MetricsLint(Checker):
                 continue
             fname = dotted(node.func)
             last = fname.rsplit(".", 1)[-1] if fname else ""
-            if last not in ("record_gauge", "record_counter"):
+            if last not in ("record_gauge", "record_counter",
+                            "record_histogram"):
                 continue
             # skip the method definitions' own module internals? no —
             # every call site must conform.
@@ -590,6 +599,158 @@ class JitHygiene(Checker):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# (6) trace-registry
+# ---------------------------------------------------------------------------
+
+# registry source files: ALL_CAPS string-constant assignments in these
+# modules define the legal vocabularies
+_SPAN_REGISTRY_FILE = "foremast_tpu/utils/tracing.py"
+_EVENT_REGISTRY_FILE = "foremast_tpu/engine/flightrec.py"
+_PATH_REGISTRY_FILE = "foremast_tpu/engine/provenance.py"
+
+# instrumentation-free zones: bench/demo/devtools scripts may improvise
+_TRACE_EXEMPT_PREFIXES = (
+    "foremast_tpu/bench_",
+    "foremast_tpu/examples/",
+    "foremast_tpu/devtools/",
+)
+
+_SPAN_CALLS = {"span", "tracing.span", "tracer.span", "tracing.tracer.span",
+               "self.span", "tr.span"}
+
+
+def _collect_caps_strings(tree: ast.AST) -> set[str]:
+    """String literals inside module-level ALL_CAPS assignments (covers
+    plain constants, dict VALUES, and frozenset registries). Dict KEYS are
+    deliberately skipped: in maps like SCORE_SPANS they are lookup aliases
+    ('pair'), not registered names — collecting them would let a typo'd
+    span("pair") pass as registered."""
+    out: set[str] = set()
+
+    def visit(n: ast.AST):
+        if isinstance(n, ast.Dict):
+            for v in n.values:
+                visit(v)
+            return
+        if isinstance(n, ast.Constant):
+            if isinstance(n.value, str):
+                out.add(n.value)
+            return
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id.isupper()
+                   for t in node.targets):
+            continue
+        visit(node.value)
+    return out
+
+
+def _is_constant_ref(node: ast.AST) -> bool:
+    """Name/Attribute/Subscript whose terminal identifier is ALL_CAPS —
+    i.e. a reference to a registered constant or constant map."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    name = dotted(node)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last.isupper() and len(last) > 1
+
+
+class TraceNameRegistry(Checker):
+    name = "trace-registry"
+    require_reason = True
+
+    def __init__(self):
+        self._spans: set[str] = set()
+        self._events: set[str] = set()
+        self._paths: set[str] = set()
+        # deferred literal usages: (kind, literal, path, line)
+        self._literals: list[tuple[str, str, str, int]] = []
+
+    def _check_name_arg(self, kind: str, arg: ast.AST,
+                        module: ModuleInfo, line: int,
+                        findings: list[Finding]):
+        if isinstance(arg, ast.JoinedStr):
+            findings.append(Finding(
+                self.name, module.relpath, line,
+                f"inline f-string {kind} name — build it from a "
+                f"registered constant map instead (see utils/tracing.py "
+                f"SCORE_SPANS for the pattern)"))
+        elif isinstance(arg, ast.Constant):
+            if isinstance(arg.value, str):
+                self._literals.append((kind, arg.value, module.relpath,
+                                       line))
+        elif not _is_constant_ref(arg):
+            findings.append(Finding(
+                self.name, module.relpath, line,
+                f"dynamic {kind} name — route it through a registered "
+                f"constant (ALL_CAPS) so the name inventory stays static"))
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        if module.relpath == _SPAN_REGISTRY_FILE:
+            self._spans |= _collect_caps_strings(module.tree)
+            return []
+        if module.relpath == _EVENT_REGISTRY_FILE:
+            self._events |= _collect_caps_strings(module.tree)
+            return []
+        if module.relpath == _PATH_REGISTRY_FILE:
+            self._paths |= _collect_caps_strings(module.tree)
+            return []
+        if module.relpath.startswith(_TRACE_EXEMPT_PREFIXES):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname is None:
+                continue
+            last = fname.rsplit(".", 1)[-1]
+            if fname in _SPAN_CALLS and node.args:
+                self._check_name_arg("span", node.args[0], module,
+                                     node.lineno, findings)
+            elif last == "add_timing" and node.args:
+                self._check_name_arg("span", node.args[0], module,
+                                     node.lineno, findings)
+            elif last == "record_event" and node.args and any(
+                    part in ("flight", "recorder", "flightrec")
+                    for part in fname.split(".")):
+                # scoped to flight-recorder receivers: the operator layer
+                # has its own record_event (the Kubernetes Events API)
+                self._check_name_arg("event", node.args[0], module,
+                                     node.lineno, findings)
+            elif fname.endswith("provenance.record") and len(node.args) >= 2:
+                self._check_name_arg("provenance-path", node.args[1],
+                                     module, node.lineno, findings)
+        return findings
+
+    def finish(self) -> list[Finding]:
+        registries = {"span": self._spans, "event": self._events,
+                      "provenance-path": self._paths}
+        hints = {
+            "span": "utils/tracing.py SPAN_NAMES",
+            "event": "engine/flightrec.py EVENT_TYPES",
+            "provenance-path": "engine/provenance.py PATHS",
+        }
+        findings: list[Finding] = []
+        for kind, literal, path, line in self._literals:
+            reg = registries[kind]
+            if not reg:
+                continue  # single-file run: registry module not in scope
+            if literal not in reg:
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"{kind} name {literal!r} is not registered — add it "
+                    f"to {hints[kind]}"))
+        return findings
+
+
 def default_checkers(docs_text: str | None = None) -> list[Checker]:
     return [
         LockDiscipline(),
@@ -597,4 +758,5 @@ def default_checkers(docs_text: str | None = None) -> list[Checker]:
         MetricsLint(),
         ThreadHygiene(),
         JitHygiene(),
+        TraceNameRegistry(),
     ]

@@ -52,6 +52,8 @@ from ..resilience.policy import Deadline
 from ..utils import tracing
 from ..utils.timeutils import from_rfc3339
 from . import jobs as J
+from . import flightrec
+from . import provenance as prov
 from .config import EngineConfig, MetricPolicy
 from .health import HealthMonitor
 
@@ -239,6 +241,9 @@ class _JobState:
     unhealthy: list = field(default_factory=list)  # (metric, detail, anomaly pairs)
     judged_any: bool = False
     failed: str = ""
+    # per-job fetch accounting from the preprocess thread's trace notes
+    # (delta vs full, points, seconds) — provenance's "fetch mode" block
+    fetch: dict = field(default_factory=dict)
 
 
 class Analyzer:
@@ -305,11 +310,34 @@ class Analyzer:
         # family, lstm scoring, training) — the steady-state no-change
         # gate asserts this stays flat over a memo-hit cycle
         self.device_launches = 0
+        # -- observability: provenance + flight recorder + trace ids --
+        # per-(job, cycle) verdict attribution (engine/provenance.py):
+        # which verdict path fired, per-family scores vs thresholds,
+        # fetch mode — served at /jobs/<id>/explain. enabled=False (the
+        # PROVENANCE=0 A/B leg) turns every call into a no-op.
+        self.provenance = prov.ProvenanceRecorder(enabled=config.provenance)
+        # incident flight recorder (engine/flightrec.py): bounded ring of
+        # structured engine events, auto-dumped on the transition into
+        # OVERLOADED/STALLED and on graceful shutdown
+        self.flight = flightrec.FlightRecorder(
+            dump_dir=config.flight_dump_dir,
+            tracer=tracing.tracer, provenance=self.provenance,
+            knobs_fn=self._dump_knobs)
+        # cycle correlation id: worker-scoped monotonic sequence, bound
+        # into the tracer (spans + log records) and stamped on provenance
+        self._cycle_seq = 0
+        self.current_cycle_id = ""
+        # jobs whose lstm verdict was served from the z-memo this cycle
+        # (provenance memo-hit classification); reset per cycle
+        self._lstm_memo_jobs: set = set()
         # -- degraded-mode operation state (docs/resilience.md) --
         # health state machine: the runtime wires cycle cadence + breaker
         # boards in; standalone analyzers still compute shed/stale/
-        # watchdog-driven states
-        self.health = HealthMonitor(exporter=self.exporter)
+        # watchdog-driven states. The flight recorder hears its
+        # transitions (and dumps on OVERLOADED/STALLED).
+        self.health = HealthMonitor(exporter=self.exporter,
+                                    recorder=self.flight)
+        self.flight.health_fn = self.health.state
         # load shedding (CYCLE_DEADLINE_S): cumulative shed count + the
         # consecutive-shed streak per open job (a shed job sorts ahead of
         # its priority class next cycle, so a permanently-blown budget
@@ -370,23 +398,63 @@ class Analyzer:
                     s.metric, s.historical, s.current, s.is_increase,
                     s.priority, s.is_absolute))
 
+    def _dump_knobs(self) -> dict:
+        """Knob values folded into flight-recorder dumps: the degraded-mode
+        and observability controls an incident post-mortem needs."""
+        cfg = self.config
+        from ..utils import knobs as _knobs
+
+        return {
+            "engine": {
+                "cycle_deadline_seconds": cfg.cycle_deadline_seconds,
+                "max_stale_seconds": cfg.max_stale_seconds,
+                "quarantine_after": cfg.quarantine_after,
+                "watchdog_seconds": cfg.watchdog_seconds,
+                "fetch_cycle_deadline_seconds":
+                    cfg.fetch_cycle_deadline_seconds,
+                "score_pipeline": cfg.score_pipeline,
+                "score_memo": cfg.score_memo,
+                "delta_fetch": cfg.delta_fetch,
+                "provenance": cfg.provenance,
+                "max_claim_per_cycle": cfg.max_claim_per_cycle,
+                "fetch_concurrency": cfg.fetch_concurrency,
+            },
+            "env": {name: k.read()
+                    for name, k in sorted(_knobs.all_knobs().items())
+                    if k.scope in ("runtime", "devtools")},
+        }
+
     # ------------------------------------------------------------------ fetch
     def _fetch_window(self, url: str, now: float) -> Window | None:
         if not url:
             return None
         url = materialize_placeholders(url, now)
-        # byte-level sources expose fetch_window: body -> grid Window in one
-        # fused native call, skipping the intermediate (ts, vals) arrays
-        # (fetch.window_from_prometheus_body). Series-level sources (fixture
-        # dicts, wavefront) go through fetch() + grid_from_series — the two
-        # paths are asserted equivalent in tests/test_native.py.
-        fw = getattr(self.source, "fetch_window", None)
-        if fw is not None:
-            win = fw(url)
+        t0 = time.perf_counter()
+        try:
+            # byte-level sources expose fetch_window: body -> grid Window
+            # in one fused native call, skipping the intermediate
+            # (ts, vals) arrays (fetch.window_from_prometheus_body).
+            # Series-level sources (fixture dicts, wavefront) go through
+            # fetch() + grid_from_series — the two paths are asserted
+            # equivalent in tests/test_native.py.
+            fw = getattr(self.source, "fetch_window", None)
+            if fw is not None:
+                win = fw(url)
+            else:
+                win = None
+            if win is None:
+                ts, vals = self.source.fetch(url)
+                win = grid_from_series(ts, vals)
             if win is not None:
-                return win
-        ts, vals = self.source.fetch(url)
-        return grid_from_series(ts, vals)
+                tracing.tracer.add_note("points", int(win.values.shape[0]))
+            return win
+        finally:
+            dt = time.perf_counter() - t0
+            tracing.tracer.add_note("fetches", 1)
+            tracing.tracer.add_note("fetch_seconds", dt)
+            self.exporter.record_histogram(
+                "foremastbrain:fetch_seconds", {}, dt,
+                help="Per-window metric fetch latency (seconds).")
 
     def _preprocess(self, doc: J.Document, now: float):
         """Fetch all windows for a job; returns (pair, band, bi, multi, hpa)
@@ -510,10 +578,17 @@ class Analyzer:
         err: list = []
         done = threading.Event()
         abandoned = {"flag": False}
+        # cross-thread trace correlation: the sacrificial thread adopts
+        # this thread's trace context, so spans it opens parent under the
+        # cycle trace (and its log lines carry cycle_id) instead of
+        # orphaning; an ABANDONED thread can at worst append late,
+        # silently-dropped children — never corrupt another stack
+        ctx = tracing.tracer.context()
 
         def run():
             try:
-                out.append(fn(*args))
+                with tracing.tracer.attach(ctx):
+                    out.append(fn(*args))
             except BaseException as e:  # noqa: BLE001 - relayed to caller
                 err.append(e)
             finally:
@@ -550,10 +625,21 @@ class Analyzer:
 
     def _record_watchdog_fire(self):
         self.watchdog_fires_total += 1
+        self.flight.record_event(flightrec.EVENT_WATCHDOG,
+                                 abandoned=self._watchdog_abandoned)
         self.exporter.record_counter(
             "foremastbrain:watchdog_fires_total", {},
             help="device materializations timed out by the collect "
                  "watchdog (WATCHDOG_S)")
+
+    def _prov_content(self, job_id: str) -> str | None:
+        """Compact provenance JSON for a terminal Document's
+        processing_content (None keeps the field untouched when
+        provenance is off — the A/B identity contract covers
+        status/reason/anomaly; the attachment itself is the feature)."""
+        if not self.provenance.enabled:
+            return None
+        return self.provenance.summary_json(job_id) or None
 
     def quarantined_count(self, now: float | None = None) -> int:
         """Jobs currently parked in poison quarantine. Snapshot first
@@ -1145,6 +1231,7 @@ class Analyzer:
                 if prev is not None and prev[0] == zfp:
                     self._lstm_z_memo.move_to_end(jkey)
                     self.lstm_rescore_skips += 1
+                    self._lstm_memo_jobs.add(it.job_id)
                     memo_zs.append((it, prev[1]))
                     continue
                 zfp_by_job[jkey] = zfp
@@ -1606,6 +1693,8 @@ class Analyzer:
                  "outages (bounded by MAX_STALE_S)")
         reason = (f"stale verdict served (age {age:.0f}s, last judged "
                   f"healthy): {failure}")
+        self.flight.record_event(flightrec.EVENT_STALE_SERVE,
+                                 job_id=doc.id, age=round(age, 1))
         try:
             end_time = from_rfc3339(doc.end_time)
         except (ValueError, TypeError):
@@ -1619,9 +1708,16 @@ class Analyzer:
                 self.store.advance(doc.id, J.PREPROCESS_COMPLETED,
                                    J.POSTPROCESS_INPROGRESS, worker=worker)
             self._stale_state.pop(doc.id, None)
+            self.provenance.record(
+                doc.id, prov.PATH_STALE_SERVED, status=J.COMPLETED_HEALTH,
+                detail=f"age {age:.0f}s", reason=reason)
             self.store.transition(doc.id, J.COMPLETED_HEALTH, reason=reason,
-                                  worker=worker)
+                                  worker=worker,
+                                  processing_content=self._prov_content(doc.id))
             return J.COMPLETED_HEALTH
+        self.provenance.record(
+            doc.id, prov.PATH_STALE_SERVED, status=J.INITIAL,
+            detail=f"age {age:.0f}s", reason=reason)
         self.store.transition(doc.id, J.INITIAL, reason=reason, worker=worker)
         return J.INITIAL
 
@@ -1644,6 +1740,9 @@ class Analyzer:
                         QUARANTINE_MAX_S)
             q[1] = now + delay
             self.jobs_quarantined_total += 1
+            self.flight.record_event(flightrec.EVENT_QUARANTINE,
+                                     job_id=job_id, delay_s=delay,
+                                     times=q[2])
             self.exporter.record_counter(
                 "foremastbrain:jobs_quarantined_total", {},
                 help="poison-job quarantine parkings (QUARANTINE_AFTER "
@@ -1651,8 +1750,18 @@ class Analyzer:
 
     def run_cycle(self, worker: str = "worker-0", now: float | None = None) -> dict:
         """One engine cycle. Returns {job_id: new_status} for observability."""
-        with tracing.span("engine.cycle", worker=worker):
+        # cycle correlation id: bound into the tracer BEFORE the cycle
+        # span opens, so the span's attrs, every cross-thread child span,
+        # every log record (TraceContextFilter), and every provenance
+        # record of this cycle carry the same grep-able id
+        self._cycle_seq += 1
+        cycle_id = f"{worker}-c{self._cycle_seq}"
+        self.current_cycle_id = cycle_id
+        t_cycle0 = time.perf_counter()
+        with tracing.tracer.bind(cycle_id=cycle_id), \
+                tracing.span(tracing.SPAN_ENGINE_CYCLE, worker=worker):
             now = time.time() if now is None else now
+            self.provenance.begin_cycle(cycle_id, worker=worker)
             # degraded mode: the whole-cycle deadline budget
             # (CYCLE_DEADLINE_S). Burns down through fetch -> preprocess ->
             # dispatch; once expired, un-preprocessed jobs are shed in
@@ -1694,6 +1803,12 @@ class Analyzer:
                 deadline_overrun=(cycle_dl is not None
                                   and cycle_dl.expired()),
             )
+            # cycle-duration distribution (p50/p99 on /metrics — the
+            # last-cycle stage gauges alone can't answer tail questions)
+            self.exporter.record_histogram(
+                "foremastbrain:cycle_seconds", {},
+                time.perf_counter() - t_cycle0,
+                help="End-to-end engine cycle duration (seconds).")
             return outcomes
 
     def _job_priority(self, doc: J.Document) -> tuple:
@@ -1712,8 +1827,11 @@ class Analyzer:
 
     def _stream_prep(self, claimed: list, now: float,
                      deadline: Deadline | None = None):
-        """Yield (doc_id, items, failed) per job, in claim order, as the
-        fetch pool completes chunks.
+        """Yield (doc_id, items, failed, fetch_notes) per job, in claim
+        order, as the fetch pool completes chunks. `fetch_notes` is the
+        tracer's per-job fetch accounting (delta/full/cached counts,
+        points, seconds) for the provenance record; shed jobs yield
+        `(doc.id, None, _SHED, {})`.
 
         Per-job fetches overlap on a bounded pool: fetch is network-bound
         in production (and the native parser releases the GIL during its C
@@ -1746,19 +1864,30 @@ class Analyzer:
         guaranteed = next(
             (d.id for d in claimed if d.strategy in CONTINUOUS_STRATEGIES),
             None)
+        # trace-context handle captured on the cycle thread: every fetch
+        # pool worker attaches it, so spans opened during preprocess parent
+        # under the cycle trace and dataplane log lines carry cycle_id —
+        # the PR 2 thread pool no longer orphans its spans
+        ctx = tracing.tracer.context()
 
         def prep_many(chunk):
             out = []
-            for doc in chunk:
-                if (deadline is not None and doc.id != guaranteed
-                        and doc.strategy in CONTINUOUS_STRATEGIES
-                        and deadline.expired()):
-                    out.append((doc.id, None, _SHED))
-                    continue
-                try:
-                    out.append((doc.id, self._preprocess(doc, now), ""))
-                except FetchError as e:
-                    out.append((doc.id, None, str(e)))
+            with tracing.tracer.attach(ctx):
+                for doc in chunk:
+                    if (deadline is not None and doc.id != guaranteed
+                            and doc.strategy in CONTINUOUS_STRATEGIES
+                            and deadline.expired()):
+                        out.append((doc.id, None, _SHED, {}))
+                        continue
+                    with tracing.tracer.bind(job_id=doc.id):
+                        tracing.tracer.begin_notes()
+                        try:
+                            items = self._preprocess(doc, now)
+                            out.append((doc.id, items, "",
+                                        tracing.tracer.take_notes()))
+                        except FetchError as e:
+                            out.append((doc.id, None, str(e),
+                                        tracing.tracer.take_notes()))
             return out
 
         workers = min(max(self.config.fetch_concurrency, 1), len(claimed) or 1)
@@ -1791,6 +1920,10 @@ class Analyzer:
             for doc in claimed:
                 q = self._quarantine.get(doc.id)
                 if q is not None and now < q[1]:
+                    self.provenance.record(
+                        doc.id, prov.PATH_QUARANTINED, status=J.INITIAL,
+                        detail=(f"re-admission in {q[1] - now:.0f}s, "
+                                f"parked {q[2]}x"))
                     self.store.transition(
                         doc.id, J.INITIAL, worker=worker,
                         reason=(f"quarantined: scoring poisoned; "
@@ -1812,6 +1945,7 @@ class Analyzer:
         all_hpas: list[_HpaItem] = []
         self._lstm_trained_this_cycle = 0
         self._lstm_budget_skipped_ids = set()
+        self._lstm_memo_jobs = set()
         launches0 = self.device_launches
         rescore_skips0 = self.lstm_rescore_skips
         shed_cycle0 = self.jobs_shed_total
@@ -1820,13 +1954,15 @@ class Analyzer:
         pipe = CyclePipeline(self) if self.config.score_pipeline else None
         stages = {"preprocess": 0.0, "dispatch": 0.0, "collect": 0.0,
                   "fold": 0.0}
-        with tracing.span("engine.preprocess", jobs=len(claimed)):
+        with tracing.span(tracing.SPAN_ENGINE_PREPROCESS, jobs=len(claimed)):
             for doc in claimed:
                 states[doc.id] = _JobState(doc)
             t_wait = time.perf_counter()
-            for doc_id, items, failed in self._stream_prep(
+            for doc_id, items, failed, fetch_notes in self._stream_prep(
                     claimed, now, cycle_dl):
                 stages["preprocess"] += time.perf_counter() - t_wait
+                if fetch_notes:
+                    states[doc_id].fetch = fetch_notes
                 if failed:
                     states[doc_id].failed = failed
                 else:
@@ -1842,6 +1978,7 @@ class Analyzer:
                         # accounts its own dispatch time)
                         pipe.feed(pairs, bands, bis, multis, hpas)
                 t_wait = time.perf_counter()
+        shed_ids: list = []
         for doc_id, st in states.items():
             if not st.failed:
                 self._shed_streak.pop(doc_id, None)
@@ -1857,6 +1994,10 @@ class Analyzer:
                 # would have produced unshed (tests/test_degraded.py).
                 self.jobs_shed_total += 1
                 self._shed_streak[doc_id] = self._shed_streak.get(doc_id, 0) + 1
+                shed_ids.append(doc_id)
+                self.provenance.record(
+                    doc_id, prov.PATH_SHED_CARRYOVER, status=J.INITIAL,
+                    detail=f"streak {self._shed_streak[doc_id]}")
                 self.exporter.record_counter(
                     "foremastbrain:jobs_shed_total", {},
                     help="jobs shed by the cycle deadline budget and "
@@ -1876,20 +2017,31 @@ class Analyzer:
             elif doc.strategy in CONTINUOUS_STRATEGIES:
                 # perpetual jobs survive transient fetch errors: requeue
                 # instead of dying terminally on one network blip
+                self.provenance.record(
+                    doc_id, prov.PATH_FETCH_RETRY, status=J.INITIAL,
+                    reason=st.failed, fetch=st.fetch)
                 self.store.transition(
                     doc_id, J.INITIAL, reason=f"fetch retry: {st.failed}",
                     worker=worker,
                 )
                 outcomes[doc_id] = J.INITIAL
             else:
+                self.provenance.record(
+                    doc_id, prov.PATH_NO_DATA, status=J.PREPROCESS_FAILED,
+                    reason=st.failed, fetch=st.fetch)
                 self.store.transition(
                     doc_id, J.PREPROCESS_FAILED, reason=st.failed,
-                    worker=worker)
+                    worker=worker,
+                    processing_content=self._prov_content(doc_id))
                 outcomes[doc_id] = J.PREPROCESS_FAILED
+        if shed_ids:
+            self.flight.record_event(flightrec.EVENT_SHED,
+                                     count=len(shed_ids),
+                                     jobs=shed_ids[:16])
 
         live = {k: v for k, v in states.items() if not v.failed}
         fam_seconds: dict[str, float] = {}
-        with tracing.span("engine.score", pairs=len(all_pairs),
+        with tracing.span(tracing.SPAN_ENGINE_SCORE, pairs=len(all_pairs),
                           bands=len(all_bands), bis=len(all_bis),
                           multis=len(all_multis), hpas=len(all_hpas)):
             if pipe is not None:
@@ -1902,12 +2054,13 @@ class Analyzer:
                 # (engine.score.<fam>), span or not
                 for fam in ("pair", "band", "bivariate", "hpa"):
                     tracing.tracer.add_timing(
-                        f"engine.score.{fam}", fam_seconds.get(fam, 0.0))
+                        tracing.SCORE_SPANS[fam], fam_seconds.get(fam, 0.0))
             else:
                 # barriered fallback (SCORE_PIPELINE=0): one child span per
                 # model family, families strictly sequential
                 def timed(fam, score_fn, items, attrs_fn=None):
-                    with tracing.span(f"engine.score.{fam}", n=len(items)) as sp:
+                    with tracing.span(tracing.SCORE_SPANS[fam],
+                                      n=len(items)) as sp:
                         t0 = time.perf_counter()
                         res = self._isolate(score_fn, items)
                         fam_seconds[fam] = time.perf_counter() - t0
@@ -1929,6 +2082,27 @@ class Analyzer:
             self.lstm_budget_skips += len(self._lstm_budget_skipped_ids)
 
         t_fold = time.perf_counter()
+        # -- provenance collection (zero work when recording is off) --
+        # per-family score-vs-threshold entries and judged-result counts
+        # per job; counts vs the pipeline's memo-hit map classify each
+        # verdict as fresh-scored or memo-served.
+        prov_on = self.provenance.enabled
+        fam_entries: dict[str, list] = {}
+        judged_items: dict[str, int] = {}
+        memo_job_hits = pipe.memo_job_hits if pipe is not None else {}
+
+        def _vpath(job_id: str) -> tuple:
+            """(path, detail) for a judged job: memo-hit when EVERY result
+            came from the fingerprint memo, scored otherwise."""
+            n = judged_items.get(job_id, 0)
+            m = memo_job_hits.get(job_id, 0) + (
+                1 if job_id in self._lstm_memo_jobs else 0)
+            if n and m >= n:
+                return prov.PATH_MEMO_HIT, f"{m}/{n} results from memo"
+            if m:
+                return prov.PATH_SCORED, f"{n - m}/{n} fresh, {m} memo"
+            return prov.PATH_SCORED, ""
+
         # fold per-metric results into per-job verdicts
         for it in all_pairs:
             r = pair_res.get((it.job_id, it.metric, "pair"))
@@ -1936,6 +2110,13 @@ class Analyzer:
                 continue
             st = live[it.job_id]
             st.judged_any = True
+            if prov_on:
+                judged_items[it.job_id] = judged_items.get(it.job_id, 0) + 1
+                fam_entries.setdefault(it.job_id, []).append({
+                    "family": "pair", "metric": it.metric,
+                    "min_p": round(r["min_p"], 8),
+                    "alpha": self.config.pairwise_threshold,
+                    "unhealthy": bool(r["unhealthy"])})
             if r["unhealthy"]:
                 causes = []
                 if r["pairwise_unhealthy"]:
@@ -1951,6 +2132,13 @@ class Analyzer:
                 continue
             st = live[it.job_id]
             st.judged_any = True
+            if prov_on:
+                judged_items[it.job_id] = judged_items.get(it.job_id, 0) + 1
+                fam_entries.setdefault(it.job_id, []).append({
+                    "family": "band", "metric": it.metric,
+                    "anomalous_points": int(r["count"]),
+                    "band": [round(r["lower"], 4), round(r["upper"], 4)],
+                    "unhealthy": bool(r["unhealthy"])})
             self.exporter.record_bounds(
                 st.doc.app_name, st.doc.namespace, it.metric,
                 r["upper"], r["lower"], float(r["unhealthy"]),
@@ -1970,6 +2158,12 @@ class Analyzer:
                 continue
             st = live[it.job_id]
             st.judged_any = True
+            if prov_on:
+                judged_items[it.job_id] = judged_items.get(it.job_id, 0) + 1
+                fam_entries.setdefault(it.job_id, []).append({
+                    "family": "bivariate", "metric": "&".join(it.metrics),
+                    "anomalous_points": int(r["count"]),
+                    "unhealthy": bool(r["unhealthy"])})
             for metric, (upper, lower) in r["bounds"].items():
                 self.exporter.record_bounds(
                     st.doc.app_name, st.doc.namespace, metric,
@@ -1990,6 +2184,13 @@ class Analyzer:
                 continue
             st = live[it.job_id]
             st.judged_any = True
+            if prov_on:
+                judged_items[it.job_id] = judged_items.get(it.job_id, 0) + 1
+                fam_entries.setdefault(it.job_id, []).append({
+                    "family": "lstm", "metric": "+".join(it.metrics),
+                    "z": round(float(r["z"]), 4),
+                    "threshold": self.config.lstm_threshold,
+                    "unhealthy": bool(r["unhealthy"])})
             if r["unhealthy"]:
                 st.unhealthy.append(
                     (
@@ -1999,6 +2200,12 @@ class Analyzer:
                         [],
                     )
                 )
+        if prov_on:
+            # hpa results fold inside _finish_hpa; count them here so the
+            # memo-vs-fresh classification sees them like every family
+            for job_id in hpa_res:
+                if job_id in live:
+                    judged_items[job_id] = judged_items.get(job_id, 0) + 1
 
         for job_id, st in live.items():
             doc = st.doc
@@ -2011,6 +2218,9 @@ class Analyzer:
                     # (or aborting a canary) would misattribute the
                     # device's fault to the workload and blank coverage
                     # long after the device recovers
+                    self.provenance.record(
+                        job_id, prov.PATH_WATCHDOG_FAILOVER,
+                        status=J.INITIAL, reason=reason, fetch=st.fetch)
                     self.store.transition(
                         job_id, J.INITIAL, reason=reason, worker=worker)
                     outcomes[job_id] = J.INITIAL
@@ -2021,17 +2231,27 @@ class Analyzer:
                     # parked (quarantine) instead of re-burning the
                     # _isolate fallback every cycle forever
                     self._record_scoring_failure(job_id, now)
+                    self.provenance.record(
+                        job_id, prov.PATH_BLAST_RADIUS, status=J.INITIAL,
+                        reason=reason, fetch=st.fetch)
                     self.store.transition(job_id, J.INITIAL, reason=reason, worker=worker)
                     outcomes[job_id] = J.INITIAL
                 else:
                     self._quarantine.pop(job_id, None)  # terminal: moot
-                    self.store.transition(job_id, J.ABORT, reason=reason, worker=worker)
+                    self.provenance.record(
+                        job_id, prov.PATH_BLAST_RADIUS, status=J.ABORT,
+                        reason=reason, fetch=st.fetch)
+                    self.store.transition(
+                        job_id, J.ABORT, reason=reason, worker=worker,
+                        processing_content=self._prov_content(job_id))
                     outcomes[job_id] = J.ABORT
                 continue
             # scored cleanly: full quarantine reset (consecutive = 0)
             self._quarantine.pop(job_id, None)
             if doc.strategy == STRATEGY_HPA:
-                outcomes[job_id] = self._finish_hpa(st, hpa_res.get(job_id), worker, now)
+                outcomes[job_id] = self._finish_hpa(
+                    st, hpa_res.get(job_id), worker, now,
+                    path_info=_vpath(job_id) if prov_on else None)
                 continue
             try:
                 end_time = from_rfc3339(doc.end_time)
@@ -2043,10 +2263,19 @@ class Analyzer:
                 reason = "; ".join(f"{m}: {d}" for m, d, _ in st.unhealthy)
                 anomaly = {m: pairs for m, _, pairs in st.unhealthy if pairs}
                 self._stale_state.pop(job_id, None)
+                reason = f"anomaly detected on {metrics} :: {reason}"
+                if prov_on:
+                    path, detail = _vpath(job_id)
+                    self.provenance.record(  # lint: disable=trace-registry -- path from _vpath (registered constants only)
+                        job_id, path, status=J.COMPLETED_UNHEALTH,
+                        detail=detail, reason=reason,
+                        families=fam_entries.get(job_id),
+                        fetch=st.fetch)
                 self.store.transition(
                     job_id, J.COMPLETED_UNHEALTH,
-                    reason=f"anomaly detected on {metrics} :: {reason}",
+                    reason=reason,
                     anomaly=anomaly, worker=worker,
+                    processing_content=self._prov_content(job_id),
                 )
                 outcomes[job_id] = J.COMPLETED_UNHEALTH
             elif now < end_time:
@@ -2055,11 +2284,24 @@ class Analyzer:
                 # refreshes the job's warm stale-serving state.
                 if st.judged_any:
                     self._stale_state[job_id] = now
+                if prov_on and st.judged_any:
+                    path, detail = _vpath(job_id)
+                    self.provenance.record(  # lint: disable=trace-registry -- path from _vpath (registered constants only)
+                        job_id, path, status=J.INITIAL, detail=detail,
+                        families=fam_entries.get(job_id), fetch=st.fetch)
                 self.store.requeue(job_id, worker=worker)
                 outcomes[job_id] = J.INITIAL
             elif st.judged_any:
                 self._stale_state.pop(job_id, None)
-                self.store.transition(job_id, J.COMPLETED_HEALTH, worker=worker)
+                if prov_on:
+                    path, detail = _vpath(job_id)
+                    self.provenance.record(  # lint: disable=trace-registry -- path from _vpath (registered constants only)
+                        job_id, path, status=J.COMPLETED_HEALTH,
+                        detail=detail, families=fam_entries.get(job_id),
+                        fetch=st.fetch)
+                self.store.transition(
+                    job_id, J.COMPLETED_HEALTH, worker=worker,
+                    processing_content=self._prov_content(job_id))
                 outcomes[job_id] = J.COMPLETED_HEALTH
             else:
                 # no judgeable data at endTime: a warm job re-serves its
@@ -2071,18 +2313,28 @@ class Analyzer:
                 if served is not None:
                     outcomes[job_id] = served
                     continue
+                self.provenance.record(
+                    job_id, prov.PATH_NO_DATA, status=J.COMPLETED_UNKNOWN,
+                    reason="insufficient data points to judge",
+                    fetch=st.fetch)
                 self.store.transition(
                     job_id, J.COMPLETED_UNKNOWN,
                     reason="insufficient data points to judge", worker=worker,
+                    processing_content=self._prov_content(job_id),
                 )
                 outcomes[job_id] = J.COMPLETED_UNKNOWN
         stages["fold"] = time.perf_counter() - t_fold
         # per-stage observability: tracer stats (foremast_trace_* on
         # /metrics, bench decomposition) + foremastbrain gauges + /status
         for name, secs in stages.items():
-            tracing.tracer.add_timing(f"engine.stage.{name}", secs)
+            tracing.tracer.add_timing(tracing.STAGE_SPANS[name], secs)
         self.exporter.record_cycle_stages(stages, fam_seconds)
+        self.provenance.finish_cycle(
+            stage_seconds=stages,
+            device_launches=self.device_launches - launches0,
+            jobs=len(claimed))
         self.last_cycle_stages = {
+            "cycle_id": self.current_cycle_id,
             "jobs": len(claimed),
             "pipelined": pipe is not None,
             "stage_seconds": {k: round(v, 6) for k, v in stages.items()},
@@ -2125,9 +2377,13 @@ class Analyzer:
                         if j not in outcomes and self.store.get(j) is None]:
                 table.pop(jid, None)
 
-    def _finish_hpa(self, st: _JobState, res, worker: str, now: float) -> str:
+    def _finish_hpa(self, st: _JobState, res, worker: str, now: float,
+                    path_info: tuple | None = None) -> str:
         doc = st.doc
         if res is None:
+            self.provenance.record(
+                doc.id, prov.PATH_NO_DATA, status=J.INITIAL,
+                detail="no scoreable hpa window", fetch=st.fetch)
             self.store.requeue(doc.id, worker=worker)
             return J.INITIAL
         self._stale_state[doc.id] = now  # scored on fresh data this cycle
@@ -2138,6 +2394,20 @@ class Analyzer:
             f"hpa score {gated:.1f} (raw {res['raw_score']:.1f}) via "
             f"{reason_names.get(res['reason_code'], '?')} on {res['tps_metric']}"
         )
+        if self.provenance.enabled:
+            path, detail = path_info if path_info is not None \
+                else (prov.PATH_SCORED, "")
+            self.provenance.record(  # lint: disable=trace-registry -- path from _vpath (registered constants only)
+                doc.id, path, status=J.INITIAL, detail=detail,
+                reason=reason, fetch=st.fetch,
+                families=[{
+                    "family": "hpa", "metric": res["tps_metric"],
+                    "raw_score": round(float(res["raw_score"]), 2),
+                    "gated_score": round(float(gated), 2),
+                    "sla_metric": res["sla_metric"],
+                    "sla_current": round(float(res["sla_current"]), 4),
+                    "sla_limit": round(float(res["sla_limit"]), 4),
+                }])
         if res.get("has_pod_data"):
             # per-pod normalization context rides the FREE-FORM reason;
             # details stay strictly {current, upper, lower} band entries —

@@ -234,6 +234,21 @@ class EngineConfig:
     # big first-cycle CPU executions can legitimately run long — enable
     # it once the fleet's shapes are prewarmed/compile-cached).
     watchdog_seconds: float = 0.0  # WATCHDOG_S
+    # -- observability (docs/operations.md "Debugging a verdict") --
+    # verdict provenance recording (PROVENANCE): per-(job, cycle)
+    # attribution records — which verdict path fired (scored / memo-hit /
+    # stale-served / shed-carryover / quarantined / watchdog-failover /
+    # blast-radius-isolated), per-family scores vs thresholds, fetch mode
+    # — served at /jobs/<id>/explain and attached to archived terminal
+    # Documents. Recording only observes the cycle (verdicts are
+    # byte-identical either way — pinned by tests/test_provenance.py);
+    # 0 disables for the A/B leg.
+    provenance: bool = True  # PROVENANCE
+    # flight-recorder dump directory (FLIGHT_DUMP_DIR): incident JSON
+    # snapshots (events + traces + provenance + knobs) written on the
+    # transition into OVERLOADED/STALLED and on SIGTERM. Empty = the
+    # system temp dir.
+    flight_dump_dir: str = ""  # FLIGHT_DUMP_DIR
     policies: dict = field(default_factory=lambda: dict(DEFAULT_POLICIES))
 
     def policy_for(self, metric_name: str) -> MetricPolicy:
@@ -380,5 +395,7 @@ def from_env(env=None) -> EngineConfig:
         max_stale_seconds=_env_float(env, "MAX_STALE_S", 300.0),
         quarantine_after=_env_int(env, "QUARANTINE_AFTER", 3),
         watchdog_seconds=_env_float(env, "WATCHDOG_S", 0.0),
+        provenance=_env_bool(env, "PROVENANCE", True),
+        flight_dump_dir=env.get("FLIGHT_DUMP_DIR", ""),
         policies=policies,
     )

@@ -67,7 +67,7 @@ class HealthMonitor:
 
     def __init__(self, exporter=None, cycle_seconds: float = 10.0,
                  stall_grace_seconds: float = 30.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, recorder=None):
         self._lock = make_lock("engine.health")
         self.exporter = exporter
         self.cycle_seconds = float(cycle_seconds)
@@ -76,6 +76,11 @@ class HealthMonitor:
         self.stall_grace_seconds = float(stall_grace_seconds)
         self._clock = clock
         self.breakers_fn = None  # () -> {key: "closed"|"half-open"|"open"}
+        # flight recorder (engine/flightrec.py): hears state transitions
+        # and breaker flips; transitions into OVERLOADED/STALLED auto-dump
+        self.recorder = recorder
+        self._last_seen_state: str | None = None
+        self._last_open_breakers: tuple = ()
         self._started_at: float | None = None
         self._last_cycle_end: float | None = None
         # last COMPLETED cycle's degraded-mode signals
@@ -166,18 +171,64 @@ class HealthMonitor:
         reference = last_end if last_end is not None else started
         if reference is not None and now - reference > stall_after:
             detail["seconds_since_cycle"] = round(now - reference, 3)
-            return STATE_STALLED, detail
+            return self._observe(STATE_STALLED, detail)
         # OVERLOADED means coverage was actually cut (jobs shed). A cycle
         # that merely OVERRAN the budget without shedding (scoring ran
         # long after every fetch landed) produced full, fresh coverage —
         # that is a capacity warning (`deadline_overrun` in the detail),
         # not a reason to fail readiness or hold remediation.
         if last["shed"] > 0:
-            return STATE_OVERLOADED, detail
+            return self._observe(STATE_OVERLOADED, detail)
         if (open_breakers or last["stale_served"] > 0
                 or last["watchdog_fires"] > 0 or last["quarantined"] > 0):
-            return STATE_DEGRADED, detail
-        return STATE_OK, detail
+            return self._observe(STATE_DEGRADED, detail)
+        return self._observe(STATE_OK, detail)
+
+    def _observe(self, state: str, detail: dict) -> tuple[str, dict]:
+        """Edge-detect state transitions and breaker flips for the flight
+        recorder. Detection happens wherever the state is COMPUTED — the
+        STALLED transition has no end_cycle() to hook, it is only ever
+        seen by a reader (/readyz probe, /metrics scrape, the operator's
+        suppression poll). Events are recorded UNDER the lock so the ring
+        order always matches the edge order (two readers winning
+        successive edges — incident then recovery — must not land
+        inverted in the ring); only the auto-DUMP (file I/O, re-reads
+        tracer/provenance state) runs outside."""
+        if self.recorder is None:
+            return state, detail
+        fire_transition = None
+        with self._lock:
+            if self._last_seen_state != state:
+                prev = self._last_seen_state
+                self._last_seen_state = state
+                # the engine is born OK: a first observation that is
+                # already degraded/overloaded/stalled IS a transition
+                # (the incident predates the first probe)
+                if prev is not None or state != STATE_OK:
+                    fire_transition = (prev or STATE_OK, state)
+            breakers = tuple(detail.get("open_breakers") or ())
+            flips = None
+            if breakers != self._last_open_breakers:
+                flips = (self._last_open_breakers, breakers)
+                self._last_open_breakers = breakers
+            try:
+                if flips is not None:
+                    from .flightrec import EVENT_BREAKER
+
+                    self.recorder.record_event(
+                        EVENT_BREAKER, was=list(flips[0]),
+                        now=list(flips[1]))
+                if fire_transition is not None:
+                    self.recorder.record_transition(
+                        fire_transition[0], fire_transition[1], detail)
+            except Exception:  # noqa: BLE001 - diagnostics never break a probe
+                pass
+        if fire_transition is not None:
+            try:
+                self.recorder.maybe_auto_dump(state, detail)
+            except Exception:  # noqa: BLE001 - diagnostics never break a probe
+                pass
+        return state, detail
 
     # ------------------------------------------------------------- export
     def _export(self):

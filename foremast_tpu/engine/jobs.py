@@ -262,7 +262,8 @@ class JobStore:
             return self._jobs.get(job_id)
 
     def transition(self, job_id: str, new_status: str, *, reason: str = "",
-                   anomaly: dict | None = None, worker: str = "") -> Document:
+                   anomaly: dict | None = None, worker: str = "",
+                   processing_content: str | None = None) -> Document:
         with self._lock:
             doc = self._jobs[job_id]
             allowed = _TRANSITIONS.get(doc.status, set())
@@ -274,6 +275,10 @@ class JobStore:
                 doc.reason = reason
             if anomaly is not None:
                 doc.anomaly = anomaly
+            if processing_content is not None:
+                # verdict provenance rides the reference's free-form
+                # processing_content field into the archive record
+                doc.processing_content = processing_content
             if worker:
                 doc.lease_holder = worker
                 doc.lease_at = doc.modified_at

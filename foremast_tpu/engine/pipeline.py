@@ -80,6 +80,10 @@ class CyclePipeline:
             else None
         self.memo_results: dict = {f: {} for f in self.FAMILIES}
         self.memo_hits: dict = {}  # family -> hits this cycle
+        # provenance: which JOBS had items served from the memo this cycle
+        # (job_id -> hit count) — lets /jobs/<id>/explain attribute a
+        # verdict to the memo-hit path instead of a fresh device score
+        self.memo_job_hits: dict = {}
         self._fps: dict = {}       # (family, result_key) -> fingerprint
 
     def _memo_check(self, family: str, entry, T: int) -> bool:
@@ -94,6 +98,8 @@ class CyclePipeline:
             self.memo_hits[family] = self.memo_hits.get(family, 0) + 1
             self.an.score_memo_hits[family] = (
                 self.an.score_memo_hits.get(family, 0) + 1)
+            job_id = key[0] if isinstance(key, tuple) else key
+            self.memo_job_hits[job_id] = self.memo_job_hits.get(job_id, 0) + 1
             return True
         self._fps[(family, key)] = fp
         self.an.score_memo_misses[family] = (
@@ -268,7 +274,8 @@ class CyclePipeline:
                 results[family].update(self.memo_results[family])
         # lstm scores here, not in the stream: training mutates the model
         # cache under a per-cycle budget whose order must match claim order
-        with tracing.span("engine.score.lstm", n=len(self.multis)) as lsp:
+        with tracing.span(tracing.SCORE_SPANS["lstm"],
+                          n=len(self.multis)) as lsp:
             t1 = time.perf_counter()
             multi_res, multi_bad = an._isolate(an._score_multi, self.multis)
             lsp.attrs["budget_skips"] = len(an._lstm_budget_skipped_ids)

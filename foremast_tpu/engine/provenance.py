@@ -1,0 +1,182 @@
+"""Verdict provenance: per-(job, cycle) attribution records.
+
+PRs 3–4 gave the engine SEVEN distinct ways to produce a verdict (full
+score, fingerprint-memo reuse, stale-serve, shed carry-over, quarantine
+park, watchdog failover, blast-radius isolation) but nothing recorded
+WHICH path fired for a given job — when the operator suppresses a
+rollback or a canary flips Unhealthy, aggregate counters cannot answer
+the per-job "why". This module is that answer: the analyzer stamps one
+structured record per judged (job, cycle) into a bounded ring, the
+service serves the latest record at ``GET /jobs/<id>/explain``, the
+``foremast-tpu explain`` CLI renders it human-readably, terminal
+verdicts carry a compact copy into the archive Document
+(``processing_content``), and the flight recorder folds affected jobs'
+records into its incident dumps.
+
+Always-on and allocation-bounded: the ring and the per-job index are
+LRU-capped, per-record family lists are capped, and with ``enabled=False``
+every method is a no-op — the A/B leg pins that verdicts are
+byte-identical either way (recording only OBSERVES the cycle; it never
+feeds back into scoring).
+
+Path tags are REGISTERED constants (the devtools trace-registry rule
+rejects inline literals), so the tag set stays a stable inventory the
+runbook can enumerate.
+"""
+from __future__ import annotations
+
+import json
+import time
+from collections import OrderedDict, deque
+
+from ..utils.locks import make_lock
+
+__all__ = [
+    "ProvenanceRecorder", "PATHS",
+    "PATH_SCORED", "PATH_MEMO_HIT", "PATH_STALE_SERVED",
+    "PATH_SHED_CARRYOVER", "PATH_QUARANTINED", "PATH_WATCHDOG_FAILOVER",
+    "PATH_BLAST_RADIUS", "PATH_FETCH_RETRY", "PATH_NO_DATA",
+]
+
+# -- verdict-path registry ---------------------------------------------------
+PATH_SCORED = "scored"                      # fresh device-scored verdict
+PATH_MEMO_HIT = "memo-hit"                  # served from fingerprint memo
+PATH_STALE_SERVED = "stale-served"          # last fresh verdict re-served
+PATH_SHED_CARRYOVER = "shed-carryover"      # cycle deadline shed the job
+PATH_QUARANTINED = "quarantined"            # parked as a poison job
+PATH_WATCHDOG_FAILOVER = "watchdog-failover"  # hung launch, infra requeue
+PATH_BLAST_RADIUS = "blast-radius-isolated"  # per-job isolation failed it
+PATH_FETCH_RETRY = "fetch-retry"            # transient fetch failure requeue
+PATH_NO_DATA = "no-data"                    # nothing judgeable (unknown/fail)
+
+PATHS = frozenset({
+    PATH_SCORED, PATH_MEMO_HIT, PATH_STALE_SERVED, PATH_SHED_CARRYOVER,
+    PATH_QUARANTINED, PATH_WATCHDOG_FAILOVER, PATH_BLAST_RADIUS,
+    PATH_FETCH_RETRY, PATH_NO_DATA,
+})
+
+# per-record bound on family score entries: a 40-metric job keeps its 16
+# most informative rows plus a drop count, not an unbounded list
+_MAX_FAMILY_ENTRIES = 16
+
+
+class ProvenanceRecorder:
+    """Bounded store of per-(job, cycle) verdict-attribution records.
+
+    The engine's cycle thread writes; HTTP/CLI threads read. All methods
+    are no-ops when ``enabled`` is False (the PROVENANCE=0 A/B leg)."""
+
+    def __init__(self, enabled: bool = True, max_jobs: int = 4096,
+                 ring_size: int = 1024):
+        self.enabled = enabled
+        self.max_jobs = max_jobs
+        self._lock = make_lock("engine.provenance")
+        self._latest: OrderedDict[str, dict] = OrderedDict()  # job -> record
+        self._ring: deque = deque(maxlen=ring_size)  # recent records
+        self._cycle: dict = {}        # shared per-cycle block (stamped late)
+        self._cycle_records: int = 0  # records written this cycle
+        self.records_total = 0
+
+    # ------------------------------------------------------------- writing
+    def begin_cycle(self, cycle_id: str, worker: str = ""):
+        """Open a cycle: records written until finish_cycle share one
+        mutable cycle block (stage timings land there after the fold)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._cycle = {"cycle_id": cycle_id, "worker": worker}
+            self._cycle_records = 0
+
+    def record(self, job_id: str, path: str, status: str = "",
+               detail: str = "", families: list | None = None,
+               fetch: dict | None = None, reason: str = ""):
+        """Stamp one job's verdict attribution for the open cycle."""
+        if not self.enabled:
+            return
+        rec = {
+            "job_id": job_id,
+            "ts": time.time(),
+            "path": path,
+            "status": status,
+            "cycle": self._cycle,  # shared ref; finish_cycle fills it in
+        }
+        if detail:
+            rec["detail"] = detail
+        if reason:
+            rec["reason"] = reason
+        if families:
+            if len(families) > _MAX_FAMILY_ENTRIES:
+                rec["families_dropped"] = len(families) - _MAX_FAMILY_ENTRIES
+                families = families[:_MAX_FAMILY_ENTRIES]
+            rec["families"] = families
+        if fetch:
+            rec["fetch"] = fetch
+        with self._lock:
+            self._latest[job_id] = rec
+            self._latest.move_to_end(job_id)
+            while len(self._latest) > self.max_jobs:
+                self._latest.popitem(last=False)
+            self._ring.append(rec)
+            self._cycle_records += 1
+            self.records_total += 1
+
+    def finish_cycle(self, stage_seconds: dict | None = None,
+                     device_launches: int | None = None,
+                     jobs: int | None = None):
+        """Close the cycle: stamp cycle-level context into the SHARED
+        cycle block every record of this cycle references (one mutation,
+        not one per record)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if stage_seconds is not None:
+                self._cycle["stage_seconds"] = {
+                    k: round(float(v), 6) for k, v in stage_seconds.items()}
+            if device_launches is not None:
+                self._cycle["device_launches"] = int(device_launches)
+            if jobs is not None:
+                self._cycle["jobs"] = int(jobs)
+
+    # ------------------------------------------------------------- reading
+    def get(self, job_id: str) -> dict | None:
+        """Latest record for a job (deep enough copy for JSON serving)."""
+        with self._lock:
+            rec = self._latest.get(job_id)
+            if rec is None:
+                return None
+            out = dict(rec)
+            out["cycle"] = dict(rec.get("cycle") or {})
+            return out
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        with self._lock:
+            recs = list(self._ring)[-limit:]
+            return [{**r, "cycle": dict(r.get("cycle") or {})}
+                    for r in recs]
+
+    def for_jobs(self, job_ids) -> dict:
+        """{job_id: record} for the ids that have one (flight dumps)."""
+        out = {}
+        for jid in job_ids:
+            rec = self.get(jid)
+            if rec is not None:
+                out[jid] = rec
+        return out
+
+    def summary_json(self, job_id: str, max_bytes: int = 4096) -> str:
+        """Compact JSON of a job's latest record for the archive
+        Document's processing_content — bounded so one verbose record
+        cannot bloat every archived verdict."""
+        rec = self.get(job_id)
+        if rec is None:
+            return ""
+        # archive documents are long-lived: keep the attribution skeleton,
+        # drop the bulky per-cycle timing block
+        slim = {k: v for k, v in rec.items() if k != "cycle"}
+        slim["cycle_id"] = (rec.get("cycle") or {}).get("cycle_id", "")
+        blob = json.dumps(slim)
+        if len(blob) > max_bytes:
+            slim.pop("families", None)
+            slim["families_dropped"] = "all"
+            blob = json.dumps(slim)
+        return blob

@@ -398,6 +398,11 @@ class Runtime:
         if self.store.archive is not None:
             released = self.store.release_leases(worker=self._worker_name)
             if released:
+                from .engine.flightrec import EVENT_LEASE_HANDOFF
+
+                self.analyzer.flight.record_event(
+                    EVENT_LEASE_HANDOFF, released=released,
+                    worker=self._worker_name)
                 log.info("released %d open lease(s) for peer adoption",
                          released)
             # drain the write-behind mirror: the release stamps above (and
@@ -416,6 +421,10 @@ class Runtime:
                 prev = n
                 self.store.flush()
                 time.sleep(0.05)
+        # incident flight recorder: a SIGTERM mid-incident must leave a
+        # self-contained artifact (events + traces + provenance + knobs)
+        # even when nobody was watching the pod. Best-effort by design.
+        self.analyzer.flight.dump(reason="shutdown")
         self.store.close()
 
     def run_forever(self, **kw):
@@ -451,8 +460,17 @@ def main():
     level = getattr(logging, name, None)
     logging.basicConfig(
         level=level if isinstance(level, int) else logging.INFO,
-        format="%(asctime)s [%(name)s] %(levelname)s %(message)s",
+        format="%(asctime)s [%(name)s] %(levelname)s "
+               "%(message)s%(trace_ctx)s",
     )
+    # trace-context log correlation: every record carries the current
+    # thread's cycle_id/job_id (empty string when unbound), so
+    # `grep cycle_id=<id>` lines the log up with /debug/traces and
+    # /jobs/<id>/explain. Must follow basicConfig — the filter attaches
+    # to the root handlers it created.
+    from .utils.tracing import install_log_filter
+
+    install_log_filter()
 
     from .parallel.distributed import host_info, initialize
 

@@ -148,6 +148,22 @@ def _wire_bool(flags: dict, key: str, default: bool, metric: str) -> bool:
     raise ApiError(400, f"invalid {key} {v!r} for metric {metric}")
 
 
+def _parse_provenance_blob(blob: str, source: str = "from_archive"):
+    """Decode a Document's attached provenance summary (processing_content)
+    back into an explain() record, tagged with where it was read from; None
+    when absent or not provenance JSON (legacy docs store free text here)."""
+    if not blob:
+        return None
+    try:
+        rec = json.loads(blob)
+    except ValueError:
+        return None
+    if not isinstance(rec, dict):
+        return None
+    rec[source] = True
+    return rec
+
+
 def _as_object(x, name: str) -> dict:
     """JSON-shape gate: real clients produce every type confusion (arrays
     for objects, strings for maps); each must be a clean 400, never a
@@ -283,6 +299,9 @@ class ForemastService:
         self.chaos_active = False  # stamped by the runtime when chaos is on
         # set by make_server: () -> the HTTP admission gate's shed counter
         self.http_shed_count = None
+        # /status build section: dumps and bug reports self-identify
+        # (package version + uptime + the cycle they were taken during)
+        self.started_at = time.time()
 
     # -- handlers, each returns (status, payload-dict | text) --
     def create(self, body: dict):
@@ -555,10 +574,17 @@ class ForemastService:
         counts plus the resilience layer's live breaker states and retry
         counters. The answer to "is the brain healthy, and if not, which
         dependency is it protecting itself from?" in one request."""
+        from .. import __version__
+
         out = {
             "status": "ok",
             "jobs": self.store.status_counts(),
             "chaos_active": self.chaos_active,
+            "build": {
+                "version": __version__,
+                "uptime_s": round(time.time() - self.started_at, 1),
+                "cycle_id": getattr(self.analyzer, "current_cycle_id", ""),
+            },
         }
         if self.analyzer is not None and getattr(
                 self.analyzer, "last_cycle_stages", None):
@@ -607,6 +633,71 @@ class ForemastService:
         from ..utils.tracing import tracer
 
         return 200, {"traces": tracer.snapshot(limit), "stats": tracer.stats()}
+
+    def explain(self, job_id: str):
+        """GET /jobs/<id>/explain — the per-job "why": which verdict path
+        fired last cycle (scored / memo-hit / stale-served / shed /
+        quarantined / watchdog-failover / blast-radius), per-family
+        scores vs thresholds, fetch mode, and the cycle context. Rendered
+        human-readably by `foremast-tpu explain <job>`."""
+        recorder = getattr(self.analyzer, "provenance", None)
+        rec = recorder.get(job_id) if recorder is not None else None
+        doc = self.store.get(job_id)
+        job = None
+        if doc is not None:
+            job = {
+                "jobId": doc.id,
+                "appName": doc.app_name,
+                "namespace": doc.namespace,
+                "strategy": doc.strategy,
+                "status": J.to_external(doc.status),
+                "internalStatus": doc.status,
+                "reason": doc.reason,
+            }
+            if rec is None and doc.processing_content:
+                # recorder LRU evicted the record (fleet > max_jobs, or a
+                # restart) but the terminal Document still carries the
+                # attached summary
+                rec = _parse_provenance_blob(doc.processing_content,
+                                             source="from_document")
+        elif rec is None:
+            # terminal + gc'd: the archived Document still carries the
+            # provenance summary in processing_content
+            archive = getattr(self.store, "archive", None)
+            arec = archive.get(job_id) if archive is not None else None
+            if arec is None:
+                return 404, {"error": f"job {job_id} not found"}
+            job = {
+                "jobId": arec.get("id", job_id),
+                "appName": arec.get("app_name", ""),
+                "namespace": arec.get("namespace", ""),
+                "strategy": arec.get("strategy", ""),
+                "status": J.to_external(arec.get("status", "")),
+                "internalStatus": arec.get("status", ""),
+                "reason": arec.get("reason", ""),
+            }
+            rec = _parse_provenance_blob(arec.get("processing_content", ""))
+        return 200, {
+            "jobId": job_id,
+            "job": job,
+            "provenance": rec,
+            "provenance_enabled": (recorder.enabled
+                                   if recorder is not None else False),
+        }
+
+    def debug_flight(self, limit: int = 100):
+        """GET /debug/flight — the incident flight recorder's live ring
+        (events newest-last) + dump bookkeeping."""
+        flight = getattr(self.analyzer, "flight", None)
+        if flight is None:
+            return 200, {"events": [], "events_total": 0}
+        return 200, {
+            "events": flight.snapshot(limit),
+            "events_total": flight.events_total,
+            "dumps_total": flight.dumps_total,
+            "last_dump_path": flight.last_dump_path,
+            "dump_dir": flight.dump_dir,
+        }
 
     def dashboard(self):
         try:
@@ -666,6 +757,16 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
                     except ValueError:
                         limit = 50
                     self._send(*service.debug_traces(limit))
+                elif parsed.path == "/debug/flight":
+                    q = parse_qs(parsed.query)
+                    try:
+                        limit = int(q.get("limit", ["100"])[0])
+                    except ValueError:
+                        limit = 100
+                    self._send(*service.debug_flight(limit))
+                elif parts[:1] == ["jobs"] and len(parts) == 3 \
+                        and parts[2] == "explain":
+                    self._send(*service.explain(parts[1]))
                 elif parts == ["v1", "healthcheck", "search"]:
                     self._send(*service.search(parse_qs(parsed.query)))
                 elif parts[:3] == ["v1", "healthcheck", "id"] and len(parts) == 4:

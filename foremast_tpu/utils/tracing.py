@@ -1,24 +1,47 @@
-"""Lightweight span tracing for the engine's hot loops.
+"""Lightweight span tracing + cross-thread trace correlation.
 
 The reference implements no tracing at all (SURVEY.md §5: Jaeger is
 name-dropped in its README, nothing consumes traces). This module gives
 the runtime an always-on, zero-dependency tracer:
 
-  * `span("fetch", url=...)` context manager records wall-time spans with
+  * `span(SPAN_FETCH, url=...)` context manager records spans with
     attributes; spans nest (thread-local stack) into one trace tree per
-    top-level span.
+    top-level span. Durations are measured on `time.monotonic()` (wall
+    steps cannot produce negative or inflated spans); each span keeps an
+    epoch `start` timestamp for display only.
+  * **trace context**: `bind(cycle_id=..., job_id=...)` stamps
+    correlation ids on the current thread; `context()` snapshots the
+    thread's ids + innermost open span into a `TraceContext` handle, and
+    `attach(ctx)` adopts that handle on ANOTHER thread — spans opened
+    there parent under the originating trace instead of orphaning into
+    their own roots (the engine's fetch pool, the pipeline's watchdog
+    sacrificial threads). Ids are stamped into span attrs and — via
+    `TraceContextFilter` — into log records, so `grep cycle_id=` lines
+    up logs, traces and provenance across the whole process.
   * finished traces land in a bounded ring buffer; `snapshot()` returns
-    recent traces as plain dicts (served at /debug/traces by the service).
+    recent traces as plain dicts (served at /debug/traces by the
+    service). Each span holds at most `_MAX_CHILDREN` children (excess
+    is counted, not stored) so a pathological fan-out cannot grow a
+    trace without bound.
   * per-name aggregate stats (count, total, max) for cheap hot-loop
     dashboards, rendered as Prometheus gauges via `render_metrics()` under
     `foremast_trace_*`.
+  * `notes`: a tiny per-thread accumulator the dataplane uses to report
+    per-job fetch accounting (delta vs full, points, seconds) up to the
+    engine without threading a collector object through every layer.
   * inside jit nothing can be timed from Python — device work is traced by
     XLA itself; `span` additionally emits a `jax.profiler.TraceAnnotation`
     so host spans line up with device timelines when a profiler is
     attached.
+
+Span names are REGISTERED constants (`SPAN_NAMES` below, plus the
+`SCORE_SPANS`/`STAGE_SPANS` derived maps): the devtools trace-registry
+lint rule rejects inline f-string names, so the name set stays a stable,
+greppable inventory.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from contextlib import contextmanager
@@ -28,29 +51,92 @@ try:  # resolved once: per-span import lookups would tax every hot loop
 except Exception:  # pragma: no cover - jax always present in this build
     _TraceAnnotation = None
 
-__all__ = ["Tracer", "tracer", "span"]
+__all__ = [
+    "Tracer", "TraceContext", "TraceContextFilter", "tracer", "span",
+    "install_log_filter", "SPAN_NAMES", "SCORE_SPANS", "STAGE_SPANS",
+]
+
+
+# ---------------------------------------------------------------------------
+# span-name registry (enforced by the devtools trace-registry rule): every
+# tracing.span()/add_timing() name in library code is either one of these
+# literals or a reference to one of these constants.
+# ---------------------------------------------------------------------------
+SPAN_ENGINE_CYCLE = "engine.cycle"
+SPAN_ENGINE_CLAIM = "engine.claim"
+SPAN_ENGINE_PREPROCESS = "engine.preprocess"
+SPAN_ENGINE_SCORE = "engine.score"
+SPAN_ENGINE_LSTM_TRAIN = "engine.lstm_train"
+SPAN_DATAPLANE_FETCH = "dataplane.fetch"
+
+# per-family scoring spans/timings (engine.score.<family>)
+SCORE_SPANS = {
+    "pair": "engine.score.pair",
+    "band": "engine.score.band",
+    "bivariate": "engine.score.bivariate",
+    "lstm": "engine.score.lstm",
+    "hpa": "engine.score.hpa",
+}
+
+# per-stage cycle timing accumulators (engine.stage.<stage>)
+STAGE_SPANS = {
+    "preprocess": "engine.stage.preprocess",
+    "dispatch": "engine.stage.dispatch",
+    "collect": "engine.stage.collect",
+    "fold": "engine.stage.fold",
+}
+
+SPAN_NAMES = frozenset({
+    SPAN_ENGINE_CYCLE, SPAN_ENGINE_CLAIM, SPAN_ENGINE_PREPROCESS,
+    SPAN_ENGINE_SCORE, SPAN_ENGINE_LSTM_TRAIN, SPAN_DATAPLANE_FETCH,
+    *SCORE_SPANS.values(), *STAGE_SPANS.values(),
+})
+
+# bound on stored children per span: a span past it counts drops instead
+# of growing the trace tree (always-on tracing must be allocation-bounded)
+_MAX_CHILDREN = 128
+
+
+class TraceContext:
+    """Snapshot of one thread's trace state, portable across threads."""
+
+    __slots__ = ("ids", "parent")
+
+    def __init__(self, ids: dict, parent):
+        self.ids = ids
+        self.parent = parent  # innermost open _Span, or None
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "start", "end", "children")
+    __slots__ = ("name", "attrs", "start", "end", "_m0", "_m1", "children",
+                 "dropped")
 
     def __init__(self, name: str, attrs: dict):
         self.name = name
         self.attrs = attrs
-        self.start = time.time()
+        self.start = time.time()       # epoch, display only
+        self._m0 = time.monotonic()    # duration clock (never steps)
+        self._m1 = self._m0
         self.end = 0.0
         self.children: list[_Span] = []
+        self.dropped = 0
+
+    @property
+    def duration(self) -> float:
+        return self._m1 - self._m0
 
     def to_dict(self) -> dict:
         d = {
             "name": self.name,
             "start": self.start,
-            "duration_ms": round((self.end - self.start) * 1000.0, 3),
+            "duration_ms": round(self.duration * 1000.0, 3),
         }
         if self.attrs:
             d["attrs"] = self.attrs
         if self.children:
             d["children"] = [c.to_dict() for c in self.children]
+        if self.dropped:
+            d["children_dropped"] = self.dropped
         return d
 
 
@@ -64,9 +150,76 @@ class Tracer:
         self._stats: dict[str, list] = {}  # name -> [count, total_s, max_s]
         self._local = threading.local()
 
+    # -- trace context ----------------------------------------------------
+    def current_ids(self) -> dict:
+        """This thread's correlation ids ({} when unbound)."""
+        ids = getattr(self._local, "ids", None)
+        return dict(ids) if ids else {}
+
+    @contextmanager
+    def bind(self, **ids):
+        """Stamp correlation ids (cycle_id=..., job_id=...) on THIS thread
+        for the duration of the block; nested binds layer and restore."""
+        old = getattr(self._local, "ids", None)
+        merged = dict(old) if old else {}
+        merged.update({k: v for k, v in ids.items() if v is not None})
+        self._local.ids = merged
+        try:
+            yield
+        finally:
+            self._local.ids = old
+
+    def context(self) -> TraceContext:
+        """Snapshot this thread's ids + innermost open span for `attach`
+        on a worker thread."""
+        stack = getattr(self._local, "stack", None)
+        return TraceContext(self.current_ids(),
+                            stack[-1] if stack else None)
+
+    @contextmanager
+    def attach(self, ctx: TraceContext):
+        """Adopt a `context()` handle on the current thread: spans opened
+        inside parent under the handle's span (cross-thread children of
+        the originating trace) and the ids propagate to spans and log
+        records. Thread-local state is restored on exit, so a thread that
+        never exits (an abandoned watchdog call) can at worst add late —
+        silently dropped — children to an already-finished parent; it can
+        never corrupt another thread's stack."""
+        old_stack = getattr(self._local, "stack", None)
+        old_ids = getattr(self._local, "ids", None)
+        self._local.stack = [ctx.parent] if ctx.parent is not None else []
+        self._local.ids = dict(ctx.ids) if ctx.ids else None
+        try:
+            yield
+        finally:
+            self._local.stack = old_stack
+            self._local.ids = old_ids
+
+    # -- notes: per-thread accounting for the current unit of work --------
+    def begin_notes(self):
+        """Open a fresh per-thread note accumulator (the engine brackets
+        each job's preprocess with begin/take)."""
+        self._local.notes = {}
+
+    def add_note(self, key: str, inc: float = 1.0):
+        """Fold a count into the current thread's open note accumulator;
+        a no-op when none is open (zero overhead outside the engine)."""
+        n = getattr(self._local, "notes", None)
+        if n is not None:
+            n[key] = n.get(key, 0) + inc
+
+    def take_notes(self) -> dict:
+        """Close and return the current accumulator ({} when none)."""
+        n = getattr(self._local, "notes", None)
+        self._local.notes = None
+        return n or {}
+
     # -- recording --
     @contextmanager
     def span(self, name: str, **attrs):
+        ids = getattr(self._local, "ids", None)
+        if ids:
+            attrs = {**ids, **attrs}
         s = _Span(name, attrs)
         stack = getattr(self._local, "stack", None)
         if stack is None:
@@ -87,13 +240,26 @@ class Tracer:
                 if ann is not None:
                     ann.__exit__(None, None, None)
         finally:
-            s.end = time.time()
+            s._m1 = time.monotonic()
+            s.end = s.start + s.duration
             stack.pop()
             if parent is not None:
-                parent.children.append(s)
+                # list.append is atomic under the GIL, so cross-thread
+                # children (attach) land safely; the cap check is racy
+                # only in how tightly it bounds, never in correctness.
+                # A parent with end set already finished (and, if a root,
+                # was serialized into the ring) — a late child from an
+                # abandoned attach()'d thread is dropped, not appended,
+                # so finished traces are never retroactively mutated.
+                if parent.end:
+                    parent.dropped += 1
+                elif len(parent.children) < _MAX_CHILDREN:
+                    parent.children.append(s)
+                else:
+                    parent.dropped += 1
             else:
                 self._finish_root(s)
-            dur = s.end - s.start
+            dur = s.duration
             with self._lock:
                 st = self._stats.setdefault(name, [0, 0.0, 0.0])
                 st[0] += 1
@@ -152,3 +318,33 @@ class Tracer:
 
 tracer = Tracer()  # process-wide default
 span = tracer.span
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamp the current thread's trace ids onto every log record as
+    `record.trace_ctx` (e.g. " cycle_id=w0-c12 job_id=abc"), so a format
+    string ending in %(trace_ctx)s makes `grep cycle_id=` correlate the
+    process log with /debug/traces and /jobs/<id>/explain. Records from
+    unbound threads get an empty string — the format never breaks."""
+
+    def __init__(self, source: Tracer | None = None):
+        super().__init__()
+        self._tracer = source or tracer
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ids = self._tracer.current_ids()
+        record.trace_ctx = (
+            "".join(f" {k}={v}" for k, v in sorted(ids.items()))
+            if ids else "")
+        return True
+
+
+def install_log_filter(source: Tracer | None = None) -> int:
+    """Attach a TraceContextFilter to every root-logger handler (call
+    after logging.basicConfig). Returns the number of handlers touched."""
+    filt = TraceContextFilter(source)
+    handlers = logging.getLogger().handlers
+    for h in handlers:
+        if not any(isinstance(f, TraceContextFilter) for f in h.filters):
+            h.addFilter(filt)
+    return len(handlers)

@@ -371,3 +371,32 @@ def test_blackout_serves_stale_verdicts_suppresses_remediation_recovers():
     m = kube.get_monitor("default", "demo")
     assert m.status.remediation_taken
     assert any(kind == "deployment" for kind, *_ in kube.patches)
+
+    # -- the soak's incident trail: the blackout left a flight-recorder
+    # record (stale serves + breaker flips + the health transitions),
+    # and driving the brain on into STALLED (worker wedges after the
+    # recovery) auto-dumps a snapshot naming the triggering transition
+    # (ISSUE 6 acceptance) --
+    import json as _json
+    import tempfile as _tempfile
+
+    events = analyzer.flight.snapshot(limit=500)
+    assert any(e["type"] == "stale-serve" for e in events)
+    assert any(e["type"] == "health-transition"
+               and e["detail"]["new"] == "degraded" for e in events)
+    with _tempfile.TemporaryDirectory() as dumps:
+        analyzer.flight.dump_dir = dumps
+        analyzer.flight.min_dump_interval_s = 0.0
+        wedged_at = {"now": analyzer.health._clock()}
+        analyzer.health._clock = lambda: wedged_at["now"]
+        wedged_at["now"] += 10_000.0  # liveness window blown: no cycle
+        code, body = service.readyz()
+        assert code == 503 and body["state"] == "stalled"
+        assert analyzer.flight.last_dump_path
+        dump = _json.load(open(analyzer.flight.last_dump_path))
+        assert dump["reason"] == "health:stalled"
+        trans = [e for e in dump["events"]
+                 if e["type"] == "health-transition"]
+        assert trans[-1]["detail"]["new"] == "stalled"
+        assert dump["provenance"]["recent"]  # the soak's verdict trail
+        assert dump["knobs"]["engine"]["max_stale_seconds"] > 0

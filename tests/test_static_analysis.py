@@ -414,6 +414,122 @@ def test_jit_hygiene_traced_if_in_ops_modules():
 
 # ----------------------------------------------- suppressions and baseline
 
+# ------------------------------------------------------- (6) trace-registry
+
+def _trace_registry_with_registries():
+    """A TraceNameRegistry primed with the real registry modules, the way
+    a whole-tree run sees them."""
+    from foremast_tpu.devtools.checks import TraceNameRegistry
+
+    checker = TraceNameRegistry()
+    for rel in ("foremast_tpu/utils/tracing.py",
+                "foremast_tpu/engine/flightrec.py",
+                "foremast_tpu/engine/provenance.py"):
+        checker.check(load_module(os.path.join(REPO_ROOT, rel), rel))
+    return checker
+
+
+def test_trace_registry_flags_fstring_span_name():
+    from foremast_tpu.devtools.checks import TraceNameRegistry
+
+    run = lint_src(TraceNameRegistry(), """
+        from foremast_tpu.utils import tracing
+
+        def f(fam):
+            with tracing.span(f"engine.score.{fam}"):
+                pass
+    """)
+    assert len(run.findings) == 1
+    assert "f-string" in run.findings[0].message
+
+
+def test_trace_registry_flags_unregistered_literal_and_dynamic_names():
+    checker = _trace_registry_with_registries()
+    mod = ModuleInfo("<fixture>", "foremast_tpu/engine/fixture.py",
+                     textwrap.dedent("""
+        from foremast_tpu.utils import tracing
+
+        def f(name, flight):
+            with tracing.span("engine.never.registered"):
+                pass
+            with tracing.span(name):
+                pass
+            flight.record_event("made-up-event")
+    """))
+    run = run_lint([checker], [mod], Baseline())
+    msgs = "\n".join(f.message for f in run.findings)
+    assert "'engine.never.registered' is not registered" in msgs
+    assert "dynamic span name" in msgs
+    assert "'made-up-event' is not registered" in msgs
+
+
+def test_trace_registry_dict_keys_are_not_registered_names():
+    """SCORE_SPANS/STAGE_SPANS keys ('pair', 'fold', ...) are lookup
+    aliases, not registered span names — a typo'd span("fold") must be
+    flagged, not silently pass because the key appears in the registry
+    module."""
+    checker = _trace_registry_with_registries()
+    assert "pair" not in checker._spans
+    assert "fold" not in checker._spans
+    mod = ModuleInfo("<fixture>", "foremast_tpu/engine/fixture.py",
+                     textwrap.dedent("""
+        from foremast_tpu.utils import tracing
+
+        def f():
+            with tracing.span("fold"):
+                pass
+    """))
+    run = run_lint([checker], [mod], Baseline())
+    assert any("'fold' is not registered" in f.message
+               for f in run.findings)
+
+
+def test_trace_registry_quiet_on_constants_and_registered_literals():
+    checker = _trace_registry_with_registries()
+    mod = ModuleInfo("<fixture>", "foremast_tpu/engine/fixture.py",
+                     textwrap.dedent("""
+        from foremast_tpu.utils import tracing
+        from foremast_tpu.engine import flightrec
+        from foremast_tpu.engine import provenance as prov
+
+        def f(fam, flight, recorder, job_id):
+            with tracing.span("engine.cycle"):
+                with tracing.span(tracing.SCORE_SPANS[fam]):
+                    pass
+            tracing.tracer.add_timing(tracing.STAGE_SPANS["fold"], 0.1)
+            flight.record_event(flightrec.EVENT_SHED, count=1)
+            recorder.record(job_id, prov.PATH_SCORED)
+    """))
+    run = run_lint([checker], [mod], Baseline())
+    assert not run.findings, [f.render() for f in run.findings]
+
+
+def test_trace_registry_operator_kube_events_exempt():
+    """The operator layer's record_event is the Kubernetes Events API —
+    a different vocabulary entirely; the rule must not claim it."""
+    from foremast_tpu.devtools.checks import TraceNameRegistry
+
+    run = lint_src(TraceNameRegistry(), """
+        def remediate(kube, ns, name):
+            kube.record_event(ns, "Deployment", name, "ForemastRollback",
+                              "rolled back")
+    """, relpath="foremast_tpu/operator/fixture.py")
+    assert not run.findings, [f.render() for f in run.findings]
+
+
+def test_trace_registry_span_constants_match_runtime_sets():
+    """The lint registries are parsed from source; pin them to the live
+    constants so the two views cannot drift."""
+    from foremast_tpu.engine import flightrec
+    from foremast_tpu.engine import provenance
+    from foremast_tpu.utils import tracing
+
+    checker = _trace_registry_with_registries()
+    assert set(tracing.SPAN_NAMES) <= checker._spans
+    assert set(flightrec.EVENT_TYPES) <= checker._events
+    assert set(provenance.PATHS) <= checker._paths
+
+
 def test_inline_and_file_wide_suppressions():
     inline = lint_src(ThreadHygiene(), """
         def f():
@@ -488,6 +604,13 @@ _SEEDED_VIOLATIONS = {
 
         def f(fns):
             return [jax.jit(g) for g in fns]
+    """,
+    "trace-registry": """
+        from foremast_tpu.utils import tracing
+
+        def f(i):
+            with tracing.span(f"engine.thing.{i}"):
+                pass
     """,
 }
 

@@ -121,6 +121,147 @@ def test_engine_cycle_emits_spans_and_service_exposes_them():
     assert 'foremast_trace_count{span="engine.cycle"}' in text
 
 
+# ----------------------------------------------------- cross-thread context
+
+def test_monotonic_durations_survive_wall_clock_steps(monkeypatch):
+    """Span durations come from time.monotonic(): a wall-clock step mid
+    span (NTP slew, the bench_cycle.py clock-domain caveat this PR
+    retired) cannot produce negative or inflated durations."""
+    from foremast_tpu.utils import tracing as tmod
+
+    tr = Tracer()
+    real_time = tmod.time.time
+    # wall clock jumps BACKWARD one hour between span start and end
+    seq = iter([real_time(), real_time() - 3600.0])
+    monkeypatch.setattr(tmod.time, "time", lambda: next(seq, real_time()))
+    with tr.span("stepped"):
+        pass
+    [trace] = tr.snapshot()
+    assert 0.0 <= trace["duration_ms"] < 1000.0
+    st = tr.stats()["stepped"]
+    assert 0.0 <= st["max_seconds"] < 1.0
+
+
+def test_worker_thread_span_parents_under_cycle_trace():
+    """attach(): a span opened on a pool thread lands as a CHILD of the
+    originating trace (PR 2's fetch-pool spans no longer orphan), and the
+    bound correlation ids propagate into its attrs."""
+    tr = Tracer()
+    done = threading.Event()
+
+    with tr.bind(cycle_id="w0-c7"):
+        with tr.span("cycle"):
+            ctx = tr.context()
+
+            def work():
+                with tr.attach(ctx):
+                    assert tr.current_ids() == {"cycle_id": "w0-c7"}
+                    with tr.span("fetch", job="j1"):
+                        pass
+                done.set()
+
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            assert done.wait(5.0)
+            t.join(5.0)
+    assert tr.current_ids() == {}  # bind restored
+    [trace] = tr.snapshot()
+    assert trace["name"] == "cycle"
+    assert trace["attrs"]["cycle_id"] == "w0-c7"
+    [child] = trace["children"]
+    assert child["name"] == "fetch"
+    assert child["attrs"]["cycle_id"] == "w0-c7"  # ids crossed the thread
+
+
+def test_abandoned_thread_never_corrupts_other_stacks():
+    """A watchdog-style abandoned thread (attached, span open, never
+    finishes before the root does) must not corrupt the main thread's
+    stack or the finished trace; its late span is dropped silently."""
+    tr = Tracer()
+    release = threading.Event()
+    started = threading.Event()
+    finished = threading.Event()
+
+    with tr.span("cycle"):
+        ctx = tr.context()
+
+        def hung():
+            with tr.attach(ctx):
+                with tr.span("hung-collect"):
+                    started.set()
+                    release.wait(10.0)
+            finished.set()
+
+        t = threading.Thread(target=hung, daemon=True)
+        t.start()
+        assert started.wait(5.0)
+        # main thread abandons the worker and finishes the root
+    [trace] = tr.snapshot()
+    assert trace["name"] == "cycle"
+    assert not trace.get("children")  # late child not yet recorded
+    # the abandoned thread eventually returns: nothing raises, the late
+    # child is DROPPED (finished parents are never retroactively mutated),
+    # and the main thread can keep tracing fresh roots
+    release.set()
+    assert finished.wait(5.0)
+    assert ctx.parent.children == []
+    assert ctx.parent.dropped == 1
+    with tr.span("next-cycle"):
+        pass
+    names = [t["name"] for t in tr.snapshot()]
+    assert names == ["cycle", "next-cycle"]
+
+
+def test_child_cap_bounds_trace_allocation():
+    from foremast_tpu.utils import tracing as tmod
+
+    tr = Tracer()
+    with tr.span("root"):
+        for i in range(tmod._MAX_CHILDREN + 10):
+            with tr.span("child"):
+                pass
+    [trace] = tr.snapshot()
+    assert len(trace["children"]) == tmod._MAX_CHILDREN
+    assert trace["children_dropped"] == 10
+
+
+def test_notes_accumulate_per_thread_unit_of_work():
+    tr = Tracer()
+    tr.add_note("ignored")  # no accumulator open: no-op
+    tr.begin_notes()
+    tr.add_note("fetches")
+    tr.add_note("fetches")
+    tr.add_note("fetch_seconds", 0.25)
+    assert tr.take_notes() == {"fetches": 2, "fetch_seconds": 0.25}
+    assert tr.take_notes() == {}  # closed
+
+
+def test_log_filter_stamps_trace_ids(caplog):
+    import logging
+
+    from foremast_tpu.utils.tracing import TraceContextFilter
+
+    tr = Tracer()
+    logger = logging.getLogger("foremast_tpu.test_tracing")
+    handler = logging.Handler()
+    records = []
+    handler.emit = records.append
+    handler.addFilter(TraceContextFilter(tr))
+    logger.addHandler(handler)
+    try:
+        with tr.bind(cycle_id="w0-c3", job_id="jobA"):
+            logger.warning("inside")
+        logger.warning("outside")
+    finally:
+        logger.removeHandler(handler)
+    inside, outside = records
+    assert inside.trace_ctx == " cycle_id=w0-c3 job_id=jobA"
+    assert outside.trace_ctx == ""
+    # the runtime's format string appends %(trace_ctx)s: grep-able
+    line = f"{inside.getMessage()}{inside.trace_ctx}"
+    assert "cycle_id=w0-c3" in line
+
+
 # ---------------------------------------------------------------- distributed
 def test_process_batch_slice_partitions_evenly():
     from foremast_tpu.parallel.distributed import HostInfo, process_batch_slice
