@@ -36,7 +36,7 @@ bench-watch:     ## background tunnel watcher: banks BENCH_LOCAL_r05.json at fir
 prewarm:         ## compile the scoring-program grid into COMPILE_CACHE_PATH (default /tmp/foremast-compile-cache)
 	$(CPU_ENV) COMPILE_CACHE_PATH=$${COMPILE_CACHE_PATH:-/tmp/foremast-compile-cache} $(PY) -m foremast_tpu prewarm
 
-perf:            ## perf regression gates (zero steady-state recompiles, delta hit ratio >= 0.9, zero no-change launches) + steady-state A/B leg
+perf:            ## perf regression gates (zero steady-state recompiles, delta hit ratio >= 0.9, zero no-change launches, triage launch cut: TRIAGE=1 <= 20% of TRIAGE=0 launches at equal verdicts) + steady-state A/B leg
 	$(CPU_ENV) $(PY) -m pytest tests/ -m perf -q
 	$(CPU_ENV) BENCH_CYCLE_STEADY=1 BENCH_CYCLE_JOBS=$${BENCH_CYCLE_JOBS:-500} BENCH_CYCLE_REPS=$${BENCH_CYCLE_REPS:-8} $(PY) -m foremast_tpu.bench_cycle
 

@@ -421,8 +421,8 @@ def _opportunistic_fallback() -> dict:
     wedged at the END of the round while it had been healthy earlier.
     When the preflight fails, any opportunistically-captured artifact in
     the repo root is folded in WITH PROVENANCE (capture_mode/captured_at
-    ride along, device_error stays) — the headline then reports the real
-    measurement from this round instead of an environmental zero, and the
+    ride along, device_skipped stays) — the headline then reports the
+    real measurement from this round instead of being skipped, and the
     labeling keeps it honest: these numbers are from `captured_at`, not
     from this run."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -572,7 +572,13 @@ def main() -> None:
                 timeout_s=timeout_s, env=child_env,
             )
         if device is None:
-            device = {"value": 0.0, "vs_baseline": 0.0, "device_error": err}
+            # honesty convention (docs/benchmarks.md): an unavailable
+            # device leg SKIPS the headline fields rather than recording
+            # value 0.0 — r04/r05's environmental zeros read as 8900x
+            # regressions in round-over-round diffs. `device_skipped`
+            # carries the reason; a banked opportunistic artifact may
+            # still fold real numbers in (with provenance) underneath it.
+            device = {"device_skipped": err}
             device.update(_opportunistic_fallback())
         elif os.environ.get("BENCH_SKIP_LONG", "0").strip().lower() in (
                 "1", "true", "yes", "on"):
@@ -593,9 +599,8 @@ def main() -> None:
                 device["long_window_error"] = long_err
     else:
         device = {
-            "value": 0.0, "vs_baseline": 0.0,
-            "device_error": f"preflight: tunnel unhealthy after "
-                            f"{preflight_window_s:.0f}s window | {probe_err}",
+            "device_skipped": f"preflight: tunnel unhealthy after "
+                              f"{preflight_window_s:.0f}s window | {probe_err}",
         }
         device.update(_opportunistic_fallback())
     # calibrate the mesh leg's reduction-share estimate with THIS run's
@@ -603,7 +608,7 @@ def main() -> None:
     # instead of bench_mesh.py's hardcoded prior
     p50 = device.get("p50_s_at_100k")
     rtt = device.get("readback_rtt_floor_s", 0.0)
-    if p50 and not cpu_run and "device_error" not in device:
+    if p50 and not cpu_run and "device_skipped" not in device:
         # self-calibration ONLY from this run's own device leg: numbers
         # folded in by the opportunistic fallback carry provenance the
         # mesh record would not inherit (bench_mesh falls back to its

@@ -459,7 +459,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="compile the scoring-program grid (into COMPILE_CACHE_PATH "
              "when set) so runtimes start without the compile storm",
     )
-    pw.add_argument("--families", default="pair,band,bivariate,hpa",
+    pw.add_argument("--families",
+                    default="pair,band,bivariate,hpa,triage",
                     help="comma-separated model families to warm")
     pw.add_argument("--rungs", default="16,64,256,1024",
                     help="comma-separated batch rungs (clamped to the "

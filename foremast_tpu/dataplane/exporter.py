@@ -146,6 +146,27 @@ class VerdictExporter:
                 {"family": family}, round(float(secs), 6),
                 help="Per-model-family scoring seconds (last cycle).")
 
+    def record_triage(self, family: str, screened: int, cleared: int,
+                      escalated: int):
+        """Per-cycle tier-0 triage increments for one family (engine
+        calls this after each cycle; zero increments are skipped so the
+        counter families only materialize once triage actually runs)."""
+        if screened:
+            self.record_counter(
+                "foremastbrain:triage_screened_total", {"family": family},
+                screened,
+                help="rows screened by the tier-0 triage kernel")
+        if cleared:
+            self.record_counter(
+                "foremastbrain:triage_cleared_total", {"family": family},
+                cleared,
+                help="screened rows cleared straight to a healthy verdict")
+        if escalated:
+            self.record_counter(
+                "foremastbrain:triage_escalated_total", {"family": family},
+                escalated,
+                help="screened rows escalated to the full family scorers")
+
     def record_hpa_score(self, app: str, namespace: str, score: float):
         self._set(
             "foremastbrain:namespace_app_per_pod:hpa_score",

@@ -307,9 +307,17 @@ class Analyzer:
         self.lstm_train_memo_hits = 0
         self.lstm_rescore_skips = 0
         # total device-program launches (chunk launches across every
-        # family, lstm scoring, training) — the steady-state no-change
-        # gate asserts this stays flat over a memo-hit cycle
+        # family, lstm scoring, training, and the tier-0 triage screen) —
+        # the steady-state no-change gate asserts this stays flat over a
+        # memo-hit cycle
         self.device_launches = 0
+        # -- tier-0 triage (TRIAGE; engine/triage.py) cumulative counters:
+        # rows screened / cleared / escalated per family, and fused
+        # screen launches. Per-cycle deltas land in last_cycle_stages.
+        self.triage_screened_total: dict[str, int] = {}
+        self.triage_cleared_total: dict[str, int] = {}
+        self.triage_escalated_total: dict[str, int] = {}
+        self.triage_launches_total = 0
         # -- observability: provenance + flight recorder + trace ids --
         # per-(job, cycle) verdict attribution (engine/provenance.py):
         # which verdict path fired, per-family scores vs thresholds,
@@ -657,15 +665,22 @@ class Analyzer:
     # measured 6.8 s -> ~3.5 s per mixed cycle on CPU).
     _BATCH_BUCKETS = (16, 64, 256, 512, 1024, 4096, 16384, 65536)
 
-    def _bucket_rows(self, n: int) -> int:
-        """Smallest batch rung >= n, capped at the configured chunk."""
-        C = max(16, self.config.score_batch)
-        for b in self._BATCH_BUCKETS:
-            if b >= C:
+    @classmethod
+    def _rung_for(cls, n: int, cap: int) -> int:
+        """Smallest batch rung >= n from the ladder, capped at `cap`.
+        The ONE ladder walk — the family chunker and the triage screen
+        (engine/triage.py, whose prewarm rung set in pipeline.prewarm is
+        derived from the same ladder) both route through it."""
+        for b in cls._BATCH_BUCKETS:
+            if b >= cap:
                 break
             if n <= b:
                 return b
-        return C
+        return cap
+
+    def _bucket_rows(self, n: int) -> int:
+        """Smallest batch rung >= n, capped at the configured chunk."""
+        return self._rung_for(n, max(16, self.config.score_batch))
 
     def _launch_chunks(self, fn, arrays: list) -> list:
         """Row-chunk packed (B, ...) arrays into FIXED batch buckets and
@@ -1976,7 +1991,8 @@ class Analyzer:
                         # streamed dispatch: full bucket rungs launch here,
                         # overlapping the remaining fetches (the pipeline
                         # accounts its own dispatch time)
-                        pipe.feed(pairs, bands, bis, multis, hpas)
+                        pipe.feed(pairs, bands, bis, multis, hpas,
+                                  strategy=states[doc_id].doc.strategy)
                 t_wait = time.perf_counter()
         shed_ids: list = []
         for doc_id, st in states.items():
@@ -2090,15 +2106,32 @@ class Analyzer:
         fam_entries: dict[str, list] = {}
         judged_items: dict[str, int] = {}
         memo_job_hits = pipe.memo_job_hits if pipe is not None else {}
+        triage_gate = pipe.triage if pipe is not None else None
+        triage_job_hits = triage_gate.job_hits if triage_gate is not None \
+            else {}
+        # per-result screen statistics for cleared rows, keyed by the
+        # family result key — folded into the provenance family entries so
+        # `explain` shows the screen's numbers vs its thresholds
+        triage_stats = triage_gate.stats if triage_gate is not None else {}
 
         def _vpath(job_id: str) -> tuple:
             """(path, detail) for a judged job: memo-hit when EVERY result
-            came from the fingerprint memo, scored otherwise."""
+            came from the fingerprint memo, triaged when the tier-0
+            screen cleared the rest, scored otherwise."""
             n = judged_items.get(job_id, 0)
             m = memo_job_hits.get(job_id, 0) + (
                 1 if job_id in self._lstm_memo_jobs else 0)
+            t = triage_job_hits.get(job_id, 0)
             if n and m >= n:
                 return prov.PATH_MEMO_HIT, f"{m}/{n} results from memo"
+            if n and t and m + t >= n:
+                detail = f"{t}/{n} screened clear"
+                if m:
+                    detail += f", {m} memo"
+                return prov.PATH_TRIAGED, detail
+            if t:
+                return (prov.PATH_SCORED,
+                        f"{n - m - t}/{n} fresh, {m} memo, {t} triaged")
             if m:
                 return prov.PATH_SCORED, f"{n - m}/{n} fresh, {m} memo"
             return prov.PATH_SCORED, ""
@@ -2112,11 +2145,14 @@ class Analyzer:
             st.judged_any = True
             if prov_on:
                 judged_items[it.job_id] = judged_items.get(it.job_id, 0) + 1
-                fam_entries.setdefault(it.job_id, []).append({
+                entry = {
                     "family": "pair", "metric": it.metric,
                     "min_p": round(r["min_p"], 8),
                     "alpha": self.config.pairwise_threshold,
-                    "unhealthy": bool(r["unhealthy"])})
+                    "unhealthy": bool(r["unhealthy"])}
+                entry.update(triage_stats.get(
+                    (it.job_id, it.metric, "pair"), {}))
+                fam_entries.setdefault(it.job_id, []).append(entry)
             if r["unhealthy"]:
                 causes = []
                 if r["pairwise_unhealthy"]:
@@ -2134,11 +2170,14 @@ class Analyzer:
             st.judged_any = True
             if prov_on:
                 judged_items[it.job_id] = judged_items.get(it.job_id, 0) + 1
-                fam_entries.setdefault(it.job_id, []).append({
+                entry = {
                     "family": "band", "metric": it.metric,
                     "anomalous_points": int(r["count"]),
                     "band": [round(r["lower"], 4), round(r["upper"], 4)],
-                    "unhealthy": bool(r["unhealthy"])})
+                    "unhealthy": bool(r["unhealthy"])}
+                entry.update(triage_stats.get(
+                    (it.job_id, it.metric, "band"), {}))
+                fam_entries.setdefault(it.job_id, []).append(entry)
             self.exporter.record_bounds(
                 st.doc.app_name, st.doc.namespace, it.metric,
                 r["upper"], r["lower"], float(r["unhealthy"]),
@@ -2160,10 +2199,13 @@ class Analyzer:
             st.judged_any = True
             if prov_on:
                 judged_items[it.job_id] = judged_items.get(it.job_id, 0) + 1
-                fam_entries.setdefault(it.job_id, []).append({
+                entry = {
                     "family": "bivariate", "metric": "&".join(it.metrics),
                     "anomalous_points": int(r["count"]),
-                    "unhealthy": bool(r["unhealthy"])})
+                    "unhealthy": bool(r["unhealthy"])}
+                entry.update(triage_stats.get(
+                    (it.job_id, "&".join(it.metrics), "bivariate"), {}))
+                fam_entries.setdefault(it.job_id, []).append(entry)
             for metric, (upper, lower) in r["bounds"].items():
                 self.exporter.record_bounds(
                     st.doc.app_name, st.doc.namespace, metric,
@@ -2329,6 +2371,49 @@ class Analyzer:
         for name, secs in stages.items():
             tracing.tracer.add_timing(tracing.STAGE_SPANS[name], secs)
         self.exporter.record_cycle_stages(stages, fam_seconds)
+        triage_cycle = None
+        if triage_gate is not None and triage_gate.active:
+            tg = triage_gate
+            tracing.tracer.add_timing(tracing.SPAN_ENGINE_TRIAGE, tg.seconds)
+            screened = sum(tg.screened.values())
+            cleared = sum(tg.cleared.values())
+            escalated = sum(tg.escalated.values())
+            for fam in sorted(set(tg.screened) | set(tg.cleared)
+                              | set(tg.escalated)):
+                self.triage_screened_total[fam] = (
+                    self.triage_screened_total.get(fam, 0)
+                    + tg.screened.get(fam, 0))
+                self.triage_cleared_total[fam] = (
+                    self.triage_cleared_total.get(fam, 0)
+                    + tg.cleared.get(fam, 0))
+                self.triage_escalated_total[fam] = (
+                    self.triage_escalated_total.get(fam, 0)
+                    + tg.escalated.get(fam, 0))
+                self.exporter.record_triage(
+                    fam, tg.screened.get(fam, 0), tg.cleared.get(fam, 0),
+                    tg.escalated.get(fam, 0))
+            self.triage_launches_total += tg.launches
+            # recorded even when this cycle screened 0 rows (everything
+            # memo-hit): the "(last cycle)" gauge must not go stale at the
+            # previous cycle's ratio while triage_seconds keeps updating
+            self.exporter.record_gauge(
+                "foremastbrain:triage_escalation_ratio", {},
+                round(escalated / screened, 6) if screened else 0.0,
+                help="Fraction of screened rows escalated to the "
+                     "full scorers (last cycle).")
+            self.exporter.record_gauge(
+                "foremastbrain:triage_seconds", {},
+                round(tg.seconds, 6),
+                help="Tier-0 triage screen stage seconds (last cycle).")
+            triage_cycle = {
+                "screened": screened,
+                "cleared": cleared,
+                "escalated": escalated,
+                "escalation_ratio": (round(escalated / screened, 6)
+                                     if screened else 0.0),
+                "launches": tg.launches,
+                "seconds": round(tg.seconds, 6),
+            }
         self.provenance.finish_cycle(
             stage_seconds=stages,
             device_launches=self.device_launches - launches0,
@@ -2345,6 +2430,10 @@ class Analyzer:
             "device_launches": self.device_launches - launches0,
             "score_memo_hits": dict(pipe.memo_hits) if pipe is not None
             else {},
+            # tier-0 triage: this cycle's screened/cleared/escalated rows,
+            # escalation ratio, fused screen launches, and stage seconds
+            # (None when the gate is off or inactive)
+            "triage": triage_cycle,
             "lstm_rescore_skips": self.lstm_rescore_skips - rescore_skips0,
             # degraded-mode signals (cumulative totals live on /metrics;
             # these are this cycle's contribution + the live park count)

@@ -103,6 +103,52 @@ class EngineConfig:
     # (scorers are deterministic row-wise functions of the fingerprinted
     # inputs — pinned by tests/test_delta.py's identity test).
     score_memo: bool = True
+    # tier-0 triage screen (TRIAGE; engine/triage.py + ops/triage.py):
+    # before the family scorers launch, changed rows of steady-state
+    # (continuous/hpa-class) jobs ride one fused robust-z + smoother-
+    # residual screen; rows the screen clears short-circuit to the
+    # healthy verdict the full path would produce, suspects escalate to
+    # the full scorers unchanged. Verdict-safe by construction (see
+    # engine/triage.py: shrunk-band dominance for the moving-average
+    # band family; canary-class jobs, the hpa family, and
+    # non-moving-average band algorithms always escalate) and by test
+    # (the escalation-threshold sweep in tests/test_triage.py). Effective
+    # with SCORE_PIPELINE=1 (the gate lives in the pipeline); 0 restores
+    # the screen-free path exactly.
+    triage: bool = True
+    # robust z-band escalation guard (TRIAGE_Z): rows whose max
+    # |x - median(hist)| / robust-scale over the current region exceeds
+    # this always escalate, whatever the residual band says. Escalation-
+    # only defense in depth — lowering it cannot change verdicts, only
+    # shrink the launch savings (0 = screen nothing).
+    triage_z: float = 8.0
+    # one-sided CLEAR margin in sigmas (TRIAGE_MARGIN): a row clears only
+    # while its violation count of the policy band SHRUNK by this much
+    # stays under the family's verdict gate. The shrunk band is strictly
+    # narrower, so its count dominates the real one (sub-gate shrunk
+    # count => sub-gate real count => healthy), and any point the full
+    # scorer could count differently sits within float ulps of the real
+    # boundary — i.e. a macroscopic margin*sigma outside the shrunk band,
+    # so drift flips cannot change the CLEAR decision. 0 removes the
+    # drift guard (NOT recommended); >= the policy threshold disables
+    # clearing.
+    triage_margin: float = 0.25
+    # minimum valid history points for a row to be screenable
+    # (TRIAGE_MIN_POINTS); thinner rows always take the full path
+    triage_min_points: int = 24
+    # screen batch coarseness (TRIAGE_FIRE_ROWS): rows per fused screen
+    # launch at T<=1024 (scaled down ~1/T past that for bounded launch
+    # memory). An order of magnitude coarser than PIPELINE_FIRE_ROWS on
+    # purpose: the screen is one cheap pass, so fewer, bigger launches
+    # are the point.
+    triage_fire_rows: int = 16384
+    # families the screen may clear (TRIAGE_FAMILIES, comma list). The
+    # default is the provably one-sided set: band (under moving_average*
+    # algorithms only). pair/bivariate opt-in is NOT verdict-safe: the
+    # screen cannot bound rank-test p-values or ellipse correlation, so
+    # a sustained sub-band distribution shift the full scorer would
+    # convict can clear (docs/performance.md §5); hpa is never screened.
+    triage_families: tuple = ("band",)
     # persistent XLA compilation cache directory (COMPILE_CACHE_PATH;
     # empty = disabled). A restarted process reuses compiled programs
     # instead of re-paying the first-cycle compile storm (~26 s per mixed
@@ -357,6 +403,15 @@ def from_env(env=None) -> EngineConfig:
         delta_fetch=_env_bool(env, "DELTA_FETCH", True),
         window_cache_max=_env_int(env, "WINDOW_CACHE_MAX", 8192),
         score_memo=_env_bool(env, "SCORE_MEMO", True),
+        triage=_env_bool(env, "TRIAGE", True),
+        triage_z=_env_float(env, "TRIAGE_Z", 8.0),
+        triage_margin=_env_float(env, "TRIAGE_MARGIN", 0.25),
+        triage_min_points=_env_int(env, "TRIAGE_MIN_POINTS", 24),
+        triage_fire_rows=_env_int(env, "TRIAGE_FIRE_ROWS", 16384),
+        triage_families=tuple(
+            f.strip() for f in env.get("TRIAGE_FAMILIES", "band").split(",")
+            if f.strip()
+        ),
         compile_cache_path=env.get("COMPILE_CACHE_PATH", ""),
         prewarm_on_start=_env_bool(env, "PREWARM_ON_START", False),
         ma_window=_env_int(env, "MA_WINDOW", 30),

@@ -79,6 +79,18 @@ class CyclePipeline:
         self.memo = analyzer._score_memo if analyzer.config.score_memo \
             else None
         self.memo_results: dict = {f: {} for f in self.FAMILIES}
+        # tier-0 triage gate (TRIAGE; engine/triage.py): composes after
+        # the memo check — memo skips unchanged rows, triage screens the
+        # changed-but-unremarkable ones in one fused kernel and
+        # short-circuits CLEAR rows to synthesized healthy results;
+        # SUSPECT rows fall through to the family accumulators unchanged.
+        self.triage = None
+        if analyzer.config.triage:
+            from .triage import TriageGate
+
+            gate = TriageGate(analyzer)
+            if gate.active:
+                self.triage = gate
         self.memo_hits: dict = {}  # family -> hits this cycle
         # provenance: which JOBS had items served from the memo this cycle
         # (job_id -> hit count) — lets /jobs/<id>/explain attribute a
@@ -107,36 +119,51 @@ class CyclePipeline:
         return False
 
     # ------------------------------------------------------------- feeding
-    def feed(self, pairs, bands, bis, multis, hpas):
+    def feed(self, pairs, bands, bis, multis, hpas, strategy: str = ""):
         """Route one job's preprocessed items (claim order) into the
         accumulators; launch any bucket that filled its rung.
 
-        Routing (bucket keys, joint-grid prep, hpa row building) is
-        guarded per item like every scoring step: a malformed item lands
-        in the per-job retry list instead of aborting the whole cycle —
-        the `_isolate` blast-radius contract starts here, not at launch.
+        `strategy` is the owning job's strategy: the triage gate screens
+        only steady-state (continuous/hpa-class) jobs — canary-class
+        verdicts gate live rollouts and always take the full path.
+
+        Routing (bucket keys, joint-grid prep, hpa row building, triage
+        screening) is guarded per item like every scoring step: a
+        malformed item lands in the per-job retry list instead of
+        aborting the whole cycle — the `_isolate` blast-radius contract
+        starts here, not at launch.
         """
         an = self.an
+        tg = self.triage
         self.multis += multis
         for it in pairs:
             try:
                 T = an._pair_T(it)
                 if not self._memo_check("pair", it, T):
-                    self._add("pair", T, it)
+                    if tg is not None and tg.accepts("pair", strategy):
+                        tg.add("pair", T, it, self)
+                    else:
+                        self._add("pair", T, it)
             except Exception:  # noqa: BLE001 - retried per job at collect
                 self.failed.append(("pair", [it]))
         for it in bands:
             try:
                 T = an._band_T(it)
                 if not self._memo_check("band", it, T):
-                    self._add("band", T, it)
+                    if tg is not None and tg.accepts("band", strategy):
+                        tg.add("band", T, it, self)
+                    else:
+                        self._add("band", T, it)
             except Exception:  # noqa: BLE001
                 self.failed.append(("band", [it]))
         for it in bis:
             try:
                 pre, T = an._bi_prep(it)
                 if not self._memo_check("bivariate", (it, pre), T):
-                    self._add("bivariate", T, (it, pre))
+                    if tg is not None and tg.accepts("bivariate", strategy):
+                        tg.add("bivariate", T, (it, pre), self)
+                    else:
+                        self._add("bivariate", T, (it, pre))
             except Exception:  # noqa: BLE001
                 self.failed.append(("bivariate", [it]))
         if hpas:
@@ -202,6 +229,11 @@ class CyclePipeline:
         per job, and score the lstm family. Returns
         (pair_res, band_res, bi_res, multi_res, hpa_res, scoring_failed)."""
         an = self.an
+        if self.triage is not None:
+            # screen the remaining partial triage buckets FIRST: suspects
+            # route into the family accumulators below and flush with
+            # everyone else; cleared rows land in triage.results
+            self.triage.flush(self)
         for family in self.FAMILIES:
             buckets, self.acc[family] = self.acc[family], {}
             for T, bucket in buckets.items():
@@ -263,6 +295,13 @@ class CyclePipeline:
                         an._watchdog_call(sync[family], group))
                 except Exception as e:  # noqa: BLE001
                     bad[job_id] = f"{type(e).__name__}: {e}"
+        if self.triage is not None:
+            # fold triage-cleared rows in BEFORE memoization: a cleared
+            # row's synthesized result is the healthy result the scorer
+            # would have produced, so memoizing it keeps the steady chain
+            # (unchanged next cycle -> memo hit, no re-screen)
+            for family, cleared in self.triage.results.items():
+                results[family].update(cleared)
         if self.memo is not None:
             # memoize every freshly scored verdict (collect + retries) for
             # the next cycle, then fold the memo-served ones back in
@@ -380,7 +419,8 @@ STANDARD_RUNGS = (16, 64, 256, 1024)
 STANDARD_T_BUCKETS = (128, 256)
 
 
-def prewarm(config=None, families=("pair", "band", "bivariate", "hpa"),
+def prewarm(config=None,
+            families=("pair", "band", "bivariate", "hpa", "triage"),
             rungs=STANDARD_RUNGS, t_buckets=STANDARD_T_BUCKETS) -> dict:
     """Compile the (family x rung x T-bucket) scoring grid up front.
 
@@ -395,10 +435,12 @@ def prewarm(config=None, families=("pair", "band", "bivariate", "hpa"),
     import numpy as np
 
     from ..ops import hpa as hpa_ops
+    from ..ops import triage as triage_ops
     from ..ops.windowing import Window, bucket_length
     from ..parallel import fleet as fl
     from .analyzer import Analyzer, _BandItem, _BiItem, _HpaItem
     from .config import EngineConfig, from_env
+    from .triage import screen_cap
 
     cfg = config if config is not None else from_env()
     if not isinstance(cfg, EngineConfig):
@@ -424,6 +466,22 @@ def prewarm(config=None, families=("pair", "band", "bivariate", "hpa"),
         for T in t_buckets:
             n_c = max(T // 4, 8)
             n_h = T - n_c
+            if "triage" in families:
+                # the fused tier-0 screen launches at exactly the rungs
+                # TriageGate._rung can return: every _BATCH_BUCKETS entry
+                # below the memory-aware cap, plus the cap itself (the
+                # steady-state rung a big fleet's screen actually fires) —
+                # deriving from the family rung list missed 512/4096 and
+                # left mid-size buckets compiling at cycle time
+                cap = screen_cap(cfg.triage_fire_rows, T)
+                t_rungs = sorted(
+                    {b for b in Analyzer._BATCH_BUCKETS if b < cap}
+                    | {cap})
+                for r in t_rungs:
+                    np.asarray(triage_ops.screen_rows(
+                        *triage_ops.triage_arg_spec(r, T),
+                        cfg.ma_window)["count"])
+                    programs += 1
             for r in rungs:
                 if "pair" in families:
                     # the fused pairwise program straight at the kernel:

@@ -596,6 +596,23 @@ class ForemastService:
             # steady-state incremental fetch health: hit ratio, bytes not
             # re-downloaded, and why any full refetches happened
             out["delta_fetch"] = self.delta_source.snapshot()
+        screened = getattr(self.analyzer, "triage_screened_total", None)
+        if screened:
+            # tier-0 triage health (cumulative; the last cycle's numbers
+            # ride out["cycle"]["triage"]): how much of the changed-row
+            # stream the screen cleared without a family launch
+            cleared = dict(self.analyzer.triage_cleared_total)
+            escalated = dict(self.analyzer.triage_escalated_total)
+            total = sum(screened.values())
+            out["triage"] = {
+                "screened": dict(screened),
+                "cleared": cleared,
+                "escalated": escalated,
+                "escalation_ratio": (
+                    round(sum(escalated.values()) / total, 6)
+                    if total else 0.0),
+                "screen_launches": self.analyzer.triage_launches_total,
+            }
         if self.cache_source is not None:
             out["window_cache"] = {
                 "hits": self.cache_source.hits,

@@ -67,6 +67,7 @@ SPAN_ENGINE_CLAIM = "engine.claim"
 SPAN_ENGINE_PREPROCESS = "engine.preprocess"
 SPAN_ENGINE_SCORE = "engine.score"
 SPAN_ENGINE_LSTM_TRAIN = "engine.lstm_train"
+SPAN_ENGINE_TRIAGE = "engine.triage"
 SPAN_DATAPLANE_FETCH = "dataplane.fetch"
 
 # per-family scoring spans/timings (engine.score.<family>)
@@ -88,7 +89,8 @@ STAGE_SPANS = {
 
 SPAN_NAMES = frozenset({
     SPAN_ENGINE_CYCLE, SPAN_ENGINE_CLAIM, SPAN_ENGINE_PREPROCESS,
-    SPAN_ENGINE_SCORE, SPAN_ENGINE_LSTM_TRAIN, SPAN_DATAPLANE_FETCH,
+    SPAN_ENGINE_SCORE, SPAN_ENGINE_LSTM_TRAIN, SPAN_ENGINE_TRIAGE,
+    SPAN_DATAPLANE_FETCH,
     *SCORE_SPANS.values(), *STAGE_SPANS.values(),
 })
 
