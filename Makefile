@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos soak clean
+.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos soak soak-sharded clean
 
 test: lint       ## full suite (CPU, 8 virtual devices via conftest), gated on lint
 	$(PY) -m pytest tests/ -q
@@ -48,6 +48,9 @@ chaos:           ## seeded chaos soak: engine cycles under the fault plan
 
 soak:            ## live-runtime chaos soak (<120s): spike+hang faults against a running process; health DEGRADED->OK end to end
 	$(CPU_ENV) $(PY) -m pytest tests/test_soak_live.py -m chaos -q
+
+soak-sharded:    ## multi-replica kill -9 chaos soak (<120s): 3 replicas over one archive, one hard-killed mid-cycle; zero lost / zero double-scored jobs, verdicts == single-replica baseline
+	$(CPU_ENV) $(PY) -m pytest tests/test_shard_soak.py -q
 
 demo:            ## hermetic rollback demo (no cluster)
 	$(CPU_ENV) $(PY) -m foremast_tpu demo

@@ -1,4 +1,4 @@
-"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | health | explain | prewarm | demo.
+"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | health | shards | explain | prewarm | demo.
 
 One entrypoint covers the reference's process zoo and kubectl plugins:
 
@@ -237,6 +237,42 @@ def cmd_health(args) -> int:
     return 0 if status == 200 else 1
 
 
+def cmd_shards(args) -> int:
+    """Print the runtime's shard-ring view (/status `shards` section):
+    replica identity, live membership, owned/adopting/draining counts,
+    and rebalance/handoff history — the "which slice of the fleet is this
+    replica responsible for" question, scriptable."""
+    import urllib.request
+
+    endpoint = (args.endpoint or knobs.read("ANALYST_ENDPOINT")
+                or "http://localhost:8099")
+    base = endpoint.split("/v1/")[0].rstrip("/")
+    try:
+        with urllib.request.urlopen(f"{base}/status", timeout=10) as r:
+            payload = json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001 - CLI boundary: diagnose, don't trace
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    snap = payload.get("shards")
+    if snap is None:
+        print("sharding is not active on this runtime (no archive or "
+              "SHARDING=0)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(snap, indent=2))
+        return 0
+    print(f"replica {snap.get('replica')} (worker {snap.get('worker')}), "
+          f"membership {snap.get('membership')}"
+          + ("" if snap.get("membership_fresh", True) else " [STALE VIEW]"))
+    print(f"  replicas: {', '.join(snap.get('replicas', [])) or '-'}")
+    print(f"  shards: {snap.get('owned')}/{snap.get('shard_count')} owned, "
+          f"{snap.get('adopting')} adopting, {snap.get('draining')} draining")
+    print(f"  rebalances: {snap.get('rebalances_total')}, "
+          f"handoffs: {snap.get('handoffs_total')}, "
+          f"adoptions: {snap.get('adoptions_total')}")
+    return 0
+
+
 def _render_explain(payload: dict) -> str:
     """Human-readable decision chain for one job's latest provenance
     record (the docs/operations.md "debugging a verdict" runbook walks
@@ -433,6 +469,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="runtime base URL (env ANALYST_ENDPOINT; "
                          "default http://localhost:8099)")
     hp.set_defaults(func=cmd_health)
+    sh = sub.add_parser(
+        "shards",
+        help="print the runtime's shard-ring view (replica membership, "
+             "owned/adopting/draining shards, rebalance history)",
+    )
+    sh.add_argument("--endpoint", default="",
+                    help="runtime base URL (env ANALYST_ENDPOINT; "
+                         "default http://localhost:8099)")
+    sh.add_argument("--json", action="store_true",
+                    help="print the raw /status shards section")
+    sh.set_defaults(func=cmd_shards)
     ex = sub.add_parser(
         "explain",
         help="render a job's verdict provenance (which path produced the "

@@ -367,6 +367,11 @@ class Analyzer:
         self.watchdog_fires_total = 0
         self._wd_lock = make_lock("engine.analyzer.watchdog")
         self._watchdog_abandoned = 0
+        # sharded multi-replica brain (engine/sharding.py): the runtime
+        # wires a ShardManager in; its ownership predicate then gates the
+        # per-cycle claim so N replicas partition the fleet instead of
+        # racing for it. None = single-replica (own everything), unchanged.
+        self.shard = None
 
     def _memo_put(self, table: OrderedDict, key, val):
         """Insert-and-bound for the memo tables (LRU, shared ceiling)."""
@@ -1925,6 +1930,7 @@ class Analyzer:
                 worker,
                 limit=self.config.max_claim_per_cycle,
                 max_stuck_seconds=self.config.max_stuck_seconds,
+                owns_fn=self.shard.owns if self.shard is not None else None,
             )
         outcomes: dict[str, str] = {}
         if self._quarantine:

@@ -34,7 +34,21 @@ except ImportError:  # Windows: no flock; single-process archives only
 
 from ..utils.locks import make_lock
 
-__all__ = ["FileArchive", "EsArchive"]
+__all__ = ["FileArchive", "EsArchive", "MEMBER_STATE_PREFIX"]
+
+# Shard-membership heartbeat state keys (engine/sharding.py writes them,
+# re-exporting this prefix as MEMBER_KEY_PREFIX). The canonical constant
+# lives HERE because compaction must age the blobs out: the default
+# replica id is hostname-pid — a fresh key every pod restart — and
+# keeping the latest record per state key forever would grow the
+# compacted state section (and every membership read that scans it)
+# without bound across deployment history.
+MEMBER_STATE_PREFIX = "shard-member:"
+# a member silent this long is ages past any plausible MEMBER_TTL_S
+# (default 15 s; docs/configuration.md): safe to drop. FileArchive drops
+# at compaction; EsArchive via delete_state, driven by the membership
+# reader (engine/sharding.py prunes what its read filters out anyway)
+KEEP_MEMBER_SECONDS = 3600.0
 
 # jobs.py's TERMINAL_STATUSES, duplicated here because jobs.py imports
 # from this module (tests pin the two sets against drift)
@@ -99,6 +113,10 @@ class FileArchive:
         # locked scan (sustained-rotation churn); exposed for observability
         self.locked_scan_fallbacks = 0
         self.compactions = 0
+        # list_state memo: (mutation sig, {key: (value, updated_at)}).
+        # The shard membership layer reads state every heartbeat; between
+        # archive mutations that must not cost a full two-generation scan
+        self._state_view: tuple | None = None
         # times the sidecar .lock could not be opened/flocked while fcntl
         # IS available: mutations proceeded under the in-process lock only,
         # and compaction was suppressed (truncating without the
@@ -148,34 +166,44 @@ class FileArchive:
         return _Lock()
 
     # -- writing --
+    def _maybe_compact_locked(self, line_len: int,
+                              cross_locked: bool) -> None:
+        """Size-triggered compaction check (caller holds the flock)."""
+        try:
+            if (os.path.exists(self.path)
+                    and os.path.getsize(self.path) + line_len > self.max_bytes):
+                if cross_locked:
+                    self._compact_locked()
+                else:
+                    # degraded: an unlocked compaction could truncate
+                    # away a concurrent peer append in a shared-archive
+                    # (RWX PVC) deployment — the append itself is safe
+                    # (O_APPEND, interleave-atomic), compaction is not.
+                    # The file grows past max_bytes until the lock
+                    # heals; counted so operators see it.
+                    self.compactions_skipped_unlocked += 1
+        except OSError:
+            pass
+
+    def _raw_append_locked(self, line: bytes) -> bool:
+        """One interleave-atomic write(2) (caller holds the flock).
+        Shared by _append and claim_job so the write path cannot drift."""
+        try:
+            fd = os.open(self.path,
+                         os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+        except OSError:
+            return False  # disk full/unwritable: caller keeps RAM copy
+        return True
+
     def _append(self, rec: dict) -> bool:
         line = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
         with self._flock() as lk:
-            try:
-                if (os.path.exists(self.path)
-                        and os.path.getsize(self.path) + len(line) > self.max_bytes):
-                    if lk.cross_locked:
-                        self._compact_locked()
-                    else:
-                        # degraded: an unlocked compaction could truncate
-                        # away a concurrent peer append in a shared-archive
-                        # (RWX PVC) deployment — the append below is safe
-                        # (O_APPEND, interleave-atomic), compaction is not.
-                        # The file grows past max_bytes until the lock
-                        # heals; counted so operators see it.
-                        self.compactions_skipped_unlocked += 1
-            except OSError:
-                pass
-            try:
-                fd = os.open(self.path,
-                             os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
-                try:
-                    os.write(fd, line)  # one write(2): interleave-atomic
-                finally:
-                    os.close(fd)
-            except OSError:
-                return False  # disk full/unwritable: caller keeps RAM copy
-        return True
+            self._maybe_compact_locked(len(line), lk.cross_locked)
+            return self._raw_append_locked(line)
 
     def _compact_locked(self):
         """Merge both generations into `.1`, last-write-wins (caller holds
@@ -185,7 +213,8 @@ class FileArchive:
         count, not deployment history."""
         import time as _time
 
-        horizon = _time.time() - self.keep_terminal_seconds
+        now = _time.time()
+        horizon = now - self.keep_terminal_seconds
         docs: dict[str, dict] = {}
         states: dict[str, dict] = {}
         hpalogs: list[dict] = []
@@ -210,9 +239,17 @@ class FileArchive:
             if rec.get("status") not in _TERMINAL
             or rec.get("modified_at", 0.0) >= horizon
         ]
+        # dead shard-member heartbeat blobs age out like terminal docs do
+        # (hostname-pid replica ids mint a new key per restart; without a
+        # horizon the state section accumulates every incarnation forever)
+        keep_states = [
+            rec for rec in states.values()
+            if not rec.get("key", "").startswith(MEMBER_STATE_PREFIX)
+            or now - rec.get("updated_at", 0.0) <= KEEP_MEMBER_SECONDS
+        ]
         tmp = self.path + ".1.tmp"
         with open(tmp, "w") as f:
-            for rec in (*keep_docs, *states.values(), *hpalogs):
+            for rec in (*keep_docs, *keep_states, *hpalogs):
                 f.write(json.dumps(rec, separators=(",", ":")) + "\n")
         os.replace(tmp, self.path + ".1")
         # truncate the active file (its records now live compacted in .1)
@@ -222,6 +259,44 @@ class FileArchive:
 
     def index_job(self, doc: dict) -> bool:
         return self._append({"_type": "document", **doc})
+
+    def claim_job(self, job_id: str, expected_modified_at: float,
+                  rec: dict) -> bool:
+        """Single-adopter compare-and-swap: append `rec` only while the
+        archive's LATEST record for `job_id` still carries
+        `expected_modified_at` — under the cross-process mutation lock, so
+        two replicas racing to adopt the same stale/released record cannot
+        both win (the loser sees the winner's claim record and backs off).
+        Returns False when the record moved (a peer's claim or any newer
+        state) or is absent. A DEGRADED flock (sidecar .lock unopenable)
+        keeps the in-process check but loses the cross-process guarantee —
+        adoption degrades to the optimistic semantics, which stay safe
+        (last-write-wins verdicts); counted on lock_degradations.
+
+        Cost note: each call scans both generations under the flock, so a
+        large adoption burst over a big file archive serializes O(archive)
+        scans. Fine for this archive's role (dev/test medium, small shared
+        deployments); fleet-scale production uses EsArchive, where the CAS
+        is one conditional PUT."""
+        line = (json.dumps({"_type": "document", **rec},
+                           separators=(",", ":")) + "\n").encode()
+        with self._flock() as lk:
+            # same size-triggered compaction as _append: a mass-adoption
+            # burst (rebalance after a replica death) appends one claim
+            # record per job and must not grow the file unboundedly
+            self._maybe_compact_locked(len(line), lk.cross_locked)
+            latest = None
+            for r in self._scan_once():
+                if r.get("_type") != "document" or r.get("id") != job_id:
+                    continue
+                if latest is None or (r.get("modified_at", 0.0)
+                                      >= latest.get("modified_at", 0.0)):
+                    latest = r
+            if latest is None:
+                return False
+            if latest.get("modified_at", 0.0) != expected_modified_at:
+                return False
+            return self._raw_append_locked(line)
 
     def index_hpalog(self, log: dict) -> bool:
         return self._append({"_type": "hpalog", **log})
@@ -338,6 +413,34 @@ class FileArchive:
                 best = (rec.get("value"), rec.get("updated_at", 0.0))
         return best
 
+    def list_state(self, prefix: str = "") -> dict | None:
+        """{key: (value, updated_at)} — latest per key under `prefix`
+        (the shard-membership enumeration; engine/sharding.py). Returns a
+        dict on success; implementations that can FAIL the read (EsArchive,
+        the breaker wrapper) return None instead of {} so callers can keep
+        their previous view through an outage."""
+        sig = self._mutation_sig()
+        cached = self._state_view
+        if cached is None or cached[0] != sig:
+            # full scan, cached against the PRE-scan signature: any append
+            # or compaction racing the scan changes the sig, so the next
+            # call rescans — between archive mutations the shard layer's
+            # per-heartbeat membership read costs a couple of stat(2)s
+            # instead of a streaming parse of both generations
+            best: dict[str, tuple] = {}
+            for rec in self._iter_records():
+                if rec.get("_type") != "state":
+                    continue
+                key = rec.get("key", "")
+                cur = best.get(key)
+                if cur is None or rec.get("updated_at", 0.0) >= cur[1]:
+                    best[key] = (rec.get("value"), rec.get("updated_at", 0.0))
+            cached = (sig, best)
+            self._state_view = cached
+        if not prefix:
+            return dict(cached[1])
+        return {k: v for k, v in cached[1].items() if k.startswith(prefix)}
+
 
 class EsArchive:
     """Write-behind into ES-compatible REST indices (documents/hpalogs).
@@ -404,6 +507,47 @@ class EsArchive:
             return None
         return res.get("_source")
 
+    def claim_job(self, job_id: str, expected_modified_at: float,
+                  rec: dict) -> bool:
+        """Single-adopter compare-and-swap via ES optimistic concurrency:
+        re-read the doc, verify it is still the version the adoption scan
+        decided on, then PUT conditioned on if_seq_no/if_primary_term — a
+        racing peer's claim bumps the seq_no and this PUT 409s. Servers
+        without the concurrency fields degrade to the plain external-
+        version PUT (optimistic adoption, the pre-CAS semantics)."""
+        try:
+            res = self._req("GET", f"/{self.documents_index}/_doc/{job_id}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False  # nothing to claim
+            self.errors += 1  # 5xx outage: visible on foremast_archive_errors
+            return False
+        except Exception:  # noqa: BLE001 - transport: treat as lost race
+            self.errors += 1
+            return False
+        src = res.get("_source") or {}
+        if src.get("modified_at", 0.0) != expected_modified_at:
+            return False  # the record moved since the scan read it
+        seq_no, p_term = res.get("_seq_no"), res.get("_primary_term")
+        if seq_no is None or p_term is None:
+            return self.index_job(rec)
+        try:
+            self._req(
+                "PUT",
+                f"/{self.documents_index}/_doc/{job_id}"
+                f"?if_seq_no={seq_no}&if_primary_term={p_term}",
+                rec,
+            )
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return False  # a peer claimed it first
+            self.errors += 1
+            return False
+        except Exception:  # noqa: BLE001 - never fail a verdict on archive IO
+            self.errors += 1
+            return False
+
     def index_state(self, key: str, value, updated_at: float) -> bool:
         version = int(updated_at * 1_000_000)
         try:
@@ -433,6 +577,50 @@ class EsArchive:
         if not src:
             return None
         return (src.get("value"), src.get("updated_at", 0.0))
+
+    def list_state(self, prefix: str = "") -> dict | None:
+        """{key: (value, updated_at)} under `prefix`, or None on a FAILED
+        read (outage) so membership callers keep their previous view
+        instead of collapsing the ring (engine/sharding.py)."""
+        query = ({"prefix": {"key.keyword": prefix}} if prefix
+                 else {"match_all": {}})
+        try:
+            res = self._req(
+                "POST", f"/{self.state_index}/_search",
+                # newest-first: if the result ever exceeds the cap, the
+                # truncated page drops the OLDEST docs (dead replica
+                # incarnations), never a live member's current heartbeat
+                {"query": query, "size": 1000,
+                 "sort": [{"updated_at": {"order": "desc",
+                                          "unmapped_type": "double"}}]},
+            )
+        except Exception:  # noqa: BLE001
+            self.errors += 1
+            return None
+        out: dict[str, tuple] = {}
+        for h in res.get("hits", {}).get("hits", []):
+            src = h.get("_source") or {}
+            key = src.get("key", "")
+            if key:
+                out[key] = (src.get("value"), src.get("updated_at", 0.0))
+        return out
+
+    def delete_state(self, key: str) -> bool:
+        """Best-effort DELETE of one state doc. ES has no compaction pass
+        to age dead shard-member blobs out (FileArchive drops them when
+        it compacts), so the membership reader prunes long-dead
+        incarnations through this instead (engine/sharding.py)."""
+        try:
+            self._req("DELETE", f"/{self.state_index}/_doc/{key}")
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return True  # already gone
+            self.errors += 1
+            return False
+        except Exception:  # noqa: BLE001 - best-effort hygiene
+            self.errors += 1
+            return False
 
     def search(self, app=None, namespace=None, status=None, strategy=None,
                limit: int = 50, oldest_first: bool = False) -> list[dict]:

@@ -36,6 +36,8 @@ __all__ = [
     "EVENT_HEALTH_TRANSITION", "EVENT_SHED", "EVENT_QUARANTINE",
     "EVENT_STALE_SERVE", "EVENT_WATCHDOG", "EVENT_BREAKER",
     "EVENT_LEASE_HANDOFF", "EVENT_DUMP",
+    "EVENT_REPLICA_JOIN", "EVENT_REPLICA_LEAVE", "EVENT_REBALANCE",
+    "EVENT_SHARD_ADOPTION",
 ]
 
 # -- event-type registry -----------------------------------------------------
@@ -47,11 +49,19 @@ EVENT_WATCHDOG = "watchdog-fire"
 EVENT_BREAKER = "breaker-flip"
 EVENT_LEASE_HANDOFF = "lease-handoff"
 EVENT_DUMP = "flight-dump"
+# sharded multi-replica membership (engine/sharding.py): another replica
+# joined/left the ring, this replica's shard assignment changed, and a
+# post-rebalance adoption scan pulled a peer's jobs
+EVENT_REPLICA_JOIN = "replica-join"
+EVENT_REPLICA_LEAVE = "replica-leave"
+EVENT_REBALANCE = "shard-rebalance"
+EVENT_SHARD_ADOPTION = "shard-adoption"
 
 EVENT_TYPES = frozenset({
     EVENT_HEALTH_TRANSITION, EVENT_SHED, EVENT_QUARANTINE,
     EVENT_STALE_SERVE, EVENT_WATCHDOG, EVENT_BREAKER, EVENT_LEASE_HANDOFF,
-    EVENT_DUMP,
+    EVENT_DUMP, EVENT_REPLICA_JOIN, EVENT_REPLICA_LEAVE, EVENT_REBALANCE,
+    EVENT_SHARD_ADOPTION,
 })
 
 MAX_DUMPS = 8  # newest dump files kept on disk per dump dir

@@ -76,6 +76,13 @@ class HealthMonitor:
         self.stall_grace_seconds = float(stall_grace_seconds)
         self._clock = clock
         self.breakers_fn = None  # () -> {key: "closed"|"half-open"|"open"}
+        # sharded-brain tap (engine/sharding.py ShardManager.health_summary):
+        # () -> {replica, replicas, owned, adopting, draining}. Folded into
+        # the state() detail so /readyz and /status answer "which slice of
+        # the fleet is this replica responsible for, and is it mid-
+        # rebalance" — informational, never a state driver (a rebalance is
+        # normal operation, not degradation).
+        self.shards_fn = None
         # flight recorder (engine/flightrec.py): hears state transitions
         # and breaker flips; transitions into OVERLOADED/STALLED auto-dump
         self.recorder = recorder
@@ -91,12 +98,14 @@ class HealthMonitor:
 
     # ------------------------------------------------------------ wiring
     def configure(self, cycle_seconds: float | None = None,
-                  breakers_fn=None):
+                  breakers_fn=None, shards_fn=None):
         with self._lock:
             if cycle_seconds is not None:
                 self.cycle_seconds = float(cycle_seconds)
             if breakers_fn is not None:
                 self.breakers_fn = breakers_fn
+            if shards_fn is not None:
+                self.shards_fn = shards_fn
 
     # --------------------------------------------------------- engine side
     def begin_cycle(self):
@@ -153,6 +162,7 @@ class HealthMonitor:
             started = self._started_at
             last_end = self._last_cycle_end
             breakers_fn = self.breakers_fn
+            shards_fn = self.shards_fn
         open_breakers = []
         if breakers_fn is not None:
             try:
@@ -162,6 +172,11 @@ class HealthMonitor:
                 open_breakers = []
         detail = dict(last)
         detail["open_breakers"] = open_breakers
+        if shards_fn is not None:
+            try:
+                detail["shards"] = shards_fn()
+            except Exception:  # noqa: BLE001 - a probe must never raise
+                pass
         # STALLED: the engine has started cycling but nothing COMPLETED
         # inside the liveness window. The reference is the last completed
         # cycle (first begin before any completes), so it covers every

@@ -211,6 +211,13 @@ class JobStore:
         # adopt a crashed peer's in-flight work (adopt_stale_from_archive)
         self.mirror_open = mirror_open and archive is not None
         self.adopted_total = 0  # observability: jobs adopted from peers
+        # lease lifecycle counters (foremastbrain:lease_*_total on
+        # /metrics): fresh INITIAL claims, stuck-lease takeover steals,
+        # and released handoffs (shutdown + shard rebalance). Adoptions
+        # are adopted_total above.
+        self.lease_claims_total = 0
+        self.lease_steals_total = 0
+        self.lease_releases_total = 0
         self.mirror_failures_total = 0  # failed mirror writes (any cause)
         # per-doc retry backoff after a failed mirror write: id ->
         # (retry_at, current_delay). Keeps a permanently-rejected doc (ES
@@ -297,25 +304,37 @@ class JobStore:
         return doc
 
     def claim_open_jobs(self, worker: str, limit: int = 1024,
-                        max_stuck_seconds: float = 90.0) -> list[Document]:
+                        max_stuck_seconds: float = 90.0,
+                        owns_fn=None) -> list[Document]:
         """Lease up to `limit` runnable jobs for `worker`.
 
         A job is runnable if INITIAL, or stuck in an inprogress status longer
         than max_stuck_seconds (takeover — the reference's shared-nothing
         recovery mechanism).
+
+        `owns_fn` is the sharded-brain ownership gate (engine/sharding.py
+        ShardManager.owns): jobs in shards this replica does not own are
+        skipped — they belong to a peer, and the rebalance reconciler
+        (release_unowned) hands any local copies off. Must be a cheap
+        pure-host predicate: it runs per doc under the store lock.
         """
         now = time.time()
         out = []
+        claims = steals = 0
         with self._lock:
             for doc in self._jobs.values():
                 if len(out) >= limit:
                     break
+                if owns_fn is not None and not owns_fn(doc.id):
+                    continue
                 if doc.status == INITIAL:
                     doc.status = PREPROCESS_INPROGRESS
+                    claims += 1
                 elif doc.status in INPROGRESS_STATUSES and (
                     now - (doc.lease_at or doc.modified_at) > max_stuck_seconds
                 ):
                     doc.status = PREPROCESS_INPROGRESS  # reprocess from scratch
+                    steals += 1
                 else:
                     continue
                 doc.lease_holder = worker
@@ -324,6 +343,8 @@ class JobStore:
                 doc.released_at = 0.0  # claimed again: handoff mark expires
                 out.append(doc)
             if out:
+                self.lease_claims_total += claims
+                self.lease_steals_total += steals
                 self._persist()
         return out
 
@@ -363,8 +384,72 @@ class JobStore:
                 # push the handoff stamps (one attempt each — the drain's
                 # progress check still bounds a dead archive)
                 self._mirror_backoff.clear()
+                self.lease_releases_total += released
                 self._persist()
         return released
+
+    def release_unowned(self, owns_fn, worker: str = "") -> list[str]:
+        """Shard-rebalance handoff: release every open job this replica no
+        longer owns (engine/sharding.py calls this from ShardManager.tick
+        after a membership change).
+
+        Same semantics as release_leases, per doc: in-progress jobs rewind
+        to INITIAL, the lease drops, and released_at stamps the record so
+        the NEW owner's adoption scan takes it over immediately — no
+        MAX_STUCK_IN_SECONDS wait. Docs already handed off (released,
+        unleased, INITIAL) are left alone so a still-unadopted record is
+        not re-stamped every tick. Returns the released ids."""
+        now = time.time()
+        released: list[str] = []
+        with self._lock:
+            for doc in self._jobs.values():
+                if doc.status not in OPEN_STATUSES:
+                    continue
+                if owns_fn(doc.id):
+                    continue
+                if (doc.released_at > 0 and not doc.lease_holder
+                        and doc.status == INITIAL):
+                    continue  # already handed off, awaiting adoption/prune
+                if doc.status in INPROGRESS_STATUSES:
+                    doc.status = INITIAL
+                    if worker:
+                        doc.reason = f"released by {worker} rebalance"
+                doc.lease_holder = ""
+                doc.released_at = now
+                doc.modified_at = now
+                # handed-off docs must reach the archive promptly: clear
+                # any mirror-failure backoff so the next flush retries
+                self._mirror_backoff.pop(doc.id, None)
+                released.append(doc.id)
+            if released:
+                self.lease_releases_total += len(released)
+                self._persist()
+        return released
+
+    def prune_handed_off(self, owns_fn) -> int:
+        """Drop local copies of handed-off jobs once the archive CONFIRMED
+        holding the released record (archived_at caught up): the record of
+        truth now lives in the archive for the new owner to adopt, and a
+        lingering local open copy would shadow the peer's eventual
+        terminal verdict in /search forever. Returns the number dropped."""
+        if self.archive is None:
+            return 0
+        dropped = 0
+        with self._lock:
+            dead = [
+                doc.id for doc in self._jobs.values()
+                if doc.status in OPEN_STATUSES
+                and doc.released_at > 0
+                and not doc.lease_holder
+                and doc.archived_at >= doc.modified_at
+                and not owns_fn(doc.id)
+            ]
+            for jid in dead:
+                del self._jobs[jid]
+                dropped += 1
+            if dropped:
+                self._persist()
+        return dropped
 
     def archive_dirty_count(self) -> int:
         """Docs whose newest version the archive has not confirmed yet —
@@ -787,7 +872,8 @@ class JobStore:
                                  max_stuck_seconds: float = 90.0,
                                  limit: int = 1024,
                                  now: float | None = None,
-                                 skew_margin_seconds: float = 15.0) -> int:
+                                 skew_margin_seconds: float = 15.0,
+                                 owns_fn=None, dead_holder_fn=None) -> int:
         """Adopt open jobs a crashed/partitioned peer left in the archive.
 
         The reference's failover medium is ES: any brain replica re-claims
@@ -796,9 +882,29 @@ class JobStore:
         Here the shared archive plays that role: open-job records mirrored
         by peers (see _mirror_to_archive) whose lease stamp has gone stale
         are pulled into the local store; the normal claim_open_jobs lease
-        steal then reprocesses them. Like the reference, takeover is
-        optimistic — a live-but-slow peer's job can be double-scored;
-        verdict writes are last-write-wins per id, so that is harmless.
+        steal then reprocesses them.
+
+        Three adoptability gates, any one suffices:
+          * released — the owner stamped released_at (graceful shutdown or
+            a shard-rebalance handoff): adoptable NOW, no stuck wait;
+          * dead holder — `dead_holder_fn(lease_holder)` says the owning
+            replica is POSITIVELY dead per the membership layer
+            (engine/sharding.py): a kill -9'd peer's fleet is adoptable at
+            membership-TTL latency instead of the stuck window;
+          * stale — the lease stamp aged past max_stuck + skew margin (the
+            original optimistic path, always available).
+
+        `owns_fn` restricts adoption to this replica's own shards, so N
+        replicas recovering a dead peer split its fleet instead of all
+        pulling all of it.
+
+        When the archive supports `claim_job` (compare-and-swap append;
+        FileArchive/EsArchive do), the adoption is RACE-FREE: the claim
+        record lands only if the archived record is still the version this
+        scan read, so two replicas racing for the same record cannot both
+        pull it — the loser's CAS fails and it moves on. Archives without
+        claim_job keep the reference's optimistic semantics (double-score
+        possible, harmless: verdict writes are last-write-wins per id).
 
         The staleness test compares PEER-written wall-clock stamps against
         the LOCAL clock, so cross-replica clock skew eats directly into the
@@ -813,6 +919,7 @@ class JobStore:
             return 0
         now = time.time() if now is None else now
         adopted = 0
+        claim_cas = getattr(self.archive, "claim_job", None)
         # oldest_first: stale jobs have the OLDEST stamps; a newest-first
         # cap at fleet scale would return only the healthy churn
         for rec in self.archive.search(status=list(OPEN_STATUSES),
@@ -822,13 +929,17 @@ class JobStore:
                 doc = Document.from_json(rec)
             except (TypeError, ValueError):
                 continue  # malformed/foreign record: not adoptable
+            if owns_fn is not None and not owns_fn(doc.id):
+                continue  # a peer's shard: its owner recovers it
             # a gracefully-released record (release_leases stamped it on
             # shutdown, and nothing claimed it since) is adoptable NOW —
             # the owner surrendered the lease explicitly, so waiting out
             # the stuck window would only delay the takeover it asked for
             released = (doc.released_at > 0
                         and doc.released_at >= doc.lease_at)
-            if not released and (
+            dead = (dead_holder_fn is not None and doc.lease_holder
+                    and bool(dead_holder_fn(doc.lease_holder)))
+            if not released and not dead and (
                     now - max(doc.lease_at, doc.modified_at)
                     <= max_stuck_seconds + skew_margin_seconds):
                 continue  # the owner is (or was recently) alive
@@ -839,11 +950,46 @@ class JobStore:
                     or cur.modified_at >= doc.modified_at
                 ):
                     continue  # we hold it, or our copy is newer
+            if doc.status in INPROGRESS_STATUSES:
+                # reprocess from scratch — the same rewind the lease steal
+                # applies. Without it a DEAD-HOLDER adoption (lease still
+                # fresh, only membership says the owner died) would sit
+                # unclaimable until the stuck window elapsed, defeating
+                # the membership layer's faster recovery.
+                doc.status = INITIAL
+            if claim_cas is not None:
+                # single-adopter guard: append our claim record only while
+                # the archive still holds the exact version we read. The
+                # claim bumps modified_at (so a racer's staleness test
+                # fails too) and clears released_at (a handoff mark must
+                # not leave the CLAIMED record insta-adoptable by the next
+                # scan); lease_at stays stale so our own claim_open_jobs
+                # steal proceeds normally. WALL clock, not the caller's
+                # `now` (tests pass synthetic futures for staleness math —
+                # a future-stamped claim would shadow every later write),
+                # floored just past the expected version so the claim is
+                # strictly newest even under writer clock skew.
+                expected = doc.modified_at
+                doc.modified_at = max(time.time(), expected + 1e-6)
+                doc.released_at = 0.0
+                if worker:
+                    doc.lease_holder = worker
+                if not claim_cas(doc.id, expected, doc.to_json()):
+                    continue  # a peer won the race (or the record moved)
+                doc.archived_at = doc.modified_at  # our claim IS archived
+            else:
                 doc.archived_at = doc.modified_at  # archive holds this version
                 if worker:
                     # record who adopted it; lease_at stays STALE so the
                     # next claim_open_jobs steal proceeds normally
                     doc.lease_holder = worker
+            with self._lock:
+                cur = self._jobs.get(doc.id)
+                if cur is not None and (
+                    cur.status in OPEN_STATUSES
+                    or cur.modified_at >= doc.modified_at
+                ):
+                    continue  # a local racer landed while the CAS ran
                 self._jobs[doc.id] = doc
                 self.adopted_total += 1
                 adopted += 1

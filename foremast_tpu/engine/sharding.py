@@ -1,0 +1,562 @@
+"""Sharded multi-replica brain: consistent-hash job ownership + membership.
+
+The lease layer (PR 4) already solves the HARD half of horizontal scale —
+takeover: ``release_leases`` handoff marks and ``adopt_stale_from_archive``
+let any replica pick up a crashed or drained peer's work through the shared
+archive. What it never solved is OWNERSHIP: N replicas over one archive all
+raced for the same fleet, duplicating every fetch and score. This module
+partitions the fleet:
+
+  * **Shards.** Job ids hash (blake2b) onto ``shard_count`` fixed buckets of
+    the job-id hash space (``shard_of``). Shards — not individual jobs — are
+    the unit of ownership, rebalance, state, and blast radius, so membership
+    churn moves bounded, observable chunks of the fleet.
+  * **Ring.** A consistent-hash ring (``HashRing``) with ``vnodes`` virtual
+    nodes per replica assigns shards to replicas. Adding or removing one
+    replica moves only the shards that land on its vnodes (~1/N of the
+    fleet); everyone else's assignment is untouched.
+  * **Membership.** Replicas announce themselves through the SAME archive
+    the lease layer already uses — one ``shard-member:<replica>`` state blob
+    heartbeated every ``heartbeat_seconds``, presumed dead after
+    ``member_ttl_seconds`` (no new infra, no coordinator). A graceful
+    shutdown stamps ``left`` so peers rebalance immediately instead of
+    waiting out the TTL. Multi-process (jax.distributed) worlds skip
+    heartbeats entirely: the launcher fixes the membership
+    (``parallel.distributed.replica_identity`` -> ``static_members``).
+  * **State machine.** Each shard is ``owned`` / ``adopting`` (gained on a
+    rebalance, until the next adoption scan lands) / ``draining`` (lost on a
+    rebalance, until the local open jobs are handed off) / ``remote``.
+    Surfaced in the HealthMonitor detail, ``/status``, ``/metrics``, and the
+    flight recorder (EVENT_REPLICA_JOIN/LEAVE, EVENT_REBALANCE,
+    EVENT_SHARD_ADOPTION).
+
+How the pieces gate the existing machinery:
+
+  * ``claim_open_jobs(owns_fn=...)`` — a replica leases only jobs in shards
+    it owns, so replicas stop racing for the same work.
+  * ``release_unowned`` (called from ``tick``) — a rebalance hands off
+    non-owned open jobs with the PR 4 ``released_at`` mark; the new owner's
+    adoption scan takes them over immediately, no stuck-window wait.
+  * ``adopt_stale_from_archive(owns_fn=..., dead_holder_fn=...)`` — a
+    replica adopts only its own shards, and a lease held by a replica the
+    membership layer says is DEAD (kill -9: no release mark, lease not yet
+    stale) is adoptable at membership-TTL latency instead of
+    MAX_STUCK_IN_SECONDS. The archive-level compare-and-swap
+    (``archive.claim_job``) keeps two racing adopters from both pulling the
+    same record.
+
+Split-brain note: when the archive is unreachable, a replica keeps its LAST
+membership view (a failed read never collapses the ring to "just me" and
+mass-claims the fleet), and dead-holder adoption is suspended until a read
+succeeds. During a genuine partition replicas may transiently double-score
+— the same optimistic property the reference's ES takeover had; verdict
+writes stay last-write-wins per id, so it is harmless and self-heals.
+"""
+from __future__ import annotations
+
+import bisect
+import functools
+import hashlib
+import logging
+import time
+
+from . import jobs as J
+from .archive import KEEP_MEMBER_SECONDS, MEMBER_STATE_PREFIX
+from .flightrec import (
+    EVENT_LEASE_HANDOFF,
+    EVENT_REBALANCE,
+    EVENT_REPLICA_JOIN,
+    EVENT_REPLICA_LEAVE,
+    EVENT_SHARD_ADOPTION,
+)
+from ..utils.locks import make_lock
+
+log = logging.getLogger("foremast_tpu.engine.sharding")
+
+__all__ = [
+    "HashRing", "ShardManager", "shard_of", "MEMBER_KEY_PREFIX",
+    "SHARD_OWNED", "SHARD_DRAINING", "SHARD_ADOPTING", "SHARD_REMOTE",
+]
+
+# per-shard ownership states (the owned/draining/adopting machine)
+SHARD_OWNED = "owned"
+SHARD_DRAINING = "draining"
+SHARD_ADOPTING = "adopting"
+SHARD_REMOTE = "remote"
+
+# archive state-blob key prefix for membership heartbeats (canonical
+# constant lives in archive.py, whose compaction ages dead blobs out)
+MEMBER_KEY_PREFIX = MEMBER_STATE_PREFIX
+
+
+def _h(key: str) -> int:
+    """Stable 64-bit position on the hash space (process-independent —
+    Python's hash() is salted per process and would re-deal every shard
+    on every restart)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big")
+
+
+@functools.lru_cache(maxsize=1 << 18)
+def shard_of(job_id: str, shard_count: int) -> int:
+    """The fixed shard bucket a job id hashes into. Every replica computes
+    the same answer from the id alone — ownership needs no lookup table,
+    only the ring. Memoized: the ownership gate re-asks for the same ids
+    every claim/reconcile tick (several full-store walks per lap at 2+
+    members), so repeat lookups must cost a dict hit, not a blake2b."""
+    return _h("job:" + job_id) % max(int(shard_count), 1)
+
+
+class HashRing:
+    """Consistent-hash ring: members x vnodes points on the 64-bit space;
+    a key belongs to the first point clockwise from its hash. Immutable —
+    rebalance swaps in a fresh ring, so readers never need a lock."""
+
+    def __init__(self, members, vnodes: int = 64):
+        self.members = tuple(sorted(set(members)))
+        self.vnodes = max(int(vnodes), 1)
+        points = [
+            (_h(f"{m}#vn{v}"), m)
+            for m in self.members for v in range(self.vnodes)
+        ]
+        points.sort()
+        self._points = points
+        self._keys = [p[0] for p in points]
+
+    def owner(self, key: str) -> str | None:
+        if not self._points:
+            return None
+        i = bisect.bisect_right(self._keys, _h(key))
+        if i == len(self._points):
+            i = 0  # wrap: the ring is a circle
+        return self._points[i][1]
+
+
+class ShardManager:
+    """Job-ownership gate + membership tracker for one replica.
+
+    The runtime calls ``tick()`` once per worker-loop iteration (heartbeat,
+    membership refresh, rebalance, handoff), passes ``owns``/``dead_holder``
+    into the store's claim/adopt calls, and ``mark_adopt_complete`` after
+    each adoption scan. Everything here is cheap host-side bookkeeping;
+    the only I/O is one heartbeat write per ``heartbeat_seconds`` and the
+    membership read that rides it.
+
+    ``static_members`` (multi-process worlds) fixes the membership without
+    any archive traffic; an archive-less manager degrades to a sole-owner
+    ring (owns everything — single-replica behavior, unchanged).
+    """
+
+    def __init__(self, store, replica_id: str, *, shard_count: int = 64,
+                 vnodes: int = 64, heartbeat_seconds: float = 5.0,
+                 member_ttl_seconds: float = 15.0, static_members=None,
+                 worker: str = "", flight=None, clock=time.time):
+        self.store = store
+        self.archive = getattr(store, "archive", None)
+        self.replica_id = replica_id
+        self.worker = worker or replica_id
+        self.shard_count = max(int(shard_count), 1)
+        self.vnodes = max(int(vnodes), 1)
+        self.heartbeat_seconds = float(heartbeat_seconds)
+        self.member_ttl_seconds = float(member_ttl_seconds)
+        self.static_members = (
+            tuple(sorted(set(static_members) | {replica_id}))
+            if static_members else None)
+        self.flight = flight
+        self._clock = clock
+        # guards the swap of the view/ring/owner/state refs; readers
+        # (owns, dead_holder — called per doc under the store lock) read
+        # the refs WITHOUT it, which is safe because rebuilds swap whole
+        # immutable-by-convention dicts
+        self._lock = make_lock("engine.sharding")
+        self._last_heartbeat: float | None = None
+        self._last_read: float | None = None
+        # replica -> heartbeat value ({"replica", "worker"}); always
+        # includes self. A FAILED membership read keeps the previous view
+        # (stale beats empty: collapsing to {self} would mass-claim the
+        # fleet) and clears _membership_fresh so dead-holder adoption is
+        # suspended until a read succeeds again.
+        self._members_view: dict[str, dict] = {
+            replica_id: {"replica": replica_id, "worker": self.worker}}
+        # every replica id / worker name ever seen in a fresh view: the
+        # dead-holder gate only convicts holders we positively watched
+        # disappear (a never-seen holder is NOT evidence of death)
+        self._known_holders: set[str] = set()
+        self._membership_fresh = static_members is not None
+        members = self.static_members or (replica_id,)
+        self._member_ids: tuple = ()
+        self._ring = HashRing((), vnodes=self.vnodes)
+        self._owners: dict[int, str] = {}
+        self._states: dict[int, str] = {}
+        # a replica that has never seen a peer cannot tell "I have been
+        # running solo" from "I just joined an existing fleet" — the first
+        # multi-member rebalance therefore marks EVERY owned shard
+        # adopting (one extra adoption scan for a genuine solo, correct
+        # recovery for a joiner)
+        self._seen_peers = len(members) > 1
+        # bootstrap assignment (no events, not counted as a rebalance)
+        self._apply_membership(members, bootstrap=True)
+        # observability counters
+        self.rebalances_total = 0
+        self.handoffs_total = 0
+        self.adoptions_total = 0
+        self.membership_read_failures = 0
+        self.last_rebalance_at = 0.0
+
+    # ------------------------------------------------------------ ownership
+    def owns(self, job_id: str) -> bool:
+        """Does this replica own the job's shard? Lock-free (reads one
+        immutable dict ref) — called per doc under the store lock."""
+        owners = self._owners
+        if not owners:
+            return True
+        return owners.get(shard_of(job_id, self.shard_count)) \
+            == self.replica_id
+
+    def owner_of(self, job_id: str) -> str | None:
+        return self._owners.get(shard_of(job_id, self.shard_count))
+
+    def dead_holder(self, holder: str) -> bool:
+        """Is a lease holder POSITIVELY dead per the membership view?
+
+        True only when membership is fresh (last read succeeded), the
+        holder was SEEN alive in an earlier view (so we positively watched
+        it disappear — not merely never heard of it), and it matches no
+        live member's replica id or worker name. Conservative by
+        construction: never-seen holders (a non-sharded peer sharing the
+        archive, a mid-upgrade replica that has not heartbeated yet),
+        stale views, and archive outages all answer False, leaving the
+        normal MAX_STUCK_IN_SECONDS staleness test in charge."""
+        if not holder or not self._membership_fresh:
+            return False
+        if holder not in self._known_holders:
+            return False
+        view = self._members_view
+        if holder in view:
+            return False
+        return all(v.get("worker") != holder for v in view.values())
+
+    # ------------------------------------------------------------ lifecycle
+    def tick(self, now: float | None = None) -> dict:
+        """One membership/rebalance step: heartbeat (rate-limited), refresh
+        the membership view, rebalance the ring on change, and hand off
+        newly non-owned open jobs. Returns a small summary the worker loop
+        uses to trigger an immediate adoption scan after a rebalance."""
+        now = self._clock() if now is None else now
+        members = self._refresh_membership(now)
+        changed, joined, left, gained, lost = self._apply_membership(members)
+        released = self._reconcile_store()
+        if changed:
+            self.rebalances_total += 1
+            self.last_rebalance_at = now
+            self._record_membership_events(joined, left, gained, lost,
+                                           released)
+        elif released and self.flight is not None:
+            # handoffs can trail the rebalance tick (jobs submitted into a
+            # non-owned shard later): still an observable lease event
+            self.flight.record_event(
+                EVENT_LEASE_HANDOFF, released=len(released),
+                worker=self.worker, reason="shard-rebalance",
+                jobs=list(released[:32]))
+        return {
+            "membership_changed": changed,
+            "replicas": sorted(members),
+            "handoffs": len(released),
+            "gained_shards": len(gained),
+            "lost_shards": len(lost),
+        }
+
+    def heartbeat(self, now: float | None = None) -> None:
+        """Advertise liveness (one member-blob write, rate-limited to
+        ``heartbeat_seconds``). Called from tick() AND from the runtime's
+        dedicated heartbeat thread: liveness must never ride the worker
+        loop alone, or one slow scoring cycle (cold compile, adoption
+        burst) would age the advertisement past MEMBER_TTL_S and peers
+        would declare this replica dead and steal its in-flight leases
+        mid-cycle. Thread-safe: the timestamp is claimed under the lock
+        (concurrent callers skip), and reset on a failed write so the
+        next caller retries."""
+        if self.archive is None or self.static_members is not None:
+            return
+        now = self._clock() if now is None else now
+        with self._lock:
+            if (self._last_heartbeat is not None
+                    and now - self._last_heartbeat < self.heartbeat_seconds):
+                return
+            self._last_heartbeat = now
+        ok = False
+        try:
+            ok = bool(self.archive.index_state(
+                MEMBER_KEY_PREFIX + self.replica_id,
+                {"replica": self.replica_id, "worker": self.worker,
+                 "left": False}, now))
+        except Exception as e:  # noqa: BLE001 - heartbeat is best-effort
+            log.warning("membership heartbeat failed: %s", e)
+        if not ok:
+            with self._lock:
+                self._last_heartbeat = None
+
+    def withdraw(self, now: float | None = None) -> None:
+        """Graceful-shutdown half of membership: stamp this replica as
+        ``left`` so peers rebalance IMMEDIATELY instead of waiting out the
+        TTL (the lease release + mirror drain in Runtime.stop hands the
+        jobs themselves over). Best-effort: a dead archive falls back to
+        the TTL expiry."""
+        if self.archive is None or self.static_members is not None:
+            return
+        now = self._clock() if now is None else now
+        try:
+            self.archive.index_state(
+                MEMBER_KEY_PREFIX + self.replica_id,
+                {"replica": self.replica_id, "worker": self.worker,
+                 "left": True}, now)
+        except Exception as e:  # noqa: BLE001 - shutdown must not raise
+            log.warning("membership withdraw failed: %s", e)
+
+    def mark_adopt_complete(self, adopted: int = 0) -> None:
+        """An adoption scan ran with this manager's gates: gained shards
+        graduate ``adopting`` -> ``owned``; a nonzero adoption is recorded
+        for the flight recorder.
+
+        Graduation requires a TRUSTED scan: adoption and membership ride
+        the same archive, so when the last membership read failed the
+        scan's empty answer is just as likely a silent outage (the
+        breaker wrapper maps a failed search to []) — the shards stay
+        ``adopting``, keeping the /status "nothing adopting for more
+        than a tick or two" runbook signal honest until a scan against a
+        healthy archive lands. A scan that actually adopted something
+        evidently reached the archive and always graduates."""
+        scan_trusted = (adopted > 0 or self.archive is None
+                        or self.static_members is not None
+                        or self._membership_fresh)
+        with self._lock:
+            if scan_trusted and any(
+                    s == SHARD_ADOPTING for s in self._states.values()):
+                self._states = {
+                    k: (SHARD_OWNED if v == SHARD_ADOPTING else v)
+                    for k, v in self._states.items()}
+        if adopted:
+            self.adoptions_total += adopted
+            if self.flight is not None:
+                self.flight.record_event(
+                    EVENT_SHARD_ADOPTION, replica=self.replica_id,
+                    adopted=int(adopted))
+
+    # ----------------------------------------------------------- membership
+    def _refresh_membership(self, now: float) -> dict[str, dict]:
+        """Current live members (always including self). Archive-backed
+        membership heartbeats + reads here; static worlds and archive-less
+        managers return their fixed view.
+
+        The membership READ rides the heartbeat cadence: between
+        heartbeats a fresh view is simply reused, so tick() costs no
+        archive I/O on the worker loop's critical path (FileArchive's
+        list_state is a full scan, EsArchive's an HTTP search). A failed
+        read clears _membership_fresh, which forces a retry on EVERY tick
+        until one succeeds."""
+        me = {"replica": self.replica_id, "worker": self.worker}
+        if self.static_members is not None:
+            view = {m: {"replica": m} for m in self.static_members}
+            view[self.replica_id] = me
+            self._members_view = view
+            self._note_holders(view)
+            return view
+        if self.archive is None:
+            self._members_view = {self.replica_id: me}
+            return self._members_view
+        self.heartbeat(now)
+        read_due = (self._last_read is None
+                    or now - self._last_read >= self.heartbeat_seconds)
+        if not read_due and self._membership_fresh:
+            return dict(self._members_view)
+        list_state = getattr(self.archive, "list_state", None)
+        if list_state is None:
+            # archive cannot enumerate members: sole-owner ring (single-
+            # replica deployments over a minimal archive implementation)
+            self._members_view = {self.replica_id: me}
+            return self._members_view
+        try:
+            recs = list_state(MEMBER_KEY_PREFIX)
+        except Exception:  # noqa: BLE001 - outage: keep the previous view
+            recs = None
+        if recs is None:
+            self.membership_read_failures += 1
+            self._membership_fresh = False
+            return dict(self._members_view)
+        view = {self.replica_id: me}
+        # opportunistic hygiene: archives with a delete_state (EsArchive —
+        # no compaction pass to age blobs out) shed long-dead member docs
+        # so the membership read's result set tracks the LIVE fleet, not
+        # every replica incarnation ever (hostname-pid ids mint a new key
+        # per restart). Bounded per refresh; best-effort.
+        prune = getattr(self.archive, "delete_state", None)
+        pruned = 0
+        for key, (value, stamp) in recs.items():
+            rid = key[len(MEMBER_KEY_PREFIX):]
+            if rid == self.replica_id or not isinstance(value, dict):
+                continue
+            if value.get("left") or now - stamp > self.member_ttl_seconds:
+                if (prune is not None and pruned < 8
+                        and now - stamp > KEEP_MEMBER_SECONDS):
+                    try:
+                        prune(key)
+                        pruned += 1
+                    except Exception:  # noqa: BLE001 - hygiene only
+                        pass
+                continue
+            view[rid] = value
+        self._members_view = view
+        self._membership_fresh = True
+        self._last_read = now
+        self._note_holders(view)
+        return view
+
+    def _note_holders(self, view: dict[str, dict]) -> None:
+        """Remember every replica id / worker name seen alive in a fresh
+        view (the dead-holder gate's evidence base)."""
+        for rid, v in view.items():
+            self._known_holders.add(rid)
+            w = v.get("worker")
+            if w:
+                self._known_holders.add(w)
+
+    def _apply_membership(self, members, bootstrap: bool = False):
+        """Rebuild the ring when the member set changed; diff shard
+        ownership into gained (-> adopting) and lost (-> draining) sets.
+        Returns (changed, joined, left, gained, lost)."""
+        ids = tuple(sorted(members))
+        with self._lock:
+            if ids == self._member_ids:
+                return False, (), (), (), ()
+            old_ids = self._member_ids
+            ring = HashRing(ids, vnodes=self.vnodes)
+            owners = {s: ring.owner(f"shard:{s}")
+                      for s in range(self.shard_count)}
+            me = self.replica_id
+            gained = tuple(s for s, o in owners.items()
+                           if o == me and self._owners.get(s) != me)
+            lost = tuple(s for s, o in owners.items()
+                         if o != me and self._owners.get(s) == me)
+            states = {}
+            sole = len(ids) <= 1
+            first_multi = not sole and not self._seen_peers
+            if not sole:
+                self._seen_peers = True
+            for s, o in owners.items():
+                if o == me:
+                    if s in gained or first_multi:
+                        # nothing to adopt when there is no peer to adopt
+                        # from (bootstrap or sole survivor of a solo ring)
+                        states[s] = (SHARD_OWNED if sole or bootstrap
+                                     else SHARD_ADOPTING)
+                    else:
+                        states[s] = self._states.get(s, SHARD_OWNED)
+                elif s in lost:
+                    states[s] = SHARD_DRAINING
+                else:
+                    # keep a still-draining shard draining until its local
+                    # open jobs are gone, even across further rebalances
+                    states[s] = (SHARD_DRAINING
+                                 if self._states.get(s) == SHARD_DRAINING
+                                 else SHARD_REMOTE)
+            self._ring = ring
+            self._owners = owners
+            self._states = states
+            self._member_ids = ids
+        joined = tuple(sorted(set(ids) - set(old_ids) - {self.replica_id}))
+        left = tuple(sorted(set(old_ids) - set(ids) - {self.replica_id}))
+        return (not bootstrap), joined, left, gained, lost
+
+    def _record_membership_events(self, joined, left, gained, lost,
+                                  released):
+        if self.flight is None:
+            return
+        for rid in joined:
+            self.flight.record_event(EVENT_REPLICA_JOIN, replica=rid,
+                                     observer=self.replica_id)
+        for rid in left:
+            self.flight.record_event(EVENT_REPLICA_LEAVE, replica=rid,
+                                     observer=self.replica_id)
+        self.flight.record_event(
+            EVENT_REBALANCE, replica=self.replica_id,
+            replicas=len(self._member_ids), gained=len(gained),
+            lost=len(lost), handoffs=len(released),
+            jobs=list(released[:32]))
+
+    # ---------------------------------------------------------------- store
+    def _reconcile_store(self) -> list[str]:
+        """Hand off local open jobs this replica no longer owns (the PR 4
+        released_at mark -> immediate peer adoption), prune handed-off
+        copies the archive has confirmed, and settle draining shards whose
+        local jobs are gone."""
+        if self.store is None:
+            return []
+        states = self._states
+        if (len(self._member_ids) <= 1
+                and not any(s == SHARD_DRAINING for s in states.values())):
+            # sole owner of every shard: nothing can be unowned, so skip
+            # the per-doc shard-hash walk under the store lock (sharding
+            # defaults ON for single-replica deployments — this keeps
+            # their per-tick cost at zero)
+            return []
+        released = self.store.release_unowned(self.owns, worker=self.worker)
+        if released:
+            self.handoffs_total += len(released)
+        self.store.prune_handed_off(self.owns)
+        states = self._states  # re-read: a rebalance may have swapped it
+        if any(s == SHARD_DRAINING for s in states.values()):
+            open_shards = {
+                shard_of(d.id, self.shard_count)
+                for d in self.store.by_status(*J.OPEN_STATUSES)}
+            with self._lock:
+                self._states = {
+                    k: (SHARD_REMOTE
+                        if v == SHARD_DRAINING and k not in open_shards
+                        else v)
+                    for k, v in self._states.items()}
+        return released
+
+    # ------------------------------------------------------- observability
+    def state_counts(self) -> dict[str, int]:
+        states = self._states
+        out = {SHARD_OWNED: 0, SHARD_ADOPTING: 0, SHARD_DRAINING: 0,
+               SHARD_REMOTE: 0}
+        for s in states.values():
+            out[s] = out.get(s, 0) + 1
+        return out
+
+    def health_summary(self) -> dict:
+        """Compact per-shard view folded into the HealthMonitor detail."""
+        counts = self.state_counts()
+        return {
+            "replica": self.replica_id,
+            "replicas": len(self._member_ids),
+            "owned": counts[SHARD_OWNED],
+            "adopting": counts[SHARD_ADOPTING],
+            "draining": counts[SHARD_DRAINING],
+        }
+
+    def snapshot(self) -> dict:
+        """Full /status section (and the /metrics gauge source)."""
+        counts = self.state_counts()
+        return {
+            "replica": self.replica_id,
+            "worker": self.worker,
+            "replicas": list(self._member_ids),
+            "membership": ("static" if self.static_members is not None
+                           else "archive" if self.archive is not None
+                           else "solo"),
+            "membership_fresh": self._membership_fresh,
+            "shard_count": self.shard_count,
+            "vnodes": self.vnodes,
+            "owned": counts[SHARD_OWNED],
+            "adopting": counts[SHARD_ADOPTING],
+            "draining": counts[SHARD_DRAINING],
+            "remote": counts[SHARD_REMOTE],
+            "rebalances_total": self.rebalances_total,
+            "handoffs_total": self.handoffs_total,
+            "adoptions_total": self.adoptions_total,
+            "membership_read_failures": self.membership_read_failures,
+            "heartbeat_seconds": self.heartbeat_seconds,
+            "member_ttl_seconds": self.member_ttl_seconds,
+        }

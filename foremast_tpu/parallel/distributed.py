@@ -34,7 +34,7 @@ from ..utils import knobs
 log = logging.getLogger("foremast_tpu.parallel")
 
 __all__ = ["initialize", "HostInfo", "host_info", "global_fleet_mesh",
-           "process_batch_slice"]
+           "process_batch_slice", "replica_identity"]
 
 _initialized = False
 
@@ -85,6 +85,26 @@ def initialize(coordinator: str | None = None, num_processes: int | None = None,
     jax.distributed.initialize(**kwargs)
     _initialized = True
     return True
+
+
+def replica_identity(env: dict | None = None):
+    """(replica_id, static_members) for the sharded brain
+    (engine/sharding.py): each process of a multi-process world is one
+    shard-ring replica, with the membership FIXED by the launcher — no
+    archive heartbeats needed, rebalance only on restart with a new world
+    size. Post-``initialize()`` the live jax.distributed world is
+    authoritative; before it (or single-host) the registered
+    NUM_PROCESSES/PROCESS_ID knobs decide. Returns ("", None) for
+    single-host deploys — the runtime then falls back to REPLICA_ID /
+    hostname-pid identity with archive-heartbeat membership."""
+    if _initialized:
+        n, pid = jax.process_count(), jax.process_index()
+    else:
+        n = knobs.read("NUM_PROCESSES", env)
+        pid = knobs.read("PROCESS_ID", env)
+    if n and n > 1 and pid is not None and pid >= 0:
+        return f"proc-{pid}", [f"proc-{i}" for i in range(n)]
+    return "", None
 
 
 @dataclass(frozen=True)

@@ -210,6 +210,11 @@ class ResilientDataSource(_ResilientBase):
 _ARCHIVE_FAILS = {
     "index_job": False, "index_hpalog": False, "index_state": False,
     "get": None, "get_state": None, "search": [],
+    # sharded-brain surfaces: a breaker-open membership read returns None
+    # (callers keep their previous view — engine/sharding.py), and an
+    # unreachable CAS counts as a lost adoption race (safe: retried on
+    # the next scan)
+    "list_state": None, "claim_job": False, "delete_state": False,
 }
 
 

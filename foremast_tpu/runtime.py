@@ -23,6 +23,13 @@ Env surface (union of the reference services'):
   ARCHIVE_ADOPT_INTERVAL seconds between scans of the shared archive for a
                          crashed peer's stale open jobs (cross-replica
                          failover, reference design.md:37-43; 0 disables)
+  SHARDING / REPLICA_ID  sharded multi-replica brain (engine/sharding.py):
+  SHARD_COUNT /          consistent-hash job ownership over replicas
+  SHARD_VNODES /         sharing one archive — membership by archive
+  HEARTBEAT_S /          heartbeat (TTL'd), rebalance on join/leave with
+  MEMBER_TTL_S           released_at handoffs, dead-holder adoption at
+                         TTL latency (docs/operations.md "Running
+                         multiple replicas")
   JOB_RETENTION_SECONDS  prune archived terminal jobs from RAM after this
   PORT                   HTTP port (reference :8099)
   GRPC_PORT              gRPC dispatch port (0/unset disables; 8100 in the
@@ -72,6 +79,8 @@ Env surface (union of the reference services'):
 from __future__ import annotations
 
 import logging
+import os
+import socket
 import threading
 import time
 
@@ -104,6 +113,13 @@ class Runtime:
         lstm_cache_path: str | None = None,
         resilient: bool | None = None,
         chaos_spec: str | None = None,
+        replica_id: str = "",
+        sharding: bool | None = None,
+        shard_count: int = 64,
+        shard_vnodes: int = 64,
+        heartbeat_seconds: float = 5.0,
+        member_ttl_seconds: float = 15.0,
+        static_replicas=None,
     ):
         self.config = config or from_env()
         # persistent XLA compile cache (COMPILE_CACHE_PATH): point the
@@ -231,6 +247,50 @@ class Runtime:
                 return states
 
             self.analyzer.health.configure(breakers_fn=_breaker_states)
+        # -- sharded multi-replica brain (engine/sharding.py): consistent-
+        # hash job ownership over the shared archive. Default: on whenever
+        # there IS a shared archive — the handoff/adoption medium. Without
+        # one, even a launcher-fixed multi-process world must NOT shard:
+        # release_unowned would rewind a peer's jobs into a limbo no
+        # adoption scan can reach (there is no shared store to reach it
+        # through), silently dropping ~(N-1)/N of submissions. A
+        # sole-member ring owns every shard, so a single-replica
+        # deployment behaves exactly as before.
+        self.replica_id = replica_id or f"{socket.gethostname()}-{os.getpid()}"
+        self.shard = None
+        if sharding is None:
+            sharding = True
+        if static_replicas and archive is None:
+            log.warning(
+                "multi-process world without a shared archive: sharding "
+                "disabled (no handoff/adoption medium) — every process "
+                "scores the jobs submitted to it, as before")
+            sharding = False
+        if sharding and archive is not None:
+            from .engine.sharding import ShardManager
+
+            self.shard = ShardManager(
+                self.store, self.replica_id,
+                shard_count=shard_count, vnodes=shard_vnodes,
+                heartbeat_seconds=heartbeat_seconds,
+                member_ttl_seconds=member_ttl_seconds,
+                static_members=static_replicas,
+                flight=self.analyzer.flight,
+            )
+            self.analyzer.shard = self.shard
+            self.analyzer.health.configure(
+                shards_fn=self.shard.health_summary)
+            if self.adopt_interval_seconds <= 0:
+                # the rebalance handoff RELIES on the adoption scan: a
+                # released job in a peer's shard is only ever picked up by
+                # adopt_stale_from_archive. With scans disabled it would
+                # sit in the archive unscored forever, so floor the
+                # cadence instead of honoring the disable.
+                log.warning(
+                    "SHARDING is active but ARCHIVE_ADOPT_INTERVAL "
+                    "disables adoption scans; forcing a 30s cadence "
+                    "(shard handoffs depend on adoption)")
+                self.adopt_interval_seconds = 30.0
         # LSTM model-cache warm-start (LSTM_CACHE_PATH): trained AE params
         # persist across restarts so a bounced pod skips the budgeted
         # re-training warm-up for every known app
@@ -246,6 +306,7 @@ class Runtime:
             self.store, exporter=self.exporter, query_endpoint=query_endpoint,
             analyzer=self.analyzer, resilience=self.resilience,
             delta_source=self.delta_source, cache_source=self.cache_source,
+            shard=self.shard,
         )
         self.service.chaos_active = bool(self.chaos_injectors)
         self.wavefront_sink = wavefront_sink
@@ -261,7 +322,7 @@ class Runtime:
 
     # -- lifecycle --
     def start(self, host: str = "0.0.0.0", port: int = 8099,
-              cycle_seconds: float = 10.0, worker: str = "worker-0",
+              cycle_seconds: float = 10.0, worker: str | None = None,
               grpc_port: int | None = None,
               http_max_inflight: int | None = None,
               grpc_workers: int | None = None,
@@ -270,7 +331,16 @@ class Runtime:
         loop (background). grpc_port=0 binds an ephemeral port (see
         grpc_bound_port); None disables the gRPC front. The admission-gate
         knobs default to the service layer's own defaults when None (env
-        parsing lives in main(), like every other runtime knob)."""
+        parsing lives in main(), like every other runtime knob).
+
+        The default worker name is the REPLICA ID when sharding is active:
+        lease stamps must identify WHICH replica holds them or a peer's
+        dead-holder check can never match a killed replica (every pod
+        stamping a shared "worker-0" would alias all replicas together,
+        silently degrading kill -9 recovery from MEMBER_TTL_S latency back
+        to the MAX_STUCK_IN_SECONDS window)."""
+        if worker is None:
+            worker = self.replica_id if self.shard is not None else "worker-0"
         self.cycle_seconds = cycle_seconds
         self.analyzer.health.configure(cycle_seconds=cycle_seconds)
         http_kw = {} if http_max_inflight is None else {
@@ -289,6 +359,18 @@ class Runtime:
             self._grpc_server, self.grpc_bound_port = serve_grpc_background(
                 self.service, host=host, port=grpc_port, **grpc_kw
             )
+        if self.shard is not None:
+            # lease stamps carry the WORKER name; membership heartbeats
+            # advertise it so peers' dead-holder checks can map a holder
+            # back to a live replica (engine/sharding.py dead_holder)
+            self.shard.worker = worker
+            # liveness advertisement gets its OWN thread: if it only rode
+            # the worker loop, one slow cycle (cold compile, adoption
+            # burst) would age the heartbeat past MEMBER_TTL_S and peers
+            # would declare this replica dead and steal its in-flight
+            # leases mid-cycle. heartbeat() itself rate-limits writes.
+            t_hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
+            t_hb.start()
         t_eng = threading.Thread(
             target=self._worker_loop, args=(cycle_seconds, worker), daemon=True
         )
@@ -316,10 +398,43 @@ class Runtime:
         except Exception as e:  # noqa: BLE001 - warmup is best-effort
             log.warning("prewarm failed: %s", e)
 
+    def _heartbeat_loop(self):
+        """Keep the membership heartbeat current independent of cycle
+        duration (see start()). Wakes at half the heartbeat cadence so
+        the advertised age stays well inside MEMBER_TTL_S; the write
+        itself is rate-limited inside ShardManager.heartbeat."""
+        interval = max(min(self.shard.heartbeat_seconds / 2.0, 5.0), 0.25) \
+            if self.shard.heartbeat_seconds > 0 else 0.25
+        while not self._stop.is_set():
+            try:
+                self.shard.heartbeat()
+            except Exception:  # noqa: BLE001 - liveness must keep trying
+                log.exception("membership heartbeat error")
+            self._stop.wait(interval)
+
     def _worker_loop(self, cycle_seconds: float, worker: str):
         while not self._stop.is_set():
             t0 = time.time()
             try:
+                if self.shard is not None:
+                    # membership heartbeat + rebalance; a membership change
+                    # forces an IMMEDIATE adoption scan (the new owner must
+                    # pick up handed-off/dead-peer jobs now, not on the
+                    # leisurely adopt cadence). Own try: a broken shard
+                    # layer must degrade to sole-owner behavior, never
+                    # stop the scoring loop.
+                    try:
+                        tick = self.shard.tick()
+                        if tick.get("membership_changed"):
+                            self._last_adopt = 0.0
+                            log.info(
+                                "shard rebalance: %d replica(s), "
+                                "+%d/-%d shard(s), %d handoff(s)",
+                                len(tick["replicas"]),
+                                tick["gained_shards"], tick["lost_shards"],
+                                tick["handoffs"])
+                    except Exception:  # noqa: BLE001
+                        log.exception("shard tick error")
                 if (self.adopt_interval_seconds > 0
                         and self.store.archive is not None
                         and t0 - self._last_adopt >= self.adopt_interval_seconds):
@@ -328,7 +443,13 @@ class Runtime:
                         worker=worker,
                         max_stuck_seconds=self.config.max_stuck_seconds,
                         skew_margin_seconds=self.adopt_skew_margin_seconds,
+                        owns_fn=(self.shard.owns
+                                 if self.shard is not None else None),
+                        dead_holder_fn=(self.shard.dead_holder
+                                        if self.shard is not None else None),
                     )
+                    if self.shard is not None:
+                        self.shard.mark_adopt_complete(n)
                     if n:
                         log.info("adopted %d stale job(s) from the archive",
                                  n)
@@ -395,6 +516,10 @@ class Runtime:
             self._server.shutdown()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=2.0)
+        if self.shard is not None:
+            # membership half of the handoff: peers rebalance immediately
+            # on the `left` mark instead of waiting out MEMBER_TTL_S
+            self.shard.withdraw()
         if self.store.archive is not None:
             released = self.store.release_leases(worker=self._worker_name)
             if released:
@@ -472,7 +597,7 @@ def main():
 
     install_log_filter()
 
-    from .parallel.distributed import host_info, initialize
+    from .parallel.distributed import host_info, initialize, replica_identity
 
     # multi-host (DCN) deploys join the jax.distributed world here; plain
     # single-host deploys fall straight through
@@ -494,6 +619,13 @@ def main():
         from .engine.archive import FileArchive
 
         archive = FileArchive(archive_path)
+    # replica identity on the shard ring: explicit REPLICA_ID wins; a
+    # multi-process world derives proc-<rank> with launcher-fixed static
+    # membership; otherwise hostname-pid with archive-heartbeat membership
+    replica = knobs.read("REPLICA_ID")
+    static_replicas = None
+    if not replica:
+        replica, static_replicas = replica_identity()
     rt = Runtime(
         snapshot_path=knobs.read("SNAPSHOT_PATH") or None,
         query_endpoint=knobs.read("QUERY_SERVICE_ENDPOINT"),
@@ -502,6 +634,13 @@ def main():
         adopt_interval_seconds=knobs.read("ARCHIVE_ADOPT_INTERVAL"),
         adopt_skew_margin_seconds=knobs.read("ARCHIVE_ADOPT_SKEW_MARGIN"),
         lstm_cache_path=knobs.read("LSTM_CACHE_PATH") or None,
+        replica_id=replica,
+        sharding=knobs.read("SHARDING"),
+        shard_count=knobs.read("SHARD_COUNT"),
+        shard_vnodes=knobs.read("SHARD_VNODES"),
+        heartbeat_seconds=knobs.read("HEARTBEAT_S"),
+        member_ttl_seconds=knobs.read("MEMBER_TTL_S"),
+        static_replicas=static_replicas,
     )
     proxy = knobs.read("WAVEFRONT_PROXY")
     if proxy:

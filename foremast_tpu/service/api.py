@@ -281,7 +281,7 @@ class ForemastService:
 
     def __init__(self, store: JobStore, exporter: VerdictExporter | None = None,
                  query_endpoint: str = "", analyzer=None, resilience=None,
-                 delta_source=None, cache_source=None):
+                 delta_source=None, cache_source=None, shard=None):
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.query_endpoint = query_endpoint  # metric-store base for the proxy
@@ -296,6 +296,9 @@ class ForemastService:
         # single-flight counters) — both surfaced on /metrics and /status
         self.delta_source = delta_source
         self.cache_source = cache_source
+        # optional sharded-brain handle (engine/sharding.py ShardManager):
+        # /status gets a shards section, /metrics the shard gauges
+        self.shard = shard
         self.chaos_active = False  # stamped by the runtime when chaos is on
         # set by make_server: () -> the HTTP admission gate's shed counter
         self.http_shed_count = None
@@ -454,6 +457,42 @@ class ForemastService:
             f"foremast_loss_window_open_seconds "
             f"{round(self.store.loss_window_open_seconds, 4)}"
         )
+        # lease lifecycle: fresh claims, stuck-lease takeover steals,
+        # released handoffs (shutdown + shard rebalance), peer adoptions —
+        # the previously-invisible churn cross-replica failover runs on
+        lines.append(
+            f"foremastbrain:lease_claims_total {self.store.lease_claims_total}"
+        )
+        lines.append(
+            f"foremastbrain:lease_steals_total {self.store.lease_steals_total}"
+        )
+        lines.append(
+            "foremastbrain:lease_releases_total "
+            f"{self.store.lease_releases_total}"
+        )
+        lines.append(
+            f"foremastbrain:lease_adoptions_total {self.store.adopted_total}"
+        )
+        if self.shard is not None:
+            # snapshot() builds a fresh dict (scrape threads never touch
+            # the manager's live state maps)
+            snap = self.shard.snapshot()
+            lines.append(f"foremastbrain:shard_owned_count {snap['owned']}")
+            lines.append(
+                f"foremastbrain:shard_adopting_count {snap['adopting']}")
+            lines.append(
+                f"foremastbrain:shard_draining_count {snap['draining']}")
+            lines.append(
+                f"foremastbrain:shard_replicas_live {len(snap['replicas'])}")
+            lines.append(
+                "foremastbrain:shard_rebalances_total "
+                f"{snap['rebalances_total']}")
+            lines.append(
+                "foremastbrain:shard_handoffs_total "
+                f"{snap['handoffs_total']}")
+            lines.append(
+                "foremastbrain:shard_adoptions_total "
+                f"{snap['adoptions_total']}")
         if self.store.archive is not None:
             lines.append(
                 "foremast_archive_errors "
@@ -596,6 +635,11 @@ class ForemastService:
             # steady-state incremental fetch health: hit ratio, bytes not
             # re-downloaded, and why any full refetches happened
             out["delta_fetch"] = self.delta_source.snapshot()
+        if self.shard is not None:
+            # sharded-brain view: which slice of the fleet this replica
+            # owns, membership health, rebalance/handoff history
+            # (docs/operations.md "Running multiple replicas")
+            out["shards"] = self.shard.snapshot()
         screened = getattr(self.analyzer, "triage_screened_total", None)
         if screened:
             # tier-0 triage health (cumulative; the last cycle's numbers

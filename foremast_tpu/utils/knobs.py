@@ -191,6 +191,24 @@ register("FOREMAST_NATIVE_CXXFLAGS", "", str,
          "extra compile flags for the native extension build",
          scope="build")
 
+# -- sharded multi-replica brain (engine/sharding.py; runtime.py) --
+register("SHARDING", True, parse_bool,
+         "consistent-hash job ownership across replicas sharing an "
+         "archive; a sole replica owns every shard (no behavior change)")
+register("REPLICA_ID", "", str,
+         "stable replica identity on the shard ring (default: "
+         "hostname-pid; multi-process worlds derive proc-<rank>)")
+register("SHARD_COUNT", 64, int,
+         "logical shards over the job-id hash space (ownership/rebalance "
+         "granularity)")
+register("SHARD_VNODES", 64, int,
+         "virtual nodes per replica on the shard ring (assignment balance)")
+register("HEARTBEAT_S", 5.0, float,
+         "replica membership heartbeat interval (archive state writes)")
+register("MEMBER_TTL_S", 15.0, float,
+         "heartbeat age past which a replica is presumed dead and its "
+         "shards rebalance")
+
 # -- multi-host world (parallel/distributed.py) --
 register("COORDINATOR_ADDRESS", "", str,
          "jax.distributed coordinator (multi-host deploys)")

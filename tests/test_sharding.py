@@ -1,0 +1,789 @@
+"""Sharded multi-replica brain: ring, membership, rebalance, adoption gates.
+
+The fast (tier-1) half of the sharding layer's coverage: deterministic
+ring properties, archive-heartbeat membership with TTL/withdraw, ownership
+gating of claim/adopt, the rebalance handoff (released_at mark -> peer
+adoption), the single-adopter compare-and-swap, dead-holder adoption, and
+the /status-/metrics-/health surfaces. The full 3-replica kill -9 chaos
+soak lives in tests/test_shard_soak.py (slow; `make soak-sharded`).
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.archive import FileArchive
+from foremast_tpu.engine.flightrec import (
+    EVENT_TYPES,
+    EVENT_REBALANCE,
+    EVENT_REPLICA_JOIN,
+    EVENT_REPLICA_LEAVE,
+    EVENT_SHARD_ADOPTION,
+    FlightRecorder,
+)
+from foremast_tpu.engine.health import HealthMonitor
+from foremast_tpu.engine.jobs import Document, JobStore, MetricQueries
+from foremast_tpu.engine.sharding import (
+    MEMBER_KEY_PREFIX,
+    SHARD_ADOPTING,
+    SHARD_DRAINING,
+    SHARD_OWNED,
+    HashRing,
+    ShardManager,
+    shard_of,
+)
+from foremast_tpu.service.api import ForemastService
+
+
+def _doc(job_id: str) -> Document:
+    return Document(
+        id=job_id, app_name="a", namespace="d", strategy="canary",
+        start_time="", end_time="",
+        metrics={"error5xx": MetricQueries(current="cu", baseline="bu")},
+    )
+
+
+def _mgr(store, rid, archive=None, **kw):
+    kw.setdefault("shard_count", 16)
+    kw.setdefault("vnodes", 32)
+    kw.setdefault("heartbeat_seconds", 0.0)  # heartbeat every tick
+    kw.setdefault("member_ttl_seconds", 5.0)
+    return ShardManager(store, rid, **kw)
+
+
+# ------------------------------------------------------------------- ring
+def test_ring_deterministic_across_instances_and_order():
+    a = HashRing(["r1", "r2", "r3"], vnodes=16)
+    b = HashRing(["r3", "r1", "r2"], vnodes=16)
+    for i in range(200):
+        assert a.owner(f"shard:{i}") == b.owner(f"shard:{i}")
+    assert a.owner("shard:0") in ("r1", "r2", "r3")
+    assert HashRing([]).owner("x") is None
+
+
+def test_ring_balance_with_vnodes():
+    ring = HashRing([f"r{i}" for i in range(3)], vnodes=64)
+    counts: dict[str, int] = {}
+    for s in range(256):
+        counts[ring.owner(f"shard:{s}")] = counts.get(
+            ring.owner(f"shard:{s}"), 0) + 1
+    # vnodes keep the split far from degenerate: everyone owns a real slice
+    assert all(c >= 256 * 0.15 for c in counts.values()), counts
+
+
+def test_ring_consistent_minimal_movement():
+    """Adding a member must only MOVE shards TO the new member; ownership
+    between the existing members never re-deals (the consistent-hashing
+    property the rebalance's bounded blast radius rests on)."""
+    before = HashRing(["r1", "r2"], vnodes=64)
+    after = HashRing(["r1", "r2", "r3"], vnodes=64)
+    for s in range(256):
+        key = f"shard:{s}"
+        if after.owner(key) != before.owner(key):
+            assert after.owner(key) == "r3", (s, before.owner(key),
+                                              after.owner(key))
+
+
+def test_shard_of_stable_and_bounded():
+    assert shard_of("job-1", 16) == shard_of("job-1", 16)
+    assert 0 <= shard_of("anything", 7) < 7
+    # distinct ids spread (not a constant function)
+    assert len({shard_of(f"job-{i}", 64) for i in range(200)}) > 30
+
+
+# ------------------------------------------------------------- membership
+def test_membership_heartbeat_join_ttl_expiry_and_withdraw(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    A = ShardManager(JobStore(archive=ar), "A", shard_count=16,
+                     heartbeat_seconds=0.0, member_ttl_seconds=5.0)
+    B = ShardManager(JobStore(archive=ar), "B", shard_count=16,
+                     heartbeat_seconds=0.0, member_ttl_seconds=5.0)
+    t0 = 1000.0
+    A.tick(now=t0)
+    assert A.tick(now=t0 + 0.1)["replicas"] == ["A"]
+    # B heartbeats -> both see a two-member ring
+    assert B.tick(now=t0 + 0.2)["replicas"] == ["A", "B"]
+    t = A.tick(now=t0 + 0.3)
+    assert t["membership_changed"] and t["replicas"] == ["A", "B"]
+    assert A.rebalances_total == 1
+    # B goes silent: TTL expiry drops it (A keeps heartbeating)
+    t = A.tick(now=t0 + 10.0)
+    assert t["membership_changed"] and t["replicas"] == ["A"]
+    # B comes back, then WITHDRAWS: the left mark removes it immediately,
+    # no TTL wait
+    B.tick(now=t0 + 11.0)
+    assert A.tick(now=t0 + 11.1)["replicas"] == ["A", "B"]
+    B.withdraw(now=t0 + 11.2)
+    t = A.tick(now=t0 + 11.3)
+    assert t["membership_changed"] and t["replicas"] == ["A"]
+    # member records live under the state prefix, not the documents index
+    assert ar.search(status=list(J.OPEN_STATUSES)) == []
+    assert set(ar.list_state(MEMBER_KEY_PREFIX)) == {
+        MEMBER_KEY_PREFIX + "A", MEMBER_KEY_PREFIX + "B"}
+
+
+def test_failed_membership_read_keeps_previous_view(tmp_path):
+    """An archive outage must NOT collapse the ring to 'just me' (that
+    would mass-claim the whole fleet); the stale view holds and dead-
+    holder adoption is suspended until a read succeeds."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    A = _mgr(JobStore(archive=ar), "A", member_ttl_seconds=50.0)
+    B = _mgr(JobStore(archive=ar), "B", member_ttl_seconds=50.0)
+    t0 = 1000.0
+    B.tick(now=t0)
+    A.tick(now=t0 + 0.1)
+    assert A.tick(now=t0 + 0.2)["replicas"] == ["A", "B"]
+    # a holder NEVER seen in any membership view is not evidence of death
+    # (a non-sharded peer sharing the archive must keep its leases until
+    # the normal stuck window) — only a watched disappearance convicts
+    assert A.dead_holder("ghost") is False
+    assert A.dead_holder("B") is False  # B is alive
+    # B goes silent past the TTL: A positively watched it disappear
+    assert A.tick(now=t0 + 60.0)["replicas"] == ["A"]
+    assert A.dead_holder("B") is True
+    real = ar.list_state
+    ar.list_state = lambda prefix="": None  # outage sentinel
+    t = A.tick(now=t0 + 61.0)
+    assert t["replicas"] == ["A"] and not t["membership_changed"]
+    assert A.membership_read_failures == 1
+    assert A.dead_holder("B") is False  # suspended while stale
+    ar.list_state = real
+    assert A.tick(now=t0 + 62.0)["replicas"] == ["A"]
+    assert A.dead_holder("B") is True
+
+
+def test_static_members_skip_archive_traffic(tmp_path):
+    """Multi-process worlds: membership is launcher-fixed; no heartbeats
+    hit the archive and the ring is stable from construction."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    m = ShardManager(JobStore(archive=ar), "proc-0", shard_count=16,
+                     static_members=["proc-0", "proc-1"])
+    t = m.tick(now=1000.0)
+    assert t["replicas"] == ["proc-0", "proc-1"]
+    assert not t["membership_changed"]
+    assert ar.list_state(MEMBER_KEY_PREFIX) == {}  # nothing written
+    counts = m.state_counts()
+    assert 0 < counts[SHARD_OWNED] < 16
+
+
+def test_replica_identity_from_process_world(monkeypatch):
+    from foremast_tpu.parallel.distributed import replica_identity
+
+    rid, members = replica_identity({"NUM_PROCESSES": "3",
+                                     "PROCESS_ID": "1"})
+    assert rid == "proc-1" and members == ["proc-0", "proc-1", "proc-2"]
+    assert replica_identity({}) == ("", None)
+
+
+# --------------------------------------------------- ownership + handoff
+def test_claim_gated_by_ownership_partitions_the_fleet(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    SA, SB = JobStore(archive=ar), JobStore(archive=ar)
+    A = _mgr(SA, "A", static_members=["A", "B"])
+    B = _mgr(SB, "B", static_members=["A", "B"])
+    ids = [f"job-{i}" for i in range(40)]
+    for store in (SA, SB):
+        for jid in ids:
+            store.create(_doc(jid))
+    got_a = {d.id for d in SA.claim_open_jobs("A", owns_fn=A.owns)}
+    got_b = {d.id for d in SB.claim_open_jobs("B", owns_fn=B.owns)}
+    assert got_a and got_b
+    assert got_a.isdisjoint(got_b)
+    assert got_a | got_b == set(ids)
+    # every job has exactly one owner, agreed on by both ring views
+    for jid in ids:
+        assert A.owner_of(jid) == B.owner_of(jid)
+        assert A.owns(jid) != B.owns(jid)
+
+
+def test_rebalance_hands_off_and_peer_adopts_membership_churn(tmp_path):
+    """The membership-churn acceptance shape: B joins (A releases B's
+    shards, B adopts them), then B leaves gracefully (A adopts back)."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    SA = JobStore(archive=ar)
+    A = _mgr(SA, "A")
+    t0 = 1000.0
+    A.tick(now=t0)
+    ids = [f"job-{i}" for i in range(30)]
+    for jid in ids:
+        SA.create(_doc(jid))
+    assert len(SA.claim_open_jobs("A", owns_fn=A.owns)) == 30  # sole owner
+    SA.flush()
+
+    # --- B joins ---
+    SB = JobStore(archive=ar)
+    B = _mgr(SB, "B")
+    B.tick(now=t0 + 1.0)
+    t = A.tick(now=t0 + 1.1)  # A sees B, rebalances, releases B's shards
+    assert t["membership_changed"]
+    b_ids = {jid for jid in ids if B.owns(jid)}
+    assert t["handoffs"] == len(b_ids) > 0
+    assert A.handoffs_total == len(b_ids)
+    SA.flush()  # handoff stamps reach the archive
+    n = SB.adopt_stale_from_archive(worker="B", owns_fn=B.owns,
+                                    dead_holder_fn=B.dead_holder)
+    B.mark_adopt_complete(n)
+    assert n == len(b_ids)
+    assert {d.id for d in SB.claim_open_jobs("B", owns_fn=B.owns)} == b_ids
+    # A's handed-off local copies prune once the archive confirmed them
+    A.tick(now=t0 + 1.2)
+    assert {d.id for d in SA.by_status(*J.OPEN_STATUSES)} == set(ids) - b_ids
+
+    # --- B leaves gracefully ---
+    SB.release_leases(worker="B")
+    SB.flush()
+    B.withdraw(now=t0 + 2.0)
+    t = A.tick(now=t0 + 2.1)
+    assert t["membership_changed"] and t["replicas"] == ["A"]
+    n = SA.adopt_stale_from_archive(worker="A", owns_fn=A.owns,
+                                    dead_holder_fn=A.dead_holder)
+    A.mark_adopt_complete(n)
+    assert n == len(b_ids)  # everything came home
+    assert len(SA.claim_open_jobs("A", owns_fn=A.owns,
+                                  max_stuck_seconds=1e-9)) == 30
+
+
+def test_gained_shards_adopt_then_own_lost_shards_drain_then_remote(
+        tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    SA = JobStore(archive=ar)
+    A = _mgr(SA, "A")
+    t0 = 1000.0
+    A.tick(now=t0)
+    for i in range(30):
+        SA.create(_doc(f"job-{i}"))
+    SA.claim_open_jobs("A", owns_fn=A.owns)
+    SA.flush()
+    B = _mgr(JobStore(archive=ar), "B")
+    B.tick(now=t0 + 1.0)
+    # B gained shards from a live peer: they sit ADOPTING until a scan ran
+    assert B.state_counts()[SHARD_ADOPTING] > 0
+    B.mark_adopt_complete(0)
+    assert B.state_counts()[SHARD_ADOPTING] == 0
+    assert B.state_counts()[SHARD_OWNED] > 0
+    # A: lost shards holding local open jobs DRAIN, then settle REMOTE
+    # once the handoff mirrored and pruned
+    t = A.tick(now=t0 + 1.1)
+    assert t["handoffs"] > 0
+    SA.flush()
+    A.tick(now=t0 + 1.2)  # prune pass: archive confirmed the handoffs
+    assert A.state_counts()[SHARD_DRAINING] == 0
+
+
+# ------------------------------------------------- single-adopter guard
+class _FrozenSearch:
+    """Archive proxy serving a PRE-RACE search snapshot: both adopters
+    decide on the same version (the true concurrent-race interleaving,
+    which a sequential test cannot produce — the second adopter would see
+    the first's claim record); the CAS against the real file then lets
+    exactly one win."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._frozen = inner.search(status=list(J.OPEN_STATUSES),
+                                    limit=100, oldest_first=True)
+
+    def search(self, **kw):
+        return [dict(r) for r in self._frozen]
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_single_adopter_cas_two_stores_one_archive(tmp_path):
+    """Satellite: two replicas racing to adopt the same released/stale
+    record must not BOTH pull it into their local stores — the archive-
+    level compare-and-swap lets exactly one win."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    a = JobStore(archive=ar)
+    a.create(_doc("j1"))
+    a.claim_open_jobs("w-dead", max_stuck_seconds=90)
+    a.flush()
+
+    later = time.time() + 1000
+    b, c = JobStore(archive=ar), JobStore(archive=ar)
+    # both replicas' scans read the SAME stale version, then race the CAS
+    b.archive = _FrozenSearch(ar)
+    c.archive = _FrozenSearch(ar)
+    won = (b.adopt_stale_from_archive(worker="B", max_stuck_seconds=90,
+                                      now=later)
+           + c.adopt_stale_from_archive(worker="C", max_stuck_seconds=90,
+                                        now=later))
+    assert won == 1, "exactly one replica may adopt the record"
+    assert (b.get("j1") is None) != (c.get("j1") is None)
+    winner = b if b.get("j1") is not None else c
+    # the claim record in the archive carries the winner's identity and a
+    # fresh modified_at, so later scans see a live owner
+    rec = ar.get("j1")
+    assert rec["lease_holder"] == ("B" if winner is b else "C")
+    # the winner completes the job normally
+    assert [d.id for d in winner.claim_open_jobs(
+        "w2", max_stuck_seconds=1e-9)] == ["j1"]
+    winner.transition("j1", J.PREPROCESS_COMPLETED, worker="w2")
+    winner.transition("j1", J.POSTPROCESS_INPROGRESS, worker="w2")
+    winner.transition("j1", J.COMPLETED_HEALTH, worker="w2")
+    assert ar.get("j1")["status"] == J.COMPLETED_HEALTH
+
+
+def test_claim_job_cas_semantics(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    ar.index_job({"id": "x", "status": J.INITIAL, "modified_at": 10.0})
+    # stale expectation: a newer record exists
+    ar.index_job({"id": "x", "status": J.INITIAL, "modified_at": 20.0})
+    assert not ar.claim_job("x", 10.0, {"id": "x", "status": J.INITIAL,
+                                        "modified_at": 30.0})
+    # matching expectation wins and lands the claim record
+    assert ar.claim_job("x", 20.0, {"id": "x", "status": J.INITIAL,
+                                    "modified_at": 30.0,
+                                    "lease_holder": "B"})
+    assert ar.get("x")["modified_at"] == 30.0
+    # absent records are not claimable
+    assert not ar.claim_job("nope", 0.0, {"id": "nope",
+                                          "modified_at": 1.0})
+
+
+def test_archive_without_cas_stays_optimistic(tmp_path):
+    """Archives lacking claim_job keep the reference's optimistic takeover
+    (both adopt; last-write-wins verdicts make it harmless)."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    a = JobStore(archive=ar)
+    a.create(_doc("j1"))
+    a.claim_open_jobs("w-dead", max_stuck_seconds=90)
+    a.flush()
+    later = time.time() + 1000
+    b, c = JobStore(archive=ar), JobStore(archive=ar)
+    # hide the CAS surface from both adopters
+    b.archive = _NoCas(ar)
+    c.archive = _NoCas(ar)
+    assert b.adopt_stale_from_archive(worker="B", max_stuck_seconds=90,
+                                      now=later) == 1
+    assert c.adopt_stale_from_archive(worker="C", max_stuck_seconds=90,
+                                      now=later) == 1
+
+
+class _NoCas:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        if name == "claim_job":
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
+# ----------------------------------------------------- dead-holder gate
+def test_dead_holder_adopted_before_stuck_window(tmp_path):
+    """kill -9 recovery at membership-TTL latency: the dead peer's lease
+    is FRESH (far inside MAX_STUCK_IN_SECONDS) but membership says the
+    holder is gone, so the survivor adopts immediately."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    SB = JobStore(archive=ar)
+    B = _mgr(SB, "B", member_ttl_seconds=2.0)
+    t0 = 1000.0
+    B.tick(now=t0)
+    SB.create(_doc("victim"))
+    SB.claim_open_jobs("B", owns_fn=B.owns)
+    SB.flush()
+    # A arrives; B is killed (stops heartbeating) right after
+    SA = JobStore(archive=ar)
+    A = _mgr(SA, "A", member_ttl_seconds=2.0)
+    A.tick(now=t0 + 0.5)
+    assert A.tick(now=t0 + 0.6)["replicas"] == ["A", "B"]
+    # before the TTL: the holder is live, lease fresh -> nothing adoptable
+    assert SA.adopt_stale_from_archive(
+        worker="A", owns_fn=A.owns, dead_holder_fn=A.dead_holder,
+        now=time.time()) == 0
+    # after the TTL: membership drops B; its fresh lease is adoptable NOW
+    t = A.tick(now=t0 + 5.0)
+    assert t["membership_changed"] and t["replicas"] == ["A"]
+    assert A.dead_holder("B") is True
+    n = SA.adopt_stale_from_archive(
+        worker="A", owns_fn=A.owns, dead_holder_fn=A.dead_holder,
+        now=time.time())
+    assert n == 1
+    assert SA.get("victim") is not None
+
+
+# ------------------------------------------------------------- surfaces
+def test_flight_events_registered_and_fired(tmp_path):
+    for ev in (EVENT_REPLICA_JOIN, EVENT_REPLICA_LEAVE, EVENT_REBALANCE,
+               EVENT_SHARD_ADOPTION):
+        assert ev in EVENT_TYPES
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    flight = FlightRecorder(dump_dir=str(tmp_path))
+    SA = JobStore(archive=ar)
+    A = _mgr(SA, "A", flight=flight)
+    t0 = 1000.0
+    A.tick(now=t0)
+    B = _mgr(JobStore(archive=ar), "B")
+    B.tick(now=t0 + 1.0)
+    A.tick(now=t0 + 1.1)  # join + rebalance
+    A.mark_adopt_complete(3)
+    A.tick(now=t0 + 10.0)  # TTL expiry: leave + rebalance
+    types = [e["type"] for e in flight.snapshot()]
+    assert EVENT_REPLICA_JOIN in types
+    assert EVENT_REPLICA_LEAVE in types
+    assert types.count(EVENT_REBALANCE) >= 2
+    assert EVENT_SHARD_ADOPTION in types
+    join = next(e for e in flight.snapshot()
+                if e["type"] == EVENT_REPLICA_JOIN)
+    assert join["detail"]["replica"] == "B"
+
+
+def test_health_detail_and_service_surfaces(tmp_path):
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    store = JobStore(archive=ar)
+    mgr = _mgr(store, "A")
+    mgr.tick(now=1000.0)
+    h = HealthMonitor(cycle_seconds=10.0)
+    h.configure(shards_fn=mgr.health_summary)
+    h.begin_cycle()
+    h.end_cycle()
+    state, detail = h.state()
+    assert state == "ok"
+    assert detail["shards"]["replica"] == "A"
+    assert detail["shards"]["owned"] == 16
+    # a RAISING shards_fn never breaks the probe
+    h.configure(shards_fn=lambda: 1 / 0)
+    state, detail = h.state()
+    assert state == "ok" and "shards" not in detail
+
+    svc = ForemastService(store, shard=mgr)
+    _, payload = svc.status_summary()
+    assert payload["shards"]["replica"] == "A"
+    assert payload["shards"]["owned"] == 16
+    assert payload["shards"]["membership"] == "archive"
+    _, text = svc.metrics()
+    assert "foremastbrain:shard_owned_count 16" in text
+    assert "foremastbrain:shard_replicas_live 1" in text
+    assert "foremastbrain:lease_claims_total 0" in text
+
+
+def test_cli_shards_renders_status_section(monkeypatch, capsys):
+    import io
+    import json as _json
+    import urllib.request
+
+    from foremast_tpu.cli import main as cli_main
+
+    payload = {"shards": {
+        "replica": "A", "worker": "w", "membership": "archive",
+        "membership_fresh": True, "replicas": ["A", "B"],
+        "shard_count": 16, "owned": 9, "adopting": 0, "draining": 1,
+        "remote": 6, "rebalances_total": 2, "handoffs_total": 4,
+        "adoptions_total": 3}}
+
+    def fake_urlopen(url, timeout=10):
+        assert url.endswith("/status")
+        return io.BytesIO(_json.dumps(payload).encode())
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    assert cli_main(["shards"]) == 0
+    out = capsys.readouterr().out
+    assert "replica A" in out and "9/16 owned" in out
+    assert cli_main(["shards", "--json"]) == 0
+    assert _json.loads(capsys.readouterr().out)["owned"] == 9
+
+
+def test_release_unowned_idempotent_and_scoped(tmp_path):
+    """Release only stamps each handed-off doc ONCE (no modified_at churn
+    re-dirtying the mirror every tick) and never touches owned or
+    terminal docs."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    store = JobStore(archive=ar)
+    for i in range(10):
+        store.create(_doc(f"job-{i}"))
+    store.claim_open_jobs("w")
+    owned = {f"job-{i}" for i in range(5)}
+    released = store.release_unowned(lambda jid: jid in owned, worker="A")
+    assert set(released) == {f"job-{i}" for i in range(5, 10)}
+    assert store.lease_releases_total == 5
+    stamps = {jid: store.get(jid).modified_at for jid in released}
+    assert store.release_unowned(lambda jid: jid in owned, worker="A") == []
+    assert all(store.get(j).modified_at == s for j, s in stamps.items())
+    for jid in owned:
+        assert store.get(jid).status == J.PREPROCESS_INPROGRESS
+        assert store.get(jid).released_at == 0.0
+
+
+# ------------------------------------------------- review-fix regressions
+def test_membership_read_rides_heartbeat_cadence(tmp_path):
+    """Between heartbeats a fresh membership view is reused — tick() must
+    not pay an archive list_state scan per worker-loop lap; a FAILED read
+    retries on every tick until one succeeds."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    calls = {"n": 0}
+    real = ar.list_state
+
+    def counting(prefix=""):
+        calls["n"] += 1
+        return real(prefix)
+
+    ar.list_state = counting
+    A = _mgr(JobStore(archive=ar), "A", heartbeat_seconds=10.0)
+    t0 = 1000.0
+    A.tick(now=t0)
+    assert calls["n"] == 1
+    for i in range(5):  # inside the heartbeat window: cached view, no I/O
+        A.tick(now=t0 + 1.0 + i)
+    assert calls["n"] == 1
+    A.tick(now=t0 + 10.5)  # heartbeat due again: one read rides it
+    assert calls["n"] == 2
+    ar.list_state = lambda prefix="": None  # outage
+    A.tick(now=t0 + 21.0)
+    assert not A._membership_fresh
+    ar.list_state = counting
+    A.tick(now=t0 + 21.5)  # NOT heartbeat-due, but stale: retry anyway
+    assert calls["n"] == 3 and A._membership_fresh
+
+
+def test_compaction_ages_out_dead_member_blobs(tmp_path):
+    """shard-member heartbeat blobs from long-gone replica incarnations
+    (hostname-pid mints a new key per restart) age out at compaction;
+    live members and ordinary state keys survive."""
+    from foremast_tpu.engine import archive as AR
+
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    now = time.time()
+    old = now - AR.KEEP_MEMBER_SECONDS - 10.0
+    ar.index_state(MEMBER_KEY_PREFIX + "dead-1", {"replica": "dead-1"}, old)
+    ar.index_state(MEMBER_KEY_PREFIX + "live", {"replica": "live"}, now)
+    ar.index_state("rollback-timer:x", {"armed": True}, old)
+    ar._compact_locked()
+    keys = set(ar.list_state())
+    assert MEMBER_KEY_PREFIX + "dead-1" not in keys
+    assert MEMBER_KEY_PREFIX + "live" in keys
+    assert "rollback-timer:x" in keys  # non-member state never ages here
+
+
+def test_es_claim_job_5xx_counts_errors_404_does_not():
+    """An ES outage during the CAS pre-read must surface on the errors
+    counter (the operator signal for 'adoption failing'), while a plain
+    404 is just 'nothing to claim'."""
+    import urllib.error
+
+    from foremast_tpu.engine.archive import EsArchive
+
+    ar = EsArchive("http://127.0.0.1:9")
+
+    def raising(code):
+        def _req(method, path, body=None):
+            raise urllib.error.HTTPError("u", code, "err", {}, None)
+        return _req
+
+    ar._req = raising(404)
+    assert ar.claim_job("j", 1.0, {"id": "j"}) is False
+    assert ar.errors == 0
+    ar._req = raising(503)
+    assert ar.claim_job("j", 1.0, {"id": "j"}) is False
+    assert ar.errors == 1
+
+
+def test_runtime_default_worker_is_replica_id(tmp_path):
+    """CLI-launched replicas never pass a worker name: the default must be
+    the REPLICA ID when sharding is active, or every pod would stamp
+    leases as a shared 'worker-0' and peers' dead_holder() could never
+    match a killed replica (kill -9 recovery would silently degrade to
+    the MAX_STUCK_IN_SECONDS window)."""
+    from foremast_tpu.dataplane import FixtureDataSource
+    from foremast_tpu.runtime import Runtime
+
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    rt = Runtime(data_source=FixtureDataSource({}), cache=False, archive=ar,
+                 replica_id="pod-7")
+    try:
+        rt.start(host="127.0.0.1", port=0, cycle_seconds=3600.0)
+        assert rt._worker_name == "pod-7"
+        assert rt.shard.worker == "pod-7"
+    finally:
+        rt.stop()
+    # unsharded runtimes keep the historical default
+    rt2 = Runtime(data_source=FixtureDataSource({}), cache=False)
+    try:
+        rt2.start(host="127.0.0.1", port=0, cycle_seconds=3600.0)
+        assert rt2.shard is None and rt2._worker_name == "worker-0"
+    finally:
+        rt2.stop()
+
+
+def test_file_list_state_memoized_between_mutations(tmp_path):
+    """Between archive mutations list_state serves a cached view (the
+    membership read costs stat(2)s, not a two-generation parse); any
+    append invalidates it."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    ar.index_state(MEMBER_KEY_PREFIX + "A", {"replica": "A"}, 1000.0)
+    scans = {"n": 0}
+    real = ar._iter_records
+
+    def counting():
+        scans["n"] += 1
+        return real()
+
+    ar._iter_records = counting
+    first = ar.list_state(MEMBER_KEY_PREFIX)
+    assert set(first) == {MEMBER_KEY_PREFIX + "A"} and scans["n"] == 1
+    for _ in range(5):
+        assert ar.list_state(MEMBER_KEY_PREFIX) == first
+    assert ar.list_state() == first  # prefix filter shares the one view
+    assert scans["n"] == 1
+    ar.index_state(MEMBER_KEY_PREFIX + "B", {"replica": "B"}, 1001.0)
+    assert set(ar.list_state(MEMBER_KEY_PREFIX)) == {
+        MEMBER_KEY_PREFIX + "A", MEMBER_KEY_PREFIX + "B"}
+    assert scans["n"] == 2
+
+
+def test_es_delete_state_and_membership_prunes_dead_blobs():
+    """EsArchive has no compaction pass: the membership reader prunes
+    long-dead member incarnations through delete_state (left or silent
+    past KEEP_MEMBER_SECONDS), bounded per refresh; TTL-expired-but-
+    recent members are only FILTERED, never deleted."""
+    import urllib.error
+
+    from foremast_tpu.engine import archive as AR
+    from foremast_tpu.engine.archive import EsArchive
+
+    es = EsArchive("http://127.0.0.1:9")
+    es._req = lambda m, p, body=None: (_ for _ in ()).throw(
+        urllib.error.HTTPError("u", 404, "gone", {}, None))
+    assert es.delete_state("k") is True and es.errors == 0
+    es._req = lambda m, p, body=None: (_ for _ in ()).throw(
+        urllib.error.HTTPError("u", 503, "down", {}, None))
+    assert es.delete_state("k") is False and es.errors == 1
+
+    class StubArchive:
+        def __init__(self):
+            now = time.time()
+            self.deleted = []
+            self.state = {
+                MEMBER_KEY_PREFIX + "ancient":
+                    ({"replica": "ancient"}, now - AR.KEEP_MEMBER_SECONDS - 9),
+                MEMBER_KEY_PREFIX + "recent-dead":
+                    ({"replica": "recent-dead"}, now - 60.0),
+                MEMBER_KEY_PREFIX + "live": ({"replica": "live"}, now),
+            }
+
+        def index_state(self, key, value, updated_at):
+            return True
+
+        def list_state(self, prefix=""):
+            return dict(self.state)
+
+        def delete_state(self, key):
+            self.deleted.append(key)
+            return True
+
+    ar = StubArchive()
+    store = JobStore()
+    store.archive = ar
+    m = _mgr(store, "A", member_ttl_seconds=5.0)
+    assert m.tick()["replicas"] == ["A", "live"]
+    assert ar.deleted == [MEMBER_KEY_PREFIX + "ancient"]
+
+
+def test_runtime_floors_adopt_interval_when_sharded(tmp_path):
+    """ARCHIVE_ADOPT_INTERVAL=0 ('disable scans') must not silently break
+    the rebalance handoff: a released job in a peer's shard is only ever
+    picked up by the adoption scan, so sharding forces a floor cadence.
+    Unsharded runtimes keep the documented disable."""
+    from foremast_tpu.dataplane import FixtureDataSource
+    from foremast_tpu.runtime import Runtime
+
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    rt = Runtime(data_source=FixtureDataSource({}), cache=False, archive=ar,
+                 adopt_interval_seconds=0.0)
+    assert rt.shard is not None and rt.adopt_interval_seconds > 0
+    rt2 = Runtime(data_source=FixtureDataSource({}), cache=False, archive=ar,
+                  adopt_interval_seconds=0.0, sharding=False)
+    assert rt2.shard is None and rt2.adopt_interval_seconds == 0.0
+
+
+def test_heartbeat_rate_limited_thread_safe_and_retries_on_failure(tmp_path):
+    """heartbeat() writes at most one member blob per heartbeat window
+    (the runtime's dedicated liveness thread and the worker tick both
+    call it), and a FAILED write releases the slot so the next call
+    retries instead of going silent for a full window."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    writes = {"n": 0}
+    real = ar.index_state
+
+    def counting(key, value, updated_at):
+        writes["n"] += 1
+        return real(key, value, updated_at)
+
+    ar.index_state = counting
+    store = JobStore(archive=ar)
+    m = ShardManager(store, "A", shard_count=16, heartbeat_seconds=10.0,
+                     member_ttl_seconds=30.0)
+    t0 = 1000.0
+    m.heartbeat(now=t0)
+    for i in range(5):
+        m.heartbeat(now=t0 + 1.0 + i)  # inside the window: rate-limited
+    assert writes["n"] == 1
+    m.heartbeat(now=t0 + 10.5)
+    assert writes["n"] == 2
+    ar.index_state = lambda *a: False  # write failure
+    m.heartbeat(now=t0 + 21.0)
+    ar.index_state = counting
+    m.heartbeat(now=t0 + 21.1)  # slot released by the failure: retry NOW
+    assert writes["n"] == 3
+
+
+def test_runtime_static_world_without_archive_disables_sharding(tmp_path):
+    """A launcher-fixed multi-process world WITHOUT a shared archive must
+    not shard: release_unowned would rewind a peer's jobs into a limbo no
+    adoption scan can reach (there is no shared store), silently dropping
+    ~(N-1)/N of submissions. With an archive the static world shards."""
+    from foremast_tpu.dataplane import FixtureDataSource
+    from foremast_tpu.runtime import Runtime
+
+    rt = Runtime(data_source=FixtureDataSource({}), cache=False,
+                 replica_id="proc-0",
+                 static_replicas=["proc-0", "proc-1"])
+    assert rt.shard is None
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    rt2 = Runtime(data_source=FixtureDataSource({}), cache=False, archive=ar,
+                  replica_id="proc-0",
+                  static_replicas=["proc-0", "proc-1"])
+    assert rt2.shard is not None
+    assert rt2.shard.static_members == ("proc-0", "proc-1")
+
+
+def test_adopting_not_graduated_while_membership_stale(tmp_path):
+    """A silently-failed adoption scan (breaker-open archive: search->[])
+    must not flip adopting shards to owned — membership rides the same
+    archive, so a stale view withholds graduation until a scan against a
+    healthy archive lands (keeping the /status runbook signal honest)."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    A = _mgr(JobStore(archive=ar), "A")
+    B = _mgr(JobStore(archive=ar), "B")
+    B.tick(now=1000.0)
+    A.tick(now=1000.1)
+    A.tick(now=1000.2)  # sees B: rebalance, gained shards -> adopting
+    assert A.state_counts()[SHARD_ADOPTING] > 0
+    ar.list_state = lambda prefix="": None  # outage
+    A.tick(now=1001.0)
+    assert not A._membership_fresh
+    A.mark_adopt_complete(0)  # the scan "ran" (blanked by the outage)
+    assert A.state_counts()[SHARD_ADOPTING] > 0  # NOT graduated
+    A.mark_adopt_complete(3)  # a scan that ADOPTED evidently reached it
+    assert A.state_counts()[SHARD_ADOPTING] == 0
+
+
+def test_file_claim_job_triggers_compaction(tmp_path):
+    """claim_job shares _append's size-triggered compaction: a mass-
+    adoption burst must not grow the archive unboundedly."""
+    ar = FileArchive(str(tmp_path / "ar.jsonl"), max_bytes=2000)
+    store = JobStore(archive=ar)
+    for i in range(8):
+        store.create(_doc(f"job-{i}"))
+    store.flush()
+    rec = ar.get("job-0")
+    for _ in range(30):  # repeated claims of the same version: losers
+        ar.claim_job("job-0", rec["modified_at"] + 99, rec)
+    before = ar.compactions
+    big = dict(rec)
+    big["reason"] = "x" * 3000  # push past max_bytes through claim_job
+    ar.claim_job("job-0", rec["modified_at"], big)
+    assert ar.compactions > before

@@ -326,10 +326,15 @@ def test_two_process_archive_writers_lose_nothing(tmp_path):
 
 
 def test_concurrent_adoption_is_optimistic_and_converges(tmp_path):
-    """Two live runtimes may BOTH adopt the same stale job (the
-    reference's ES takeover has the same property) — that must be safe:
-    both can claim and complete it, verdict writes are last-write-wins,
-    and the archive converges to one terminal record."""
+    """SEQUENTIAL adopters may both take a job whose claim went stale
+    again (the reference's ES takeover has the same property) — that must
+    be safe: both can claim and complete it, verdict writes are
+    last-write-wins, and the archive converges to one terminal record.
+    (A SIMULTANEOUS race — both scans reading the same version — is
+    resolved to a single winner by the claim_job CAS instead:
+    tests/test_sharding.py::test_single_adopter_cas_two_stores_one_archive.)
+    Here C's adoption is legitimate: B's claim record itself aged past
+    the stuck window on C's clock."""
     ar = FileArchive(str(tmp_path / "ar.jsonl"))
     a = JobStore(archive=ar)
     a.create(_doc())
@@ -351,6 +356,42 @@ def test_concurrent_adoption_is_optimistic_and_converges(tmp_path):
     # the archive holds exactly one terminal record for the job
     assert ar.get("j1")["status"] == J.COMPLETED_HEALTH
     assert ar.search(status=list(J.OPEN_STATUSES)) == []
+
+
+# ------------------------------------------------ lease lifecycle counters
+def test_lease_lifecycle_counters_exported_end_to_end(tmp_path):
+    """foremastbrain:lease_{claims,steals,releases,adoptions}_total cover
+    the full lease lifecycle across two stores over one shared archive,
+    and every leg lands on /metrics — the churn cross-replica failover
+    runs on was previously invisible."""
+    from foremast_tpu.service.api import ForemastService
+
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    a = JobStore(archive=ar)
+    a.create(_doc("j1"))
+    a.create(_doc("j2"))
+    assert len(a.claim_open_jobs("w1", max_stuck_seconds=90)) == 2
+    assert a.lease_claims_total == 2 and a.lease_steals_total == 0
+    # a stuck lease is STOLEN, not freshly claimed
+    time.sleep(0.01)
+    assert len(a.claim_open_jobs("w1b", max_stuck_seconds=1e-9)) == 2
+    assert a.lease_claims_total == 2 and a.lease_steals_total == 2
+    a.flush()
+    # graceful shutdown releases both
+    assert a.release_leases(worker="w1b") == 2
+    assert a.lease_releases_total == 2
+    a.flush()
+
+    b = JobStore(archive=ar)
+    assert b.adopt_stale_from_archive(worker="w2", max_stuck_seconds=90) == 2
+    assert b.adopted_total == 2
+    assert len(b.claim_open_jobs("w2", max_stuck_seconds=90)) == 2
+    _, text = ForemastService(b).metrics()
+    assert "foremastbrain:lease_claims_total 2" in text
+    assert "foremastbrain:lease_adoptions_total 2" in text
+    _, text_a = ForemastService(a).metrics()
+    assert "foremastbrain:lease_steals_total 2" in text_a
+    assert "foremastbrain:lease_releases_total 2" in text_a
 
 
 # ------------------------------------------- ADVICE r04: mirror resilience
