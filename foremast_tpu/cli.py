@@ -1,4 +1,4 @@
-"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | health | shards | explain | prewarm | demo.
+"""`foremast-tpu` CLI: serve | operator | trigger | watch | unwatch | status | health | shards | top | explain | prewarm | demo.
 
 One entrypoint covers the reference's process zoo and kubectl plugins:
 
@@ -237,19 +237,32 @@ def cmd_health(args) -> int:
     return 0 if status == 200 else 1
 
 
+def _resolve_base(endpoint: str) -> str:
+    """Runtime base URL from --endpoint / ANALYST_ENDPOINT (analyst
+    endpoints often carry the /v1/healthcheck/ suffix; the observability
+    surfaces live at the server root)."""
+    endpoint = (endpoint or knobs.read("ANALYST_ENDPOINT")
+                or "http://localhost:8099")
+    return endpoint.split("/v1/")[0].rstrip("/")
+
+
+def _get_json(base: str, path: str):
+    """One GET, decoded — shared by the read-only CLI verbs (shards /
+    explain / top) so timeout/decoding policy cannot drift per verb."""
+    import urllib.request
+
+    with urllib.request.urlopen(f"{base}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
 def cmd_shards(args) -> int:
     """Print the runtime's shard-ring view (/status `shards` section):
     replica identity, live membership, owned/adopting/draining counts,
     and rebalance/handoff history — the "which slice of the fleet is this
     replica responsible for" question, scriptable."""
-    import urllib.request
-
-    endpoint = (args.endpoint or knobs.read("ANALYST_ENDPOINT")
-                or "http://localhost:8099")
-    base = endpoint.split("/v1/")[0].rstrip("/")
+    base = _resolve_base(args.endpoint)
     try:
-        with urllib.request.urlopen(f"{base}/status", timeout=10) as r:
-            payload = json.loads(r.read().decode())
+        payload = _get_json(base, "/status")
     except Exception as e:  # noqa: BLE001 - CLI boundary: diagnose, don't trace
         print(f"cannot reach {base}: {e}", file=sys.stderr)
         return 1
@@ -309,6 +322,19 @@ def _render_explain(payload: dict) -> str:
                  + (f" ({cyc.get('jobs')} jobs, "
                     f"{cyc.get('device_launches')} device launches)"
                     if cyc.get("jobs") is not None else ""))
+    if rec.get("detection_latency_s") is not None:
+        lines.append(
+            f"  detection latency: {rec['detection_latency_s']:.3f}s "
+            "(window advance -> verdict)")
+    for h in rec.get("hops", []):
+        # cross-replica history: each hop is one lease handoff the job
+        # survived — the chain names the releasing replica AND its cycle
+        lines.append(
+            f"  handoff: from {h.get('replica') or h.get('worker') or '?'}"
+            + (f" cycle {h['cycle_id']}" if h.get("cycle_id") else "")
+            + f" ({h.get('reason') or 'handoff'}"
+            + (f", last path {h['path']}" if h.get("path") else "")
+            + ")")
     if rec.get("reason"):
         lines.append(f"  recorded reason: {rec['reason']}")
     for f in rec.get("families", []):
@@ -363,17 +389,10 @@ def _render_explain(payload: dict) -> str:
 def cmd_explain(args) -> int:
     """Fetch and render one job's verdict provenance (/jobs/<id>/explain)."""
     import urllib.error
-    import urllib.request
 
-    endpoint = (args.endpoint or knobs.read("ANALYST_ENDPOINT")
-                or "http://localhost:8099")
-    # analyst endpoints are often configured with the /v1/healthcheck/
-    # suffix; explain lives at the server root
-    base = endpoint.split("/v1/")[0].rstrip("/")
-    url = f"{base}/jobs/{args.job}/explain"
+    base = _resolve_base(args.endpoint)
     try:
-        with urllib.request.urlopen(url, timeout=10) as r:
-            payload = json.loads(r.read().decode())
+        payload = _get_json(base, f"/jobs/{args.job}/explain")
     except urllib.error.HTTPError as e:
         try:
             msg = json.loads(e.read().decode()).get("error", str(e))
@@ -389,6 +408,81 @@ def cmd_explain(args) -> int:
     else:
         print(_render_explain(payload))
     return 0
+
+
+def _render_fleet(payload: dict) -> str:
+    """Human-readable fleet view (`foremast-tpu top`): one row per
+    replica from its published digest, aggregate header on top — the
+    operator's single place to see an N-replica brain as one system."""
+    agg = payload.get("aggregate") or {}
+    lines = [
+        f"fleet via {payload.get('replica', '?')} — "
+        f"{agg.get('replicas', 0)} replica(s), "
+        f"{agg.get('replicas_fresh', 0)} fresh, "
+        f"worst health {agg.get('worst_health', '?')}, "
+        f"{agg.get('shards_owned', 0)} shard(s) owned, "
+        f"{sum((agg.get('jobs') or {}).values())} job(s)"
+    ]
+    slo_worst = agg.get("slo_worst") or {}
+    if slo_worst:
+        lines.append("slo (worst replica per class): " + "; ".join(
+            f"{cls} p50 {s.get('p50_s')}s p99 {s.get('p99_s')}s "
+            f"burn {s.get('burn')}"
+            for cls, s in sorted(slo_worst.items())))
+    lines.append(
+        f"{'REPLICA':<24} {'HEALTH':<11} {'SHARDS o/a/d':<13} "
+        f"{'JOBS':>6} {'CYCLE':<14} {'DETECT p50/p99':<26} {'AGE':>9}")
+    for r in payload.get("replicas", []):
+        d = r.get("digest") or {}
+        sh = d.get("shards") or {}
+        shards = (f"{sh.get('owned', 0)}/{sh.get('adopting', 0)}/"
+                  f"{sh.get('draining', 0)}" if sh else "-")
+        jobs = sum((d.get("jobs") or {}).values())
+        slo_d = d.get("slo") or {}
+        detect = " ".join(
+            f"{cls[:4]} {s.get('p50_s')}/{s.get('p99_s')}s"
+            for cls, s in sorted(slo_d.items())) or "-"
+        if r.get("self"):
+            age = "live"
+        elif r.get("age_s") is None:
+            age = "static"  # launcher-fixed membership: no heartbeat age
+        else:
+            age = f"{r['age_s']:.0f}s"
+        name = r.get("replica", "?") + (" *" if r.get("self") else "")
+        health = (d.get("health") or "?") + \
+            (" STALE" if r.get("stale") else "")
+        lines.append(
+            f"{name:<24} {health:<11} {shards:<13} {jobs:>6} "
+            f"{(d.get('cycle_id') or '-'):<14} {detect:<26} {age:>9}")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Render the fleet view (GET /fleet): per-replica health, shard
+    slices, detection-latency p50/p99, digest staleness — the sharded
+    brain as ONE system from any replica's endpoint. `--watch N`
+    re-renders every N seconds until interrupted."""
+    import time as _time
+
+    base = _resolve_base(args.endpoint)
+    try:
+        while True:
+            try:
+                payload = _get_json(base, "/fleet")
+            except Exception as e:  # noqa: BLE001 - CLI boundary: diagnose
+                print(f"cannot reach {base}: {e}", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(payload, indent=2))
+            else:
+                print(_render_fleet(payload))
+            if not args.watch:
+                return 0
+            _time.sleep(max(args.watch, 1.0))
+            print()
+    except KeyboardInterrupt:
+        # ^C mid-fetch or mid-sleep is the normal way out of --watch
+        return 0
 
 
 def cmd_trigger(args) -> int:
@@ -480,6 +574,20 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("--json", action="store_true",
                     help="print the raw /status shards section")
     sh.set_defaults(func=cmd_shards)
+    tp = sub.add_parser(
+        "top",
+        help="render the fleet view (/fleet): per-replica health, shard "
+             "slices, detection-latency p50/p99, digest staleness",
+    )
+    tp.add_argument("--endpoint", default="",
+                    help="any replica's base URL (env ANALYST_ENDPOINT; "
+                         "default http://localhost:8099)")
+    tp.add_argument("--json", action="store_true",
+                    help="print the raw /fleet payload")
+    tp.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="re-render every N seconds (floor 1s) until "
+                         "interrupted")
+    tp.set_defaults(func=cmd_top)
     ex = sub.add_parser(
         "explain",
         help="render a job's verdict provenance (which path produced the "

@@ -232,8 +232,12 @@ class VerdictExporter:
                 lab = ",".join(
                     f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
                 # ':' is legal in prometheus metric names (recording-rule
-                # style)
-                lines.append(f"{name}{{{lab}}} {value}")
+                # style); label-less samples omit the braces — `name{}` is
+                # not part of the 0.0.4 exposition grammar (the scrape-
+                # compat test in tests/test_fleet_plane.py parses every
+                # line against it)
+                lines.append(f"{name}{{{lab}}} {value}" if lab
+                             else f"{name} {value}")
         hists = sorted(self.histogram_samples(),
                        key=lambda s: (s[0], sorted(s[1].items())))
         seen_meta: set[str] = set()
@@ -254,6 +258,10 @@ class VerdictExporter:
                     f'{name}_bucket{{{base}{sep}le="{edge:g}"}} {cum}')
             cum += counts[-1]
             lines.append(f'{name}_bucket{{{base}{sep}le="+Inf"}} {cum}')
-            lines.append(f"{name}_sum{{{base}}} {round(total, 6)}")
-            lines.append(f"{name}_count{{{base}}} {n}")
+            if base:
+                lines.append(f"{name}_sum{{{base}}} {round(total, 6)}")
+                lines.append(f"{name}_count{{{base}}} {n}")
+            else:
+                lines.append(f"{name}_sum {round(total, 6)}")
+                lines.append(f"{name}_count {n}")
         return "\n".join(lines) + "\n"

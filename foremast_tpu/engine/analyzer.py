@@ -54,6 +54,7 @@ from ..utils.timeutils import from_rfc3339
 from . import jobs as J
 from . import flightrec
 from . import provenance as prov
+from . import slo as slo_mod
 from .config import EngineConfig, MetricPolicy
 from .health import HealthMonitor
 
@@ -244,6 +245,15 @@ class _JobState:
     # per-job fetch accounting from the preprocess thread's trace notes
     # (delta vs full, points, seconds) — provenance's "fetch mode" block
     fetch: dict = field(default_factory=dict)
+    # ingest marker (monotonic): set as the job's preprocess result
+    # streams in — the job was freshly ingested this cycle (0 = shed
+    # before fetch / quarantined: no latency observation).
+    ingest_at: float = 0.0
+    # window-advance stamp: the newest VALID sample timestamp across the
+    # job's judged current windows (the data's own clock). Detection
+    # latency = (cycle `now` − this, the poll/scrape wait) + the
+    # measured in-cycle tail — see Analyzer._observe_latency.
+    newest_ts: float = 0.0
 
 
 class Analyzer:
@@ -335,6 +345,9 @@ class Analyzer:
         # into the tracer (spans + log records) and stamped on provenance
         self._cycle_seq = 0
         self.current_cycle_id = ""
+        # monotonic stamp of the current cycle's start: the in-cycle half
+        # of each detection-latency observation (_observe_latency)
+        self._cycle_mono0 = 0.0
         # jobs whose lstm verdict was served from the z-memo this cycle
         # (provenance memo-hit classification); reset per cycle
         self._lstm_memo_jobs: set = set()
@@ -346,6 +359,20 @@ class Analyzer:
         self.health = HealthMonitor(exporter=self.exporter,
                                     recorder=self.flight)
         self.flight.health_fn = self.health.state
+        # detection-latency SLOs (engine/slo.py): ingest->verdict latency
+        # per job class, with per-class targets and error-budget burn —
+        # the latency baseline the streaming-dataplane roadmap item must
+        # beat. Pure observation; burn rides the health detail
+        # (informational) and /status, histograms ride /metrics.
+        self.slo = slo_mod.DetectionSLO(
+            exporter=self.exporter,
+            targets={
+                "canary": config.slo_canary_seconds,
+                "continuous": config.slo_continuous_seconds,
+                "hpa": config.slo_hpa_seconds,
+            },
+            objective=config.slo_objective)
+        self.health.configure(slo_fn=self.slo.burn_summary)
         # load shedding (CYCLE_DEADLINE_S): cumulative shed count + the
         # consecutive-shed streak per open job (a shed job sorts ahead of
         # its priority class next cycle, so a permanently-blown budget
@@ -436,6 +463,48 @@ class Analyzer:
                     for name, k in sorted(_knobs.all_knobs().items())
                     if k.scope in ("runtime", "devtools")},
         }
+
+    def status_digest(self) -> dict:
+        """Compact JSON-safe status digest this replica publishes in its
+        membership heartbeat blob (engine/sharding.py digest_fn) — the
+        cross-replica federation medium GET /fleet aggregates: health
+        state, job counts, last-cycle golden signals, lease/triage
+        counters, and per-class detection-latency SLO attainment. Must
+        stay small (re-written every HEARTBEAT_S into the shared archive)
+        and cheap (runs on the heartbeat thread). Dicts mutated by the
+        cycle thread are snapshotted before summing."""
+        state, _detail = self.health.state()
+        stats = self.last_cycle_stages or {}
+        store = self.store
+        digest = {
+            "v": 1,
+            "health": state,
+            "cycle_id": self.current_cycle_id,
+            "jobs": store.status_counts(),
+            "cycle": {
+                "jobs": stats.get("jobs", 0),
+                "device_launches": stats.get("device_launches", 0),
+                "shed": stats.get("jobs_shed", 0),
+                "stale_served": stats.get("stale_verdicts_served", 0),
+                "watchdog_fires": stats.get("watchdog_fires", 0),
+                "quarantined": stats.get("quarantined_jobs", 0),
+            },
+            "lease": {
+                "claims": store.lease_claims_total,
+                "steals": store.lease_steals_total,
+                "releases": store.lease_releases_total,
+                "adoptions": store.adopted_total,
+            },
+            "triage": {
+                "screened": sum(dict(self.triage_screened_total).values()),
+                "cleared": sum(dict(self.triage_cleared_total).values()),
+                "escalated": sum(dict(self.triage_escalated_total).values()),
+            },
+            "slo": self.slo.digest(),
+        }
+        if self.shard is not None:
+            digest["shards"] = self.shard.health_summary()
+        return digest
 
     # ------------------------------------------------------------------ fetch
     def _fetch_window(self, url: str, now: float) -> Window | None:
@@ -644,6 +713,53 @@ class Analyzer:
             "foremastbrain:watchdog_fires_total", {},
             help="device materializations timed out by the collect "
                  "watchdog (WATCHDOG_S)")
+
+    @staticmethod
+    def _newest_sample_ts(items) -> float:
+        """Newest VALID sample timestamp across a job's judged current
+        windows — the moment the job's window last ADVANCED, on the
+        data's own clock. 0.0 when nothing is judgeable."""
+        pairs, bands, bis, multis, hpas = items
+        curs = ([it.current for it in pairs]
+                + [it.current for it in bands]
+                + [w for it in bis for w in it.cur]
+                + [w for it in multis for w in it.cur]
+                + [it.current for it in hpas])
+        newest = 0.0
+        for w in curs:
+            if w is None or w.n_valid == 0:
+                continue
+            idx = int(np.flatnonzero(w.mask)[-1])
+            newest = max(newest, float(w.start + idx * w.step))
+        return newest
+
+    def _observe_latency(self, st: _JobState, now: float):
+        """One window-advance -> verdict detection-latency observation
+        for a judged job (engine/slo.py), annotated onto its provenance
+        record BEFORE the terminal transition attaches the summary to
+        the Document.
+
+        Two addends, each in a self-consistent clock domain:
+          * poll/scrape wait — cycle `now` minus the newest judged
+            sample's own timestamp (how long fresh evidence sat waiting
+            for the poll tick; the component the streaming dataplane
+            exists to remove, floored by the metric step / CYCLE_SECONDS
+            under poll-driven operation);
+          * in-cycle tail — monotonic fold time minus the cycle start
+            (fetch + dispatch + collect + fold for this job's cycle).
+
+        No-op for jobs that ingested nothing this cycle (shed,
+        quarantined, stale-served)."""
+        if not st.ingest_at:
+            return
+        tail0 = self._cycle_mono0 or st.ingest_at
+        lat = max(time.monotonic() - tail0, 0.0)
+        if st.newest_ts > 0:
+            lat += max(now - st.newest_ts, 0.0)
+        st.ingest_at = 0.0  # at most one observation per cycle
+        self.slo.observe(slo_mod.classify(st.doc.strategy), lat)
+        self.provenance.annotate(st.doc.id,
+                                 detection_latency_s=round(lat, 6))
 
     def _prov_content(self, job_id: str) -> str | None:
         """Compact provenance JSON for a terminal Document's
@@ -1778,6 +1894,7 @@ class Analyzer:
         cycle_id = f"{worker}-c{self._cycle_seq}"
         self.current_cycle_id = cycle_id
         t_cycle0 = time.perf_counter()
+        self._cycle_mono0 = time.monotonic()
         with tracing.tracer.bind(cycle_id=cycle_id), \
                 tracing.span(tracing.SPAN_ENGINE_CYCLE, worker=worker):
             now = time.time() if now is None else now
@@ -1987,6 +2104,12 @@ class Analyzer:
                 if failed:
                     states[doc_id].failed = failed
                 else:
+                    # detection-latency stamps: the job was freshly
+                    # ingested this cycle, and its window last advanced
+                    # at the newest judged sample's own timestamp
+                    # (engine/slo.py; _observe_latency)
+                    states[doc_id].ingest_at = time.monotonic()
+                    states[doc_id].newest_ts = self._newest_sample_ts(items)
                     pairs, bands, bis, multis, hpas = items
                     all_pairs += pairs
                     all_bands += bands
@@ -2297,9 +2420,14 @@ class Analyzer:
             # scored cleanly: full quarantine reset (consecutive = 0)
             self._quarantine.pop(job_id, None)
             if doc.strategy == STRATEGY_HPA:
+                res = hpa_res.get(job_id)
                 outcomes[job_id] = self._finish_hpa(
-                    st, hpa_res.get(job_id), worker, now,
+                    st, res, worker, now,
                     path_info=_vpath(job_id) if prov_on else None)
+                if res is not None:
+                    # a scored hpa cycle IS the detection; annotates the
+                    # record _finish_hpa just wrote
+                    self._observe_latency(st, now)
                 continue
             try:
                 end_time = from_rfc3339(doc.end_time)
@@ -2319,6 +2447,9 @@ class Analyzer:
                         detail=detail, reason=reason,
                         families=fam_entries.get(job_id),
                         fetch=st.fetch)
+                # observed between record and transition: the latency
+                # annotation must land before the summary is attached
+                self._observe_latency(st, now)
                 self.store.transition(
                     job_id, J.COMPLETED_UNHEALTH,
                     reason=reason,
@@ -2337,6 +2468,10 @@ class Analyzer:
                     self.provenance.record(  # lint: disable=trace-registry -- path from _vpath (registered constants only)
                         job_id, path, status=J.INITIAL, detail=detail,
                         families=fam_entries.get(job_id), fetch=st.fetch)
+                if st.judged_any:
+                    # "healthy so far" is a verdict too: the monitor fleet's
+                    # steady-state latency is exactly this path
+                    self._observe_latency(st, now)
                 self.store.requeue(job_id, worker=worker)
                 outcomes[job_id] = J.INITIAL
             elif st.judged_any:
@@ -2347,6 +2482,7 @@ class Analyzer:
                         job_id, path, status=J.COMPLETED_HEALTH,
                         detail=detail, families=fam_entries.get(job_id),
                         fetch=st.fetch)
+                self._observe_latency(st, now)
                 self.store.transition(
                     job_id, J.COMPLETED_HEALTH, worker=worker,
                     processing_content=self._prov_content(job_id))

@@ -295,6 +295,18 @@ class EngineConfig:
     # transition into OVERLOADED/STALLED and on SIGTERM. Empty = the
     # system temp dir.
     flight_dump_dir: str = ""  # FLIGHT_DUMP_DIR
+    # detection-latency SLO targets per job class (engine/slo.py):
+    # ingest (window advance) -> verdict latency budget in seconds.
+    # Canary verdicts gate live rollouts so their target is tightest;
+    # monitors/hpa re-judge every cycle and budget a cadence or two.
+    # 0 disables the target for that class (latency is still measured —
+    # the histograms/quantiles always record; only attainment/burn need
+    # a target). SLO_OBJECTIVE is the attainment goal the error budget
+    # derives from (0.99 = 1% of verdicts may miss the target).
+    slo_canary_seconds: float = 30.0  # SLO_CANARY_S
+    slo_continuous_seconds: float = 60.0  # SLO_CONTINUOUS_S
+    slo_hpa_seconds: float = 60.0  # SLO_HPA_S
+    slo_objective: float = 0.99  # SLO_OBJECTIVE
     policies: dict = field(default_factory=lambda: dict(DEFAULT_POLICIES))
 
     def policy_for(self, metric_name: str) -> MetricPolicy:
@@ -452,5 +464,9 @@ def from_env(env=None) -> EngineConfig:
         watchdog_seconds=_env_float(env, "WATCHDOG_S", 0.0),
         provenance=_env_bool(env, "PROVENANCE", True),
         flight_dump_dir=env.get("FLIGHT_DUMP_DIR", ""),
+        slo_canary_seconds=_env_float(env, "SLO_CANARY_S", 30.0),
+        slo_continuous_seconds=_env_float(env, "SLO_CONTINUOUS_S", 60.0),
+        slo_hpa_seconds=_env_float(env, "SLO_HPA_S", 60.0),
+        slo_objective=_env_float(env, "SLO_OBJECTIVE", 0.99),
         policies=policies,
     )

@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import tempfile
 import time
 from collections import deque
@@ -65,6 +66,11 @@ EVENT_TYPES = frozenset({
 })
 
 MAX_DUMPS = 8  # newest dump files kept on disk per dump dir
+
+# dump filenames are exactly what dump() writes (stamp + sanitized
+# reason); the index/fetch endpoints validate against this so a request
+# can never escape the dump dir or read arbitrary files
+_DUMP_NAME_RE = re.compile(r"^foremast-flight-[A-Za-z0-9_-]+\.json$")
 
 
 class FlightRecorder:
@@ -193,6 +199,52 @@ class FlightRecorder:
         except Exception as e:  # noqa: BLE001 - diagnostics must not crash
             log.warning("flight dump failed (%s): %s", reason, e)
             return None
+
+    def list_dumps(self) -> list[dict]:
+        """Index of on-disk dumps (newest first): name, age, size, and
+        the trigger parsed back out of the filename — so an operator can
+        find the right historical incident from /debug/flight/dumps
+        instead of shelling into the pod."""
+        try:
+            names = os.listdir(self.dump_dir)
+        except OSError:
+            return []
+        now = time.time()
+        out = []
+        for fn in names:
+            if not _DUMP_NAME_RE.match(fn):
+                continue
+            try:
+                st = os.stat(os.path.join(self.dump_dir, fn))
+            except OSError:
+                continue
+            # foremast-flight-<stamp>-<reason>.json; the stamp never
+            # contains '-', so the first split yields the trigger intact
+            stem = fn[len("foremast-flight-"):-len(".json")]
+            trigger = stem.split("-", 1)[1] if "-" in stem else ""
+            out.append({
+                "name": fn,
+                "age_s": round(max(now - st.st_mtime, 0.0), 1),
+                "size_bytes": st.st_size,
+                "trigger": trigger,
+            })
+        out.sort(key=lambda d: d["age_s"])
+        return out
+
+    def read_dump(self, name: str) -> dict | None:
+        """One dump's parsed payload by exact filename, or None (unknown
+        name, invalid name, unreadable file). Names are validated against
+        the dump filename grammar — no path components ever reach the
+        filesystem join."""
+        if not _DUMP_NAME_RE.match(name) or os.path.basename(name) != name:
+            return None
+        try:
+            with open(os.path.join(self.dump_dir, name),
+                      encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return payload if isinstance(payload, dict) else None
 
     def _prune_dumps(self):
         try:

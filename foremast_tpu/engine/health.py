@@ -83,6 +83,13 @@ class HealthMonitor:
         # rebalance" — informational, never a state driver (a rebalance is
         # normal operation, not degradation).
         self.shards_fn = None
+        # detection-latency SLO tap (engine/slo.py DetectionSLO
+        # burn_summary): () -> {class: error-budget burn}. Folded into
+        # the state() detail like shards_fn — informational, never a
+        # state driver (latency is an SLO conversation, not readiness;
+        # readiness failing on a burnt budget would route traffic away
+        # from a brain that is merely slow, making it slower).
+        self.slo_fn = None
         # flight recorder (engine/flightrec.py): hears state transitions
         # and breaker flips; transitions into OVERLOADED/STALLED auto-dump
         self.recorder = recorder
@@ -98,7 +105,7 @@ class HealthMonitor:
 
     # ------------------------------------------------------------ wiring
     def configure(self, cycle_seconds: float | None = None,
-                  breakers_fn=None, shards_fn=None):
+                  breakers_fn=None, shards_fn=None, slo_fn=None):
         with self._lock:
             if cycle_seconds is not None:
                 self.cycle_seconds = float(cycle_seconds)
@@ -106,6 +113,8 @@ class HealthMonitor:
                 self.breakers_fn = breakers_fn
             if shards_fn is not None:
                 self.shards_fn = shards_fn
+            if slo_fn is not None:
+                self.slo_fn = slo_fn
 
     # --------------------------------------------------------- engine side
     def begin_cycle(self):
@@ -163,6 +172,7 @@ class HealthMonitor:
             last_end = self._last_cycle_end
             breakers_fn = self.breakers_fn
             shards_fn = self.shards_fn
+            slo_fn = self.slo_fn
         open_breakers = []
         if breakers_fn is not None:
             try:
@@ -175,6 +185,13 @@ class HealthMonitor:
         if shards_fn is not None:
             try:
                 detail["shards"] = shards_fn()
+            except Exception:  # noqa: BLE001 - a probe must never raise
+                pass
+        if slo_fn is not None:
+            try:
+                burns = slo_fn()
+                if burns:  # empty before the first verdict: no key churn
+                    detail["slo_burn"] = burns
             except Exception:  # noqa: BLE001 - a probe must never raise
                 pass
         # STALLED: the engine has started cycling but nothing COMPLETED

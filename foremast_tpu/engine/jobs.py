@@ -348,7 +348,7 @@ class JobStore:
                 self._persist()
         return out
 
-    def release_leases(self, worker: str = "") -> int:
+    def release_leases(self, worker: str = "", content_fn=None) -> int:
         """Graceful-shutdown handoff: surrender every open lease.
 
         In-progress jobs drop back to INITIAL (reprocess-from-scratch, the
@@ -358,13 +358,24 @@ class JobStore:
         MAX_STUCK_IN_SECONDS window. Status rewinds bypass the transition
         table deliberately — this is the store's own shutdown protocol,
         equivalent to the takeover path's reset, not an engine-visible
-        verdict transition. Returns the number of jobs released."""
+        verdict transition.
+
+        `content_fn(job_id) -> str|None` attaches a handoff provenance
+        summary (engine/provenance.py handoff_json) to each released
+        Document's processing_content, so the job's "why" — and the
+        explicit handoff hop — travel with it into the archive for the
+        adopter's `explain`. Must be a cheap pure-host callable (runs per
+        doc under the store lock). Returns the number of jobs released."""
         now = time.time()
         released = 0
         with self._lock:
             for doc in self._jobs.values():
                 if doc.status not in OPEN_STATUSES:
                     continue
+                if content_fn is not None:
+                    blob = content_fn(doc.id)
+                    if blob:
+                        doc.processing_content = blob
                 if doc.status in INPROGRESS_STATUSES:
                     doc.status = INITIAL
                     # only the docs actually rewound get the handoff
@@ -388,7 +399,8 @@ class JobStore:
                 self._persist()
         return released
 
-    def release_unowned(self, owns_fn, worker: str = "") -> list[str]:
+    def release_unowned(self, owns_fn, worker: str = "",
+                        content_fn=None) -> list[str]:
         """Shard-rebalance handoff: release every open job this replica no
         longer owns (engine/sharding.py calls this from ShardManager.tick
         after a membership change).
@@ -398,7 +410,9 @@ class JobStore:
         the NEW owner's adoption scan takes it over immediately — no
         MAX_STUCK_IN_SECONDS wait. Docs already handed off (released,
         unleased, INITIAL) are left alone so a still-unadopted record is
-        not re-stamped every tick. Returns the released ids."""
+        not re-stamped every tick. `content_fn` attaches the handoff
+        provenance summary exactly as in release_leases. Returns the
+        released ids."""
         now = time.time()
         released: list[str] = []
         with self._lock:
@@ -410,6 +424,10 @@ class JobStore:
                 if (doc.released_at > 0 and not doc.lease_holder
                         and doc.status == INITIAL):
                     continue  # already handed off, awaiting adoption/prune
+                if content_fn is not None:
+                    blob = content_fn(doc.id)
+                    if blob:
+                        doc.processing_content = blob
                 if doc.status in INPROGRESS_STATUSES:
                     doc.status = INITIAL
                     if worker:
@@ -873,7 +891,8 @@ class JobStore:
                                  limit: int = 1024,
                                  now: float | None = None,
                                  skew_margin_seconds: float = 15.0,
-                                 owns_fn=None, dead_holder_fn=None) -> int:
+                                 owns_fn=None, dead_holder_fn=None,
+                                 on_adopt=None) -> int:
         """Adopt open jobs a crashed/partitioned peer left in the archive.
 
         The reference's failover medium is ES: any brain replica re-claims
@@ -897,6 +916,11 @@ class JobStore:
         `owns_fn` restricts adoption to this replica's own shards, so N
         replicas recovering a dead peer split its fleet instead of all
         pulling all of it.
+
+        `on_adopt(doc)` is called (outside the store lock, best-effort)
+        for each adopted Document — the runtime feeds the attached
+        handoff provenance back into its recorder and names the adopted
+        jobs in the flight-recorder adoption event.
 
         When the archive supports `claim_job` (compare-and-swap append;
         FileArchive/EsArchive do), the adoption is RACE-FREE: the claim
@@ -994,6 +1018,12 @@ class JobStore:
                 self.adopted_total += 1
                 adopted += 1
                 self._persist()
+            if on_adopt is not None:
+                try:
+                    on_adopt(doc)
+                except Exception:  # noqa: BLE001 - observer, never fatal
+                    log.warning("on_adopt hook failed for %s", doc.id,
+                                exc_info=True)
         return adopted
 
     def close(self):

@@ -29,6 +29,7 @@ import json
 import time
 from collections import OrderedDict, deque
 
+from .jobs import TERMINAL_STATUSES
 from ..utils.locks import make_lock
 
 __all__ = [
@@ -60,6 +61,10 @@ PATHS = frozenset({
 # most informative rows plus a drop count, not an unbounded list
 _MAX_FAMILY_ENTRIES = 16
 
+# bound on the handoff-hop chain a record carries: a job ping-ponging
+# across replicas keeps its newest hops, never an unbounded history
+_MAX_HOPS = 8
+
 
 class ProvenanceRecorder:
     """Bounded store of per-(job, cycle) verdict-attribution records.
@@ -74,6 +79,11 @@ class ProvenanceRecorder:
         self._lock = make_lock("engine.provenance")
         self._latest: OrderedDict[str, dict] = OrderedDict()  # job -> record
         self._ring: deque = deque(maxlen=ring_size)  # recent records
+        # job -> inherited handoff-hop chain (adopt() seeds it from the
+        # Document blob a releasing peer attached; record() stamps it
+        # onto every later record so `explain` on the adopter shows the
+        # full cross-replica decision chain)
+        self._hops: OrderedDict[str, list] = OrderedDict()
         self._cycle: dict = {}        # shared per-cycle block (stamped late)
         self._cycle_records: int = 0  # records written this cycle
         self.records_total = 0
@@ -113,6 +123,17 @@ class ProvenanceRecorder:
         if fetch:
             rec["fetch"] = fetch
         with self._lock:
+            hops = self._hops.get(job_id)
+            if hops:
+                # the inherited chain survives every later record: the
+                # adopter's terminal verdict archives WITH its history.
+                # A TERMINAL record closes the chain — job ids are
+                # deterministic (hpa/hmac over the request), so a
+                # re-submitted incarnation of the same id must start
+                # clean instead of inheriting a dead run's handoffs.
+                rec["hops"] = list(hops)
+                if status in TERMINAL_STATUSES:
+                    self._hops.pop(job_id, None)
             self._latest[job_id] = rec
             self._latest.move_to_end(job_id)
             while len(self._latest) > self.max_jobs:
@@ -137,6 +158,78 @@ class ProvenanceRecorder:
                 self._cycle["device_launches"] = int(device_launches)
             if jobs is not None:
                 self._cycle["jobs"] = int(jobs)
+
+    def annotate(self, job_id: str, **kv):
+        """Fold late-arriving fields (detection latency, measured after
+        the record was written) into a job's LATEST record. The record
+        dict is shared with the ring, so both views update; a no-op when
+        the job has no record."""
+        if not self.enabled or not kv:
+            return
+        with self._lock:
+            rec = self._latest.get(job_id)
+            if rec is not None:
+                rec.update(kv)
+
+    # --------------------------------------------- cross-replica handoffs
+    def handoff_json(self, job_id: str, replica: str = "", worker: str = "",
+                     reason: str = "", max_bytes: int = 4096) -> str:
+        """Compact JSON a RELEASING replica attaches to the Document
+        (processing_content) when it hands a job off — the job's latest
+        attribution plus an explicit handoff hop naming this replica and
+        its cycle, appended to any hops the job already inherited. The
+        adopter feeds it back through adopt(), so `explain` there shows
+        the full chain including every handoff. Empty string when
+        recording is off (the field stays untouched)."""
+        if not self.enabled:
+            return ""
+        rec = self.get(job_id)
+        hop = {
+            "replica": replica,
+            "worker": worker,
+            "reason": reason,
+            "ts": round(time.time(), 3),
+            "cycle_id": (rec.get("cycle") or {}).get("cycle_id", "")
+            if rec else "",
+            "path": rec.get("path", "") if rec else "",
+        }
+        with self._lock:
+            inherited = list(self._hops.get(job_id) or ())
+        prior = (rec.get("hops") if rec else None) or inherited
+        hops = (list(prior) + [hop])[-_MAX_HOPS:]
+        slim = {k: v for k, v in (rec or {"job_id": job_id}).items()
+                if k != "cycle"}
+        slim["cycle_id"] = hop["cycle_id"]
+        slim["hops"] = hops
+        slim["handoff"] = hop  # marker adopt() keys on
+        blob = json.dumps(slim)
+        if len(blob) > max_bytes:
+            slim.pop("families", None)
+            slim["families_dropped"] = "all"
+            blob = json.dumps(slim)
+        return blob
+
+    def adopt(self, job_id: str, blob: str):
+        """An ADOPTING replica imports the handoff blob that traveled on
+        the Document: the hop chain is remembered and stamped onto every
+        record this replica writes for the job. Non-handoff blobs (plain
+        terminal summaries, legacy free text) are ignored."""
+        if not self.enabled or not blob:
+            return
+        try:
+            rec = json.loads(blob)
+        except ValueError:
+            return
+        if not isinstance(rec, dict) or "handoff" not in rec:
+            return
+        hops = [h for h in (rec.get("hops") or []) if isinstance(h, dict)]
+        if not hops:
+            return
+        with self._lock:
+            self._hops[job_id] = hops[-_MAX_HOPS:]
+            self._hops.move_to_end(job_id)
+            while len(self._hops) > self.max_jobs:
+                self._hops.popitem(last=False)
 
     # ------------------------------------------------------------- reading
     def get(self, job_id: str) -> dict | None:

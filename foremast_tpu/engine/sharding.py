@@ -150,7 +150,9 @@ class ShardManager:
     def __init__(self, store, replica_id: str, *, shard_count: int = 64,
                  vnodes: int = 64, heartbeat_seconds: float = 5.0,
                  member_ttl_seconds: float = 15.0, static_members=None,
-                 worker: str = "", flight=None, clock=time.time):
+                 worker: str = "", flight=None, clock=time.time,
+                 digest_fn=None, cycle_id_fn=None,
+                 handoff_content_fn=None):
         self.store = store
         self.archive = getattr(store, "archive", None)
         self.replica_id = replica_id
@@ -164,6 +166,20 @@ class ShardManager:
             if static_members else None)
         self.flight = flight
         self._clock = clock
+        # -- fleet-observability taps (all optional; runtime wires them) --
+        # digest_fn: () -> compact JSON-safe status digest published in
+        # the membership heartbeat blob (Analyzer.status_digest) — the
+        # cross-replica federation medium GET /fleet aggregates. Rides
+        # the EXISTING heartbeat cadence: no new archive traffic.
+        self.digest_fn = digest_fn
+        # cycle_id_fn: () -> the current engine cycle id, stamped on
+        # lease-handoff / rebalance / adoption flight events so both
+        # sides of a handoff correlate in their flight rings.
+        self.cycle_id_fn = cycle_id_fn
+        # handoff_content_fn: (job_id) -> provenance handoff blob attached
+        # to Documents released on a rebalance (provenance.handoff_json),
+        # so the adopter's `explain` keeps the full decision chain.
+        self.handoff_content_fn = handoff_content_fn
         # guards the swap of the view/ring/owner/state refs; readers
         # (owns, dead_holder — called per doc under the store lock) read
         # the refs WITHOUT it, which is safe because rebuilds swap whole
@@ -178,6 +194,13 @@ class ShardManager:
         # suspended until a read succeeds again.
         self._members_view: dict[str, dict] = {
             replica_id: {"replica": replica_id, "worker": self.worker}}
+        # replica -> (blob, stamp) for EVERY member record the last read
+        # saw — including `left` and TTL-expired ones the membership view
+        # filters out. GET /fleet renders this: a freshly-dead replica
+        # must show as STALE (age > TTL), not silently vanish, until the
+        # archive's hygiene horizon finally drops it. Swapped whole
+        # (immutable-by-convention) like the view dicts.
+        self._fleet: dict[str, tuple[dict, float]] = {}
         # every replica id / worker name ever seen in a fresh view: the
         # dead-holder gate only convicts holders we positively watched
         # disappear (a never-seen holder is NOT evidence of death)
@@ -257,7 +280,7 @@ class ShardManager:
             self.flight.record_event(
                 EVENT_LEASE_HANDOFF, released=len(released),
                 worker=self.worker, reason="shard-rebalance",
-                jobs=list(released[:32]))
+                cycle_id=self._cycle_id(), jobs=list(released[:32]))
         return {
             "membership_changed": changed,
             "replicas": sorted(members),
@@ -284,12 +307,22 @@ class ShardManager:
                     and now - self._last_heartbeat < self.heartbeat_seconds):
                 return
             self._last_heartbeat = now
+        blob = {"replica": self.replica_id, "worker": self.worker,
+                "left": False}
+        if self.digest_fn is not None:
+            # the status digest rides the liveness blob (same medium, same
+            # cadence — federation costs zero extra archive writes); a
+            # failing digest must never cost the heartbeat itself
+            try:
+                d = self.digest_fn()
+                if d:
+                    blob["digest"] = d
+            except Exception:  # noqa: BLE001 - observability, not liveness
+                log.warning("status digest failed", exc_info=True)
         ok = False
         try:
             ok = bool(self.archive.index_state(
-                MEMBER_KEY_PREFIX + self.replica_id,
-                {"replica": self.replica_id, "worker": self.worker,
-                 "left": False}, now))
+                MEMBER_KEY_PREFIX + self.replica_id, blob, now))
         except Exception as e:  # noqa: BLE001 - heartbeat is best-effort
             log.warning("membership heartbeat failed: %s", e)
         if not ok:
@@ -313,7 +346,17 @@ class ShardManager:
         except Exception as e:  # noqa: BLE001 - shutdown must not raise
             log.warning("membership withdraw failed: %s", e)
 
-    def mark_adopt_complete(self, adopted: int = 0) -> None:
+    def _cycle_id(self) -> str:
+        """Current engine cycle id for event correlation ('' when the
+        runtime wired no tap or the tap fails)."""
+        if self.cycle_id_fn is None:
+            return ""
+        try:
+            return str(self.cycle_id_fn() or "")
+        except Exception:  # noqa: BLE001 - correlation only, never fatal
+            return ""
+
+    def mark_adopt_complete(self, adopted: int = 0, jobs=()) -> None:
         """An adoption scan ran with this manager's gates: gained shards
         graduate ``adopting`` -> ``owned``; a nonzero adoption is recorded
         for the flight recorder.
@@ -338,9 +381,13 @@ class ShardManager:
         if adopted:
             self.adoptions_total += adopted
             if self.flight is not None:
+                # cycle_id + job ids make the adoption correlatable with
+                # the releasing side's lease-handoff event (whose ids
+                # also ride each job's provenance handoff hop)
                 self.flight.record_event(
                     EVENT_SHARD_ADOPTION, replica=self.replica_id,
-                    adopted=int(adopted))
+                    adopted=int(adopted), cycle_id=self._cycle_id(),
+                    jobs=list(jobs)[:32])
 
     # ----------------------------------------------------------- membership
     def _refresh_membership(self, now: float) -> dict[str, dict]:
@@ -384,6 +431,7 @@ class ShardManager:
             self._membership_fresh = False
             return dict(self._members_view)
         view = {self.replica_id: me}
+        fleet: dict[str, tuple[dict, float]] = {}
         # opportunistic hygiene: archives with a delete_state (EsArchive —
         # no compaction pass to age blobs out) shed long-dead member docs
         # so the membership read's result set tracks the LIVE fleet, not
@@ -395,6 +443,10 @@ class ShardManager:
             rid = key[len(MEMBER_KEY_PREFIX):]
             if rid == self.replica_id or not isinstance(value, dict):
                 continue
+            # the fleet view keeps EVERY record the read saw — left and
+            # expired members render as stale rows on GET /fleet instead
+            # of silently vanishing the instant the TTL lapses
+            fleet[rid] = (value, stamp)
             if value.get("left") or now - stamp > self.member_ttl_seconds:
                 if (prune is not None and pruned < 8
                         and now - stamp > KEEP_MEMBER_SECONDS):
@@ -406,6 +458,7 @@ class ShardManager:
                 continue
             view[rid] = value
         self._members_view = view
+        self._fleet = fleet
         self._membership_fresh = True
         self._last_read = now
         self._note_holders(view)
@@ -481,7 +534,7 @@ class ShardManager:
             EVENT_REBALANCE, replica=self.replica_id,
             replicas=len(self._member_ids), gained=len(gained),
             lost=len(lost), handoffs=len(released),
-            jobs=list(released[:32]))
+            cycle_id=self._cycle_id(), jobs=list(released[:32]))
 
     # ---------------------------------------------------------------- store
     def _reconcile_store(self) -> list[str]:
@@ -499,7 +552,9 @@ class ShardManager:
             # defaults ON for single-replica deployments — this keeps
             # their per-tick cost at zero)
             return []
-        released = self.store.release_unowned(self.owns, worker=self.worker)
+        released = self.store.release_unowned(
+            self.owns, worker=self.worker,
+            content_fn=self.handoff_content_fn)
         if released:
             self.handoffs_total += len(released)
         self.store.prune_handed_off(self.owns)
@@ -534,6 +589,68 @@ class ShardManager:
             "owned": counts[SHARD_OWNED],
             "adopting": counts[SHARD_ADOPTING],
             "draining": counts[SHARD_DRAINING],
+        }
+
+    def fleet_snapshot(self, now: float | None = None) -> dict:
+        """The cross-replica federation view GET /fleet serves: one row
+        per replica incarnation the last membership read saw (plus self,
+        rendered live), each with its published status digest and the
+        digest's AGE — staleness semantics are explicit (age > TTL, or a
+        graceful `left` mark) so a killed replica shows as stale within
+        MEMBER_TTL_S instead of silently vanishing. Rows older than the
+        archive hygiene horizon have been pruned and read as absent."""
+        now = self._clock() if now is None else now
+        ttl = self.member_ttl_seconds
+        rows = []
+        me = {
+            "replica": self.replica_id,
+            "worker": self.worker,
+            "age_s": 0.0,
+            "left": False,
+            "stale": False,
+            "self": True,
+        }
+        if self.digest_fn is not None:
+            try:
+                me["digest"] = self.digest_fn() or {}
+            except Exception:  # noqa: BLE001 - observability, never fatal
+                me["digest"] = {}
+        rows.append(me)
+        fleet = self._fleet  # immutable-by-convention ref, lock-free read
+        members_view = self._members_view
+        for rid in sorted(set(fleet) | set(members_view)):
+            if rid == self.replica_id:
+                continue
+            if rid in fleet:
+                value, stamp = fleet[rid]
+                age = max(now - stamp, 0.0)
+                rows.append({
+                    "replica": rid,
+                    "worker": value.get("worker", ""),
+                    "age_s": round(age, 1),
+                    "left": bool(value.get("left")),
+                    "stale": bool(value.get("left")) or (ttl > 0
+                                                         and age > ttl),
+                    "self": False,
+                    "digest": value.get("digest") or {},
+                })
+            else:
+                # static-membership / never-read peers: listed, no digest
+                rows.append({
+                    "replica": rid, "worker":
+                    members_view.get(rid, {}).get("worker", ""),
+                    "age_s": None, "left": False, "stale": False,
+                    "self": False, "digest": {},
+                })
+        return {
+            "replica": self.replica_id,
+            "membership": ("static" if self.static_members is not None
+                           else "archive" if self.archive is not None
+                           else "solo"),
+            "membership_fresh": self._membership_fresh,
+            "member_ttl_seconds": ttl,
+            "heartbeat_seconds": self.heartbeat_seconds,
+            "replicas": rows,
         }
 
     def snapshot(self) -> dict:

@@ -1,0 +1,241 @@
+"""Detection-latency SLOs: ingest->verdict latency per job class.
+
+Foremast's value proposition is FAST, explainable health verdicts, yet
+until this module nothing measured how fast: the cycle stage gauges time
+engine internals, not the thing an operator is promised — how long after
+a job's window advanced (its newest judged sample arrived) did the
+verdict land? The analyzer stamps each job's window-advance moment
+through the cycle (the newest valid sample timestamp across its judged
+current windows, plus an ingest marker as its preprocess completes) and
+observes the latency when the verdict folds: the poll/scrape wait
+(cycle ``now`` minus the newest sample's own timestamp — the component
+the streaming dataplane exists to remove, floored by the metric step /
+CYCLE_SECONDS under poll-driven operation) plus the measured in-cycle
+tail (``Analyzer._observe_latency``), bucketed per job CLASS:
+
+  * ``canary``     — new-deployment analyses (rollingUpdate/canary/
+                     rollover): the verdict gates a live rollout, so the
+                     tightest target;
+  * ``continuous`` — steady-state monitors, re-judged every cycle;
+  * ``hpa``        — autoscaling scores, consumed by the HPA adapter.
+
+Each class carries an SLO target (SLO_CANARY_S / SLO_CONTINUOUS_S /
+SLO_HPA_S) and the fleet-wide objective (SLO_OBJECTIVE, default 0.99:
+99% of verdicts inside the target). The tracker keeps its own bucket
+counts (quantile estimates for /status, the fleet digest, and
+`foremast-tpu top`) and mirrors everything onto the exporter:
+
+  foremastbrain:detection_latency_seconds{class=}   histogram
+  foremastbrain:slo_attainment{class=}              gauge (0..1)
+  foremastbrain:slo_error_budget_burn{class=}       gauge (burn rate)
+  foremastbrain:slo_violations_total{class=}        counter
+
+Burn rate is the standard SRE ratio: observed violation rate over the
+budgeted violation rate (1 - objective). 1.0 = burning exactly the
+budget; >1 = the error budget shrinks; a sustained burn >> 1 is the
+page. Pure observation: nothing here feeds back into scoring, so the
+verdict A/B identity contract (tests/test_provenance.py) covers it.
+
+This is the latency baseline ROADMAP item 4 (streaming dataplane) must
+beat — measured before improved, per SWIFT's trace-first methodology.
+"""
+from __future__ import annotations
+
+import bisect
+
+from ..dataplane.exporter import DEFAULT_TIME_BUCKETS
+from ..utils.locks import make_lock
+
+__all__ = ["DetectionSLO", "classify", "SLO_CLASSES"]
+
+SLO_CLASSES = ("canary", "continuous", "hpa")
+
+
+def classify(strategy: str) -> str:
+    """Job class for SLO accounting from the wire strategy."""
+    if strategy == "hpa":
+        return "hpa"
+    if strategy == "continuous":
+        return "continuous"
+    return "canary"  # rollingUpdate / canary / rollover
+
+
+class DetectionSLO:
+    """Per-class ingest->verdict latency distributions + SLO math.
+
+    The engine worker writes (observe); HTTP/CLI threads read (snapshot,
+    quantile). All reads copy under the lock. Allocation-bounded by
+    construction: three classes x one fixed bucket grid."""
+
+    def __init__(self, exporter=None, targets: dict | None = None,
+                 objective: float = 0.99,
+                 buckets: tuple = DEFAULT_TIME_BUCKETS):
+        self.exporter = exporter
+        self.targets = dict(targets or {})
+        # objective clamped to (0, 1): 1.0 would make the budget zero and
+        # every burn infinite; 0 would make attainment meaningless
+        self.objective = min(max(float(objective), 0.0), 0.999999)
+        self._edges = tuple(buckets)
+        self._lock = make_lock("engine.slo")
+        # class -> [bucket counts (+Inf implicit last)], sum, count,
+        # violations (latency > target)
+        self._counts: dict[str, list] = {}
+        self._sums: dict[str, float] = {}
+        self._totals: dict[str, int] = {}
+        self._violations: dict[str, int] = {}
+
+    # -------------------------------------------------------------- writing
+    def observe(self, cls: str, latency_s: float):
+        """One ingest->verdict observation for a job of class `cls`."""
+        v = max(float(latency_s), 0.0)
+        target = float(self.targets.get(cls, 0.0))
+        violated = target > 0 and v > target
+        with self._lock:
+            counts = self._counts.get(cls)
+            if counts is None:
+                counts = self._counts[cls] = [0] * (len(self._edges) + 1)
+                self._sums[cls] = 0.0
+                self._totals[cls] = 0
+                self._violations[cls] = 0
+            counts[bisect.bisect_left(self._edges, v)] += 1
+            self._sums[cls] += v
+            self._totals[cls] += 1
+            if violated:
+                self._violations[cls] += 1
+            attainment = 1.0 - self._violations[cls] / self._totals[cls]
+        if self.exporter is not None:
+            self.exporter.record_histogram(
+                "foremastbrain:detection_latency_seconds", {"class": cls}, v,
+                help="Window-advance (newest judged sample) to verdict "
+                     "latency per job class (seconds).",
+                buckets=self._edges)
+            if violated:
+                self.exporter.record_counter(
+                    "foremastbrain:slo_violations_total", {"class": cls},
+                    help="verdicts that landed outside the class's "
+                         "detection-latency SLO target")
+            self._export_gauges(cls, attainment)
+
+    def _export_gauges(self, cls: str, attainment: float):
+        self.exporter.record_gauge(
+            "foremastbrain:slo_attainment", {"class": cls},
+            round(attainment, 6),
+            help="Fraction of verdicts inside the class's detection-"
+                 "latency SLO target (cumulative).")
+        self.exporter.record_gauge(
+            "foremastbrain:slo_error_budget_burn", {"class": cls},
+            round(self._burn_from(attainment), 4),
+            help="Error-budget burn rate: observed violation rate over "
+                 "the budgeted rate (1 - SLO_OBJECTIVE); >1 = budget "
+                 "shrinking.")
+
+    def _burn_from(self, attainment: float) -> float:
+        budget = 1.0 - self.objective
+        return (1.0 - attainment) / budget if budget > 0 else 0.0
+
+    # -------------------------------------------------------------- reading
+    def quantile(self, q: float, cls: str | None = None) -> float:
+        """Bucket-resolution quantile estimate (seconds): the upper edge
+        of the bucket the q-th observation lands in. `cls=None` pools
+        every class. 0.0 when nothing was observed."""
+        with self._lock:
+            if cls is None:
+                rows = list(self._counts.values())
+            else:
+                rows = [self._counts[cls]] if cls in self._counts else []
+            if not rows:
+                return 0.0
+            counts = [sum(r[i] for r in rows)
+                      for i in range(len(self._edges) + 1)]
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                # +Inf bucket: report the last finite edge (the estimate
+                # is a floor, which is the honest direction for an SLO)
+                return float(self._edges[min(i, len(self._edges) - 1)])
+        return float(self._edges[-1])
+
+    def attainment(self, cls: str) -> float:
+        with self._lock:
+            n = self._totals.get(cls, 0)
+            if n == 0:
+                return 1.0
+            return 1.0 - self._violations.get(cls, 0) / n
+
+    def burn(self, cls: str) -> float:
+        return self._burn_from(self.attainment(cls))
+
+    def burn_summary(self) -> dict:
+        """{class: burn} for classes with observations — the HealthMonitor
+        detail tap (informational, never a state driver; empty before the
+        first verdict so existing health-detail consumers see no change)."""
+        with self._lock:
+            have = [c for c, n in self._totals.items() if n]
+        return {c: round(self.burn(c), 4) for c in sorted(have)}
+
+    def snapshot(self) -> dict:
+        """Full /status section: per-class distribution + SLO math, plus
+        the configured targets even before the first observation (the
+        operator should see the knobs, not an empty object)."""
+        with self._lock:
+            classes = sorted(set(self._totals) | set(self.targets))
+            totals = dict(self._totals)
+            sums = dict(self._sums)
+            violations = dict(self._violations)
+        out = {"objective": self.objective, "classes": {}}
+        for cls in classes:
+            n = totals.get(cls, 0)
+            att = (1.0 - violations.get(cls, 0) / n) if n else 1.0
+            out["classes"][cls] = {
+                "target_s": self.targets.get(cls, 0.0),
+                "count": n,
+                "violations": violations.get(cls, 0),
+                "p50_s": round(self.quantile(0.5, cls), 4),
+                "p99_s": round(self.quantile(0.99, cls), 4),
+                "mean_s": round(sums.get(cls, 0.0) / n, 4) if n else 0.0,
+                "attainment": round(att, 6),
+                "burn": round(self._burn_from(att), 4),
+            }
+        return out
+
+    def digest(self) -> dict:
+        """Compact per-class block for the fleet status digest (rides the
+        membership heartbeat blob — must stay small)."""
+        with self._lock:
+            have = sorted(c for c, n in self._totals.items() if n)
+        out = {}
+        for cls in have:
+            att = self.attainment(cls)
+            out[cls] = {
+                "p50_s": round(self.quantile(0.5, cls), 4),
+                "p99_s": round(self.quantile(0.99, cls), 4),
+                "attainment": round(att, 6),
+                "burn": round(self._burn_from(att), 4),
+                "n": self._totals.get(cls, 0),
+            }
+        return out
+
+    def refresh_metrics(self):
+        """Re-stamp the SLO gauges at scrape time (gauges are time-staled
+        by the exporter; a quiet fleet must not scrape away its
+        attainment history)."""
+        if self.exporter is None:
+            return
+        with self._lock:
+            have = [c for c, n in self._totals.items() if n]
+        for cls in have:
+            self._export_gauges(cls, self.attainment(cls))
+
+    def reset(self):
+        """Clear observations (bench legs isolate their measured cycles
+        from warm-up; the exporter's cumulative series are untouched)."""
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
+            self._violations.clear()

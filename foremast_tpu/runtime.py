@@ -30,6 +30,13 @@ Env surface (union of the reference services'):
   MEMBER_TTL_S           released_at handoffs, dead-holder adoption at
                          TTL latency (docs/operations.md "Running
                          multiple replicas")
+  FLEET_DIGEST           publish the status digest in membership
+                         heartbeats — the GET /fleet federation medium
+                         (docs/operations.md "Watching the whole fleet")
+  SLO_CANARY_S /         detection-latency SLO targets per job class and
+  SLO_CONTINUOUS_S /     the attainment objective the error budget
+  SLO_HPA_S /            derives from (engine/slo.py; histograms + burn
+  SLO_OBJECTIVE          gauges on /metrics, slo section on /status)
   JOB_RETENTION_SECONDS  prune archived terminal jobs from RAM after this
   PORT                   HTTP port (reference :8099)
   GRPC_PORT              gRPC dispatch port (0/unset disables; 8100 in the
@@ -120,6 +127,7 @@ class Runtime:
         heartbeat_seconds: float = 5.0,
         member_ttl_seconds: float = 15.0,
         static_replicas=None,
+        fleet_digest: bool = True,
     ):
         self.config = config or from_env()
         # persistent XLA compile cache (COMPILE_CACHE_PATH): point the
@@ -276,6 +284,15 @@ class Runtime:
                 member_ttl_seconds=member_ttl_seconds,
                 static_members=static_replicas,
                 flight=self.analyzer.flight,
+                # fleet federation: the status digest rides the membership
+                # heartbeat blob (FLEET_DIGEST=0 keeps heartbeats minimal);
+                # cycle ids correlate both sides' handoff/adoption flight
+                # events; released Documents carry their provenance chain
+                # (+ an explicit handoff hop) to the adopter's `explain`
+                digest_fn=(self.analyzer.status_digest
+                           if fleet_digest else None),
+                cycle_id_fn=lambda: self.analyzer.current_cycle_id,
+                handoff_content_fn=self._handoff_content("rebalance"),
             )
             self.analyzer.shard = self.shard
             self.analyzer.health.configure(
@@ -319,6 +336,19 @@ class Runtime:
         self._server = None
         self._grpc_server = None
         self.grpc_bound_port: int | None = None
+
+    def _handoff_content(self, reason: str):
+        """(job_id) -> provenance handoff blob for Documents this replica
+        releases — the job's decision chain plus an explicit handoff hop
+        naming this replica/worker/cycle (engine/provenance.py). Returns
+        a callable so the blob always stamps the CURRENT worker name
+        (start() may rename it after construction)."""
+        def content(job_id: str) -> str:
+            return self.analyzer.provenance.handoff_json(
+                job_id, replica=self.replica_id, worker=self._worker_name,
+                reason=reason)
+
+        return content
 
     # -- lifecycle --
     def start(self, host: str = "0.0.0.0", port: int = 8099,
@@ -439,6 +469,17 @@ class Runtime:
                         and self.store.archive is not None
                         and t0 - self._last_adopt >= self.adopt_interval_seconds):
                     self._last_adopt = t0
+                    adopted_ids: list[str] = []
+
+                    def _on_adopt(doc):
+                        # handoff-surviving provenance: the blob the
+                        # releasing replica attached travels back into
+                        # our recorder, so `explain` here shows the full
+                        # chain including the handoff hop
+                        adopted_ids.append(doc.id)
+                        self.analyzer.provenance.adopt(
+                            doc.id, doc.processing_content)
+
                     n = self.store.adopt_stale_from_archive(
                         worker=worker,
                         max_stuck_seconds=self.config.max_stuck_seconds,
@@ -447,9 +488,10 @@ class Runtime:
                                  if self.shard is not None else None),
                         dead_holder_fn=(self.shard.dead_holder
                                         if self.shard is not None else None),
+                        on_adopt=_on_adopt,
                     )
                     if self.shard is not None:
-                        self.shard.mark_adopt_complete(n)
+                        self.shard.mark_adopt_complete(n, jobs=adopted_ids)
                     if n:
                         log.info("adopted %d stale job(s) from the archive",
                                  n)
@@ -521,13 +563,18 @@ class Runtime:
             # on the `left` mark instead of waiting out MEMBER_TTL_S
             self.shard.withdraw()
         if self.store.archive is not None:
-            released = self.store.release_leases(worker=self._worker_name)
+            released = self.store.release_leases(
+                worker=self._worker_name,
+                # the shutdown handoff carries each job's provenance chain
+                # + an explicit handoff hop to the adopting peer's explain
+                content_fn=self._handoff_content("shutdown"))
             if released:
                 from .engine.flightrec import EVENT_LEASE_HANDOFF
 
                 self.analyzer.flight.record_event(
                     EVENT_LEASE_HANDOFF, released=released,
-                    worker=self._worker_name)
+                    worker=self._worker_name,
+                    cycle_id=self.analyzer.current_cycle_id)
                 log.info("released %d open lease(s) for peer adoption",
                          released)
             # drain the write-behind mirror: the release stamps above (and
@@ -641,6 +688,7 @@ def main():
         heartbeat_seconds=knobs.read("HEARTBEAT_S"),
         member_ttl_seconds=knobs.read("MEMBER_TTL_S"),
         static_replicas=static_replicas,
+        fleet_digest=knobs.read("FLEET_DIGEST"),
     )
     proxy = knobs.read("WAVEFRONT_PROXY")
     if proxy:

@@ -6,9 +6,15 @@ Endpoints (reference: foremast-service/cmd/manager/main.go:326-346):
   GET  /alert/<app>/<namespace>/<strategy>   recent HPA logs for the app
   GET  /api/v1/<queryproxy>?...        CORS proxy to the metric store
   GET  /metrics                        foremastbrain:* verdict series
+                                       (Prometheus 0.0.4 content type)
   GET  /status                         degradation view: job counts +
                                        breaker states + retry counters +
-                                       health state machine
+                                       health state machine + SLO section
+  GET  /fleet                          cross-replica federation view:
+                                       every replica's status digest
+                                       (from the membership heartbeats)
+                                       + staleness + an aggregate block
+  GET  /debug/flight/dumps[/<name>]    on-disk incident-dump index/fetch
   GET  /healthz                        liveness (is the process up)
   GET  /readyz                         readiness: the degraded-mode health
                                        state (ok/degraded -> 200,
@@ -426,7 +432,8 @@ class ForemastService:
         # re-stamp breaker-state gauges at scrape time: an idle open
         # breaker fires no transitions, and a stale-evicted state gauge
         # would clear dashboards while the circuit is still open
-        for holder in (self.resilience, getattr(self.store, "archive", None)):
+        for holder in (self.resilience, getattr(self.store, "archive", None),
+                       getattr(self.analyzer, "slo", None)):
             refresh = getattr(holder, "refresh_metrics", None)
             if refresh is not None:
                 refresh()
@@ -631,6 +638,12 @@ class ForemastService:
             # pipeline's preprocess/dispatch/collect/fold split) — same
             # numbers as the foremastbrain:cycle_stage_seconds gauges
             out["cycle"] = self.analyzer.last_cycle_stages
+        slo = getattr(self.analyzer, "slo", None)
+        if slo is not None:
+            # detection-latency SLOs: per-class ingest->verdict p50/p99,
+            # attainment vs target, and error-budget burn (engine/slo.py;
+            # docs/operations.md "Watching the whole fleet")
+            out["slo"] = slo.snapshot()
         if self.delta_source is not None:
             # steady-state incremental fetch health: hit ratio, bytes not
             # re-downloaded, and why any full refetches happened
@@ -746,6 +759,86 @@ class ForemastService:
                                    if recorder is not None else False),
         }
 
+    _HEALTH_ORDER = {"ok": 0, "degraded": 1, "overloaded": 2, "stalled": 3}
+
+    def fleet(self):
+        """GET /fleet — the whole fleet from ANY replica: one row per
+        replica with its published status digest and the digest's age
+        (stale = age past MEMBER_TTL_S, or a graceful `left` mark), plus
+        an aggregate block (worst health, summed jobs, pooled SLO view).
+        Digests travel on the membership heartbeat blobs every replica
+        already writes into the shared archive (engine/sharding.py), so
+        federation costs zero extra infrastructure. A single-replica
+        runtime (no shard layer) serves its own live digest, so the
+        endpoint — and `foremast-tpu top` — work identically at N=1."""
+        if self.shard is not None:
+            snap = self.shard.fleet_snapshot()
+        else:
+            digest = {}
+            builder = getattr(self.analyzer, "status_digest", None)
+            if builder is not None:
+                digest = builder()
+            snap = {
+                "replica": "local",
+                "membership": "solo",
+                "membership_fresh": True,
+                "member_ttl_seconds": 0.0,
+                "heartbeat_seconds": 0.0,
+                "replicas": [{
+                    "replica": "local", "worker": "", "age_s": 0.0,
+                    "left": False, "stale": False, "self": True,
+                    "digest": digest,
+                }],
+            }
+        rows = snap["replicas"]
+        fresh = [r for r in rows if not r.get("stale")]
+        digests = [r.get("digest") or {} for r in fresh]
+        jobs_total: dict[str, int] = {}
+        for d in digests:
+            for status, n in (d.get("jobs") or {}).items():
+                jobs_total[status] = jobs_total.get(status, 0) + int(n)
+        healths = [d.get("health") for d in digests if d.get("health")]
+        worst = max(healths, key=lambda h: self._HEALTH_ORDER.get(h, 0),
+                    default="unknown")
+        slo_worst: dict[str, dict] = {}
+        for d in digests:
+            for cls, s in (d.get("slo") or {}).items():
+                cur = slo_worst.get(cls)
+                if cur is None or s.get("burn", 0.0) > cur.get("burn", 0.0):
+                    slo_worst[cls] = dict(s)
+        shards_owned = sum((d.get("shards") or {}).get("owned", 0)
+                           for d in digests)
+        snap["aggregate"] = {
+            "replicas": len(rows),
+            "replicas_fresh": len(fresh),
+            "replicas_stale": len(rows) - len(fresh),
+            "worst_health": worst,
+            "jobs": jobs_total,
+            "shards_owned": shards_owned,
+            # per class: the replica with the WORST burn speaks for the
+            # fleet (an SLO is only as met as its least-met slice)
+            "slo_worst": slo_worst,
+        }
+        return 200, snap
+
+    def debug_flight_dumps(self, name: str = ""):
+        """GET /debug/flight/dumps[/<name>] — index of the on-disk
+        incident dumps (name, age, trigger), and one dump's full payload
+        by name. Operators no longer shell into the pod for historical
+        dumps; the live ring stays at /debug/flight."""
+        flight = getattr(self.analyzer, "flight", None)
+        if flight is None:
+            if name:
+                return 404, {"error": "no flight recorder on this runtime"}
+            return 200, {"dump_dir": "", "dumps": []}
+        if name:
+            payload = flight.read_dump(name)
+            if payload is None:
+                return 404, {"error": f"no flight dump {name!r}"}
+            return 200, payload
+        return 200, {"dump_dir": flight.dump_dir,
+                     "dumps": flight.list_dumps()}
+
     def debug_flight(self, limit: int = 100):
         """GET /debug/flight — the incident flight recorder's live ring
         (events newest-last) + dump bookkeeping."""
@@ -810,7 +903,19 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
                     ct = "text/html; charset=utf-8" if status == 200 else None
                     self._send(status, payload, content_type=ct)
                 elif parsed.path == "/metrics":
-                    self._send(*service.metrics())
+                    status, payload = service.metrics()
+                    # the Prometheus exposition content type (0.0.4) —
+                    # strict scrapers (and the OpenMetrics negotiation
+                    # path) key on it, not on a bare text/plain
+                    self._send(status, payload, content_type=(
+                        "text/plain; version=0.0.4; charset=utf-8"))
+                elif parsed.path == "/fleet":
+                    self._send(*service.fleet())
+                elif parsed.path == "/debug/flight/dumps":
+                    self._send(*service.debug_flight_dumps())
+                elif parts[:3] == ["debug", "flight", "dumps"] \
+                        and len(parts) == 4:
+                    self._send(*service.debug_flight_dumps(parts[3]))
                 elif parsed.path == "/debug/traces":
                     q = parse_qs(parsed.query)
                     try:

@@ -208,6 +208,10 @@ register("HEARTBEAT_S", 5.0, float,
 register("MEMBER_TTL_S", 15.0, float,
          "heartbeat age past which a replica is presumed dead and its "
          "shards rebalance")
+register("FLEET_DIGEST", True, parse_bool,
+         "publish this replica's status digest (health, golden signals, "
+         "SLO attainment) in its membership heartbeat blob — the GET "
+         "/fleet federation medium; 0 keeps heartbeats liveness-only")
 
 # -- multi-host world (parallel/distributed.py) --
 register("COORDINATOR_ADDRESS", "", str,

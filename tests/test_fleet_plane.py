@@ -1,0 +1,589 @@
+"""Fleet observability plane (ISSUE 10): detection-latency SLOs,
+cross-replica federation, handoff-surviving provenance.
+
+Three connected layers over the sharded brain:
+  * engine/slo.py — ingest->verdict latency per job class, SLO targets,
+    error-budget burn (the baseline the streaming dataplane must beat);
+  * GET /fleet + `foremast-tpu top` — every replica's status digest,
+    published on the membership heartbeat blobs, aggregated from ANY
+    replica, with explicit staleness semantics;
+  * provenance handoff hops — a job's "why" (and the releasing
+    replica's cycle id) travels with the Document through lease
+    release/adoption, so `explain` on the adopter shows the full chain.
+
+Plus the satellites: Prometheus exposition content type + scrape
+grammar, the on-disk flight-dump index, and bench honesty for latency.
+"""
+from __future__ import annotations
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from foremast_tpu.dataplane import FixtureDataSource, VerdictExporter
+from foremast_tpu.engine import (
+    Analyzer,
+    Document,
+    EngineConfig,
+    JobStore,
+    MetricQueries,
+)
+from foremast_tpu.engine import jobs as J
+from foremast_tpu.engine.archive import FileArchive
+from foremast_tpu.engine.flightrec import (
+    EVENT_LEASE_HANDOFF,
+    EVENT_SHARD_ADOPTION,
+    FlightRecorder,
+)
+from foremast_tpu.engine.sharding import ShardManager
+from foremast_tpu.engine.slo import DetectionSLO, classify
+from foremast_tpu.service.api import ForemastService, serve_background
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+STEP = 60
+SEED = 20260804
+
+
+def _series(rng, level, n):
+    ts = np.arange(n) * STEP
+    vals = np.clip(rng.normal(level, level * 0.1 + 0.01, n), 0, None)
+    return ts.tolist(), vals.tolist()
+
+
+def _mk_job(store, fixtures, job_id, *, bad=False, strategy="canary",
+            end_time=10_000_000.0, rng=None):
+    rng = rng or np.random.default_rng(SEED)
+    cur = f"http://prom:9090/{job_id}/cur"
+    base = f"http://prom:9090/{job_id}/base"
+    fixtures[cur] = _series(rng, 5.0 if bad else 0.5, 30)
+    fixtures[base] = _series(rng, 0.5, 30)
+    continuous = strategy in ("continuous", "hpa")
+    store.create(Document(
+        id=job_id, app_name=f"app-{job_id}", namespace="fleet",
+        strategy=strategy,
+        start_time="START_TIME" if continuous else to_rfc3339(0.0),
+        end_time="END_TIME" if continuous else to_rfc3339(end_time),
+        metrics={"error5xx": MetricQueries(current=cur, baseline=base)},
+    ))
+
+
+def _mk_hpa_job(store, fixtures, job_id):
+    rng = np.random.default_rng(5)
+    tps_url = f"http://prom/{job_id}/tps"
+    sla_url = f"http://prom/{job_id}/sla"
+    hist_ts, hist_v = _series(rng, 100.0, 90)
+    cur_ts = [t + hist_ts[-1] + STEP for t in np.arange(30) * STEP]
+    fixtures[tps_url] = (
+        hist_ts + list(cur_ts),
+        hist_v + np.random.default_rng(1).normal(240, 5, 30).tolist())
+    fixtures[sla_url] = _series(rng, 5.0, 120)
+    store.create(Document(
+        id=job_id, app_name="app", namespace="fleet", strategy="hpa",
+        start_time="START_TIME", end_time="END_TIME",
+        metrics={
+            "tps": MetricQueries(historical=tps_url, current=tps_url),
+            "latency": MetricQueries(historical=sla_url, current=sla_url,
+                                     priority=1),
+        },
+    ))
+
+
+def _analyzer(fixtures, store, **cfg):
+    cfg.setdefault("max_stuck_seconds", 1e9)
+    return Analyzer(EngineConfig(**cfg), FixtureDataSource(fixtures), store,
+                    VerdictExporter())
+
+
+# ------------------------------------------------------- detection SLO unit
+
+def test_slo_quantiles_attainment_burn():
+    slo = DetectionSLO(targets={"canary": 0.5}, objective=0.99)
+    for v in (0.01, 0.02, 0.3, 0.6, 2.0):
+        slo.observe("canary", v)
+    # bucket-resolution estimates: upper edge of the rank's bucket
+    assert slo.quantile(0.5, "canary") == 0.5
+    assert slo.quantile(0.99, "canary") == 2.5
+    assert slo.attainment("canary") == pytest.approx(0.6)
+    # 40% violations against a 1% budget = 40x burn
+    assert slo.burn("canary") == pytest.approx(40.0)
+    snap = slo.snapshot()["classes"]["canary"]
+    assert snap["count"] == 5 and snap["violations"] == 2
+    assert snap["target_s"] == 0.5
+    # pooled quantile spans classes; summaries list only observed ones
+    slo.observe("hpa", 0.001)
+    assert slo.quantile(0.0, None) == 0.001
+    assert set(slo.burn_summary()) == {"canary", "hpa"}
+    assert set(slo.digest()) == {"canary", "hpa"}
+    slo.reset()
+    assert slo.quantile(0.5, "canary") == 0.0
+    assert slo.burn_summary() == {}
+
+
+def test_slo_no_target_never_violates():
+    slo = DetectionSLO(targets={}, objective=0.99)
+    slo.observe("continuous", 1e6)
+    assert slo.attainment("continuous") == 1.0
+    assert slo.burn("continuous") == 0.0
+
+
+def test_slo_exporter_series():
+    ex = VerdictExporter()
+    slo = DetectionSLO(exporter=ex, targets={"canary": 0.1})
+    slo.observe("canary", 0.5)
+    rendered = ex.render()
+    assert "foremastbrain:detection_latency_seconds_bucket" in rendered
+    assert 'foremastbrain:slo_attainment{class="canary"} 0.0' in rendered
+    assert 'foremastbrain:slo_violations_total{class="canary"} 1' in rendered
+    assert "foremastbrain:slo_error_budget_burn" in rendered
+
+
+def test_classify_strategies():
+    assert classify("hpa") == "hpa"
+    assert classify("continuous") == "continuous"
+    for s in ("canary", "rollingUpdate", "rollover"):
+        assert classify(s) == "canary"
+
+
+# ------------------------------------------- engine latency instrumentation
+
+def test_detection_latency_recorded_for_every_job_class():
+    fixtures, store = {}, JobStore()
+    an = _analyzer(fixtures, store)
+    _mk_job(store, fixtures, "c1", bad=True, end_time=5000.0)
+    _mk_job(store, fixtures, "m1", strategy="continuous")
+    _mk_hpa_job(store, fixtures, "app:fleet:hpa")
+    out = an.run_cycle(worker="w", now=0.0)
+    assert out["c1"] == J.COMPLETED_UNHEALTH
+    assert out["m1"] == J.INITIAL
+    assert out["app:fleet:hpa"] == J.INITIAL
+    # non-empty histogram per class — the acceptance criterion
+    dig = an.slo.digest()
+    assert set(dig) == {"canary", "continuous", "hpa"}
+    assert all(d["n"] >= 1 for d in dig.values())
+    # the latency annotation rides the provenance record AND the archived
+    # terminal summary
+    rec = an.provenance.get("c1")
+    assert rec["detection_latency_s"] > 0.0
+    attached = json.loads(store.get("c1").processing_content)
+    assert attached["detection_latency_s"] == rec["detection_latency_s"]
+    # surfaces: /status slo section + health-detail burn + /metrics
+    svc = ForemastService(store, exporter=an.exporter, analyzer=an)
+    _, status = svc.status_summary()
+    assert status["slo"]["classes"]["canary"]["count"] >= 1
+    assert status["slo"]["classes"]["canary"]["target_s"] == \
+        an.config.slo_canary_seconds
+    _, detail = an.health.state()
+    assert set(detail["slo_burn"]) == {"canary", "continuous", "hpa"}
+    _, metrics = svc.metrics()
+    assert "foremastbrain:detection_latency_seconds_bucket" in metrics
+
+
+def test_verdicts_identical_with_plane_observing_vs_provenance_off():
+    """The plane only OBSERVES: statuses/reasons/anomalies byte-identical
+    with PROVENANCE=0 (SLO recording is always-on and must not feed
+    back either)."""
+    outs = {}
+    for flag in (True, False):
+        fixtures, store = {}, JobStore()
+        an = _analyzer(fixtures, store, provenance=flag)
+        rng = np.random.default_rng(99)
+        for i in range(6):
+            _mk_job(store, fixtures, f"j{i}", bad=(i % 3 == 0),
+                    end_time=5000.0, rng=rng)
+        an.run_cycle(worker="w", now=1000.0)
+        an.run_cycle(worker="w", now=6000.0)
+        outs[flag] = {
+            d.id: (d.status, d.reason, sorted(d.anomaly.items()))
+            for d in store.by_status(*J.OPEN_STATUSES, *J.TERMINAL_STATUSES)}
+    assert outs[True] == outs[False]
+
+
+# ------------------------------------------------------ federation / /fleet
+
+def _manager(path, rid, digest=None, **kw):
+    store = JobStore(archive=FileArchive(path))
+    kw.setdefault("shard_count", 8)
+    kw.setdefault("vnodes", 16)
+    kw.setdefault("heartbeat_seconds", 0.0)  # heartbeat every tick
+    kw.setdefault("member_ttl_seconds", 5.0)
+    return ShardManager(store, rid, digest_fn=digest, **kw)
+
+
+def test_fleet_snapshot_digests_staleness_and_ttl(tmp_path):
+    path = str(tmp_path / "a.jsonl")
+    clock = {"now": 1000.0}
+    A = _manager(path, "A", digest=lambda: {"health": "ok", "who": "A"},
+                 clock=lambda: clock["now"])
+    B = _manager(path, "B", digest=lambda: {"health": "degraded",
+                                            "who": "B"},
+                 clock=lambda: clock["now"])
+    for _ in range(2):
+        A.tick()
+        B.tick()
+    snap = A.fleet_snapshot()
+    rows = {r["replica"]: r for r in snap["replicas"]}
+    assert set(rows) == {"A", "B"}
+    assert rows["A"]["self"] and rows["A"]["digest"]["who"] == "A"
+    assert not rows["B"]["stale"]
+    assert rows["B"]["digest"] == {"health": "degraded", "who": "B"}
+    assert rows["B"]["age_s"] <= snap["member_ttl_seconds"]
+
+    # graceful leave flips the row stale immediately
+    B.withdraw()
+    A._last_read = None  # force a fresh membership read
+    A.tick()
+    rows = {r["replica"]: r for r in A.fleet_snapshot()["replicas"]}
+    assert rows["B"]["left"] and rows["B"]["stale"]
+
+    # kill -9 (no withdraw): stale within MEMBER_TTL_S of the last beat
+    C = _manager(path, "C", digest=lambda: {"health": "ok"},
+                 clock=lambda: clock["now"])
+    C.tick()
+    A._last_read = None
+    A.tick()
+    rows = {r["replica"]: r for r in A.fleet_snapshot()["replicas"]}
+    assert not rows["C"]["stale"]
+    del C  # kill -9: heartbeats simply stop
+    clock["now"] += A.member_ttl_seconds + 1.0
+    A._last_read = None
+    A.tick()
+    rows = {r["replica"]: r for r in A.fleet_snapshot()["replicas"]}
+    assert rows["C"]["stale"] and not rows["C"]["left"]
+    assert rows["C"]["age_s"] > A.member_ttl_seconds
+
+
+def test_fleet_endpoint_aggregates_and_serves_over_http(tmp_path):
+    path = str(tmp_path / "a.jsonl")
+    fixtures, store = {}, JobStore()
+    an = _analyzer(fixtures, store)
+    _mk_job(store, fixtures, "c1", bad=True, end_time=5000.0)
+    an.run_cycle(worker="A", now=1000.0)
+    A = _manager(path, "A", digest=an.status_digest)
+    B = _manager(path, "B",
+                 digest=lambda: {"health": "overloaded",
+                                 "jobs": {"initial": 3},
+                                 "slo": {"canary": {"p50_s": 1.0,
+                                                    "p99_s": 9.0,
+                                                    "burn": 7.5}},
+                                 "shards": {"owned": 4}})
+    an.shard = A
+    for _ in range(2):
+        A.tick()
+        B.tick()
+    svc = ForemastService(store, exporter=an.exporter, analyzer=an, shard=A)
+    code, payload = svc.fleet()
+    assert code == 200
+    agg = payload["aggregate"]
+    assert agg["replicas"] == 2 and agg["replicas_fresh"] == 2
+    # worst-wins across fresh digests
+    assert agg["worst_health"] == "overloaded"
+    assert agg["jobs"]["initial"] >= 3  # summed across replicas
+    assert agg["slo_worst"]["canary"]["burn"] == 7.5
+    assert agg["shards_owned"] >= 4
+
+    server = serve_background(svc, host="127.0.0.1", port=0)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=10) as r:
+            wire = json.loads(r.read().decode())
+        assert {row["replica"] for row in wire["replicas"]} == {"A", "B"}
+        assert wire["aggregate"]["worst_health"] == "overloaded"
+    finally:
+        server.shutdown()
+
+
+def test_fleet_endpoint_single_replica_serves_local_digest():
+    fixtures, store = {}, JobStore()
+    an = _analyzer(fixtures, store)
+    _mk_job(store, fixtures, "m1", strategy="continuous")
+    an.run_cycle(worker="w", now=1000.0)
+    svc = ForemastService(store, exporter=an.exporter, analyzer=an)
+    code, payload = svc.fleet()
+    assert code == 200
+    (row,) = payload["replicas"]
+    assert row["self"] and not row["stale"]
+    assert row["digest"]["health"] == "ok"
+    assert row["digest"]["cycle_id"] == "w-c1"
+    assert payload["aggregate"]["replicas_fresh"] == 1
+
+
+def test_top_cli_renders_fleet(capsys):
+    from foremast_tpu import cli
+
+    fixtures, store = {}, JobStore()
+    an = _analyzer(fixtures, store)
+    _mk_job(store, fixtures, "m1", strategy="continuous")
+    an.run_cycle(worker="w", now=1000.0)
+    svc = ForemastService(store, exporter=an.exporter, analyzer=an)
+    server = serve_background(svc, host="127.0.0.1", port=0)
+    try:
+        port = server.server_address[1]
+        rc = cli.main(["top", "--endpoint", f"http://127.0.0.1:{port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "worst health ok" in out
+        assert "local *" in out  # the self row
+        assert "REPLICA" in out and "DETECT p50/p99" in out
+        rc = cli.main(["top", "--json",
+                       "--endpoint", f"http://127.0.0.1:{port}"])
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["aggregate"]["worst_health"] == "ok"
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------- handoff-surviving provenance (hops)
+
+def _replica(path, fixtures, rid):
+    store = JobStore(archive=FileArchive(path))
+    an = _analyzer(fixtures, store)
+    return store, an
+
+
+def test_cross_replica_explain_pins_full_chain(tmp_path):
+    """Two JobStores over one FileArchive: scored on A, handed off,
+    re-scored on B — `explain` on B names the handoff hop AND A's
+    cycle_id alongside B's own (the acceptance-criteria chain)."""
+    path = str(tmp_path / "shared.jsonl")
+    fixtures = {}
+    sA, aA = _replica(path, fixtures, "A")
+    sB, aB = _replica(path, fixtures, "B")
+    _mk_job(sA, fixtures, "watch", strategy="continuous")
+    _mk_job(sA, fixtures, "roll", end_time=5000.0)
+    aA.run_cycle(worker="A", now=1000.0)
+    assert aA.provenance.get("watch")["cycle"]["cycle_id"] == "A-c1"
+
+    # graceful handoff (the runtime.stop path): the provenance summary +
+    # handoff hop travel on the released Documents
+    released = sA.release_leases(
+        worker="A",
+        content_fn=lambda jid: aA.provenance.handoff_json(
+            jid, replica="repA", worker="A", reason="shutdown"))
+    assert released == 2
+    sA.flush()
+
+    adopted_blobs = {}
+    n = sB.adopt_stale_from_archive(
+        worker="B",
+        on_adopt=lambda d: (adopted_blobs.__setitem__(
+            d.id, d.processing_content),
+            aB.provenance.adopt(d.id, d.processing_content)))
+    assert n == 2
+    assert "handoff" in adopted_blobs["watch"]
+    aB.run_cycle(worker="B", now=1060.0)
+
+    svc = ForemastService(sB, exporter=aB.exporter, analyzer=aB)
+    code, payload = svc.explain("watch")
+    assert code == 200
+    rec = payload["provenance"]
+    assert rec["cycle"]["cycle_id"] == "B-c1"  # B's own judgment
+    (hop,) = rec["hops"]
+    assert hop["replica"] == "repA"
+    assert hop["cycle_id"] == "A-c1"  # the originating replica's cycle
+    assert hop["reason"] == "shutdown"
+
+    # the chain survives into B's ARCHIVED terminal record too
+    aB.run_cycle(worker="B", now=6000.0)  # past roll's endTime
+    arec = FileArchive(path).get("roll")
+    assert arec["status"] in J.TERMINAL_STATUSES
+    attached = json.loads(arec["processing_content"])
+    assert attached["hops"][0]["cycle_id"] == "A-c1"
+
+    # CLI rendering names the hop
+    from foremast_tpu.cli import _render_explain
+    out = _render_explain(payload)
+    assert "handoff: from repA cycle A-c1 (shutdown" in out
+
+
+def test_rebalance_handoff_carries_chain_and_cycle_ids(tmp_path):
+    """The shard-rebalance handoff path: ShardManager releases non-owned
+    jobs WITH the provenance blob, and both sides' flight events carry
+    correlatable cycle ids."""
+    path = str(tmp_path / "shared.jsonl")
+    fixtures = {}
+    sA, aA = _replica(path, fixtures, "A")
+    flightA = aA.flight
+    A = ShardManager(
+        sA, "A", shard_count=8, vnodes=16, heartbeat_seconds=0.0,
+        member_ttl_seconds=5.0, worker="A", flight=flightA,
+        digest_fn=aA.status_digest,
+        cycle_id_fn=lambda: aA.current_cycle_id,
+        handoff_content_fn=lambda jid: aA.provenance.handoff_json(
+            jid, replica="A", worker="A", reason="rebalance"))
+    aA.shard = A
+    A.tick()
+    # a fleet big enough that a joining peer takes some of it
+    rng = np.random.default_rng(3)
+    for i in range(12):
+        _mk_job(sA, fixtures, f"w{i}", strategy="continuous", rng=rng)
+    aA.run_cycle(worker="A", now=1000.0)
+
+    sB, aB = _replica(path, fixtures, "B")
+    B = ShardManager(
+        sB, "B", shard_count=8, vnodes=16, heartbeat_seconds=0.0,
+        member_ttl_seconds=5.0, worker="B", flight=aB.flight,
+        cycle_id_fn=lambda: aB.current_cycle_id)
+    B.tick()
+    A.tick()  # sees B: rebalance + handoff of B's shards
+    sA.flush()
+    handed = [d.id for d in sA.by_status(*J.OPEN_STATUSES)
+              if d.released_at > 0]
+    assert handed, "the join must hand some shards off"
+    # released docs carry the handoff blob with A's cycle id
+    blob = json.loads(sA.get(handed[0]).processing_content)
+    assert blob["handoff"]["reason"] == "rebalance"
+    assert blob["hops"][-1]["cycle_id"] == "A-c1"
+
+    adopted_ids = []
+    n = sB.adopt_stale_from_archive(
+        worker="B", owns_fn=B.owns, dead_holder_fn=B.dead_holder,
+        on_adopt=lambda d: (adopted_ids.append(d.id),
+                            aB.provenance.adopt(d.id,
+                                                d.processing_content)))
+    assert n >= 1
+    aB.run_cycle(worker="B", now=1060.0)
+    B.mark_adopt_complete(n, jobs=adopted_ids)
+
+    # releasing side: lease-handoff / rebalance event with A's cycle id
+    evA = [e for e in flightA.snapshot(limit=100)
+           if e["type"] in ("shard-rebalance", EVENT_LEASE_HANDOFF)]
+    assert any(e["detail"].get("cycle_id") == "A-c1" for e in evA)
+    # adopting side: shard-adoption event with B's cycle id + job ids
+    evB = [e for e in aB.flight.snapshot(limit=100)
+           if e["type"] == EVENT_SHARD_ADOPTION]
+    assert evB and evB[-1]["detail"]["cycle_id"] == "B-c1"
+    assert set(evB[-1]["detail"]["jobs"]) == set(adopted_ids)
+    # and the adopter's explain names A's cycle
+    rec = aB.provenance.get(adopted_ids[0])
+    assert rec["hops"][-1]["cycle_id"] == "A-c1"
+
+
+def test_terminal_record_closes_the_hop_chain():
+    """Job ids are deterministic: a re-submitted incarnation of the same
+    id must NOT inherit a dead run's handoff history. The terminal record
+    keeps the chain (it archives with it); the next record starts clean."""
+    from foremast_tpu.engine.provenance import ProvenanceRecorder
+
+    rec = ProvenanceRecorder()
+    blob = rec.handoff_json("x", replica="repA", worker="A", reason="test")
+    rec.adopt("x", blob)
+    rec.record("x", "scored", status=J.COMPLETED_HEALTH)
+    assert rec.get("x")["hops"]  # the closing record carries the chain
+    rec.record("x", "scored", status=J.INITIAL)  # re-submitted incarnation
+    assert "hops" not in rec.get("x")
+
+
+# ------------------------------------------ /metrics exposition (satellite)
+
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"  # labels
+    r" (-?[0-9.eE+-]+|NaN|[+-]Inf)$")       # value
+
+
+def test_metrics_content_type_and_scrape_grammar():
+    fixtures, store = {}, JobStore()
+    an = _analyzer(fixtures, store)
+    _mk_job(store, fixtures, "c1", bad=True, end_time=5000.0)
+    _mk_hpa_job(store, fixtures, "app:fleet:hpa")
+    an.run_cycle(worker="w", now=0.0)
+    svc = ForemastService(store, exporter=an.exporter, analyzer=an)
+    server = serve_background(svc, host="127.0.0.1", port=0)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            ctype = r.headers.get("Content-Type")
+            body = r.read().decode()
+    finally:
+        server.shutdown()
+    assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+    # every line parses under the exposition grammar
+    typed: dict[str, str] = {}
+    seen_samples: set[str] = set()
+    for line in body.splitlines():
+        if not line:
+            continue
+        m = _TYPE_RE.match(line)
+        if m:
+            # TYPE precedes the family's samples and appears once
+            assert m.group(1) not in typed, f"duplicate TYPE: {line}"
+            assert m.group(1) not in seen_samples, f"TYPE after samples: {line}"
+            typed[m.group(1)] = m.group(2)
+            continue
+        if line.startswith("# HELP"):
+            assert _HELP_RE.match(line), f"bad HELP line: {line}"
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        seen_samples.add(m.group(1))
+    # histograms expose the full triplet
+    hists = [n for n, t in typed.items() if t == "histogram"]
+    assert "foremastbrain:detection_latency_seconds" in hists
+    for h in hists:
+        assert f"{h}_sum" in seen_samples and f"{h}_count" in seen_samples
+        assert any(s == f"{h}_bucket" for s in seen_samples)
+
+
+# ---------------------------------------- flight dump index (satellite)
+
+def test_flight_dump_index_and_fetch(tmp_path):
+    fr = FlightRecorder(dump_dir=str(tmp_path), min_dump_interval_s=0.0)
+    fr.record_event(EVENT_LEASE_HANDOFF, released=1)
+    assert fr.dump(reason="health:stalled") is not None
+    assert fr.dump(reason="shutdown") is not None
+    dumps = fr.list_dumps()
+    assert len(dumps) == 2
+    assert {d["trigger"] for d in dumps} == {"health-stalled", "shutdown"}
+    assert all(d["age_s"] >= 0.0 and d["size_bytes"] > 0 for d in dumps)
+    payload = fr.read_dump(dumps[0]["name"])
+    assert payload is not None and "events" in payload
+    # name validation: traversal and garbage never reach the filesystem
+    assert fr.read_dump("../etc/passwd") is None
+    assert fr.read_dump("foremast-flight-x/../../y.json") is None
+    assert fr.read_dump("nope.json") is None
+
+    class _An:  # minimal analyzer stub carrying the recorder
+        flight = fr
+
+    svc = ForemastService(JobStore(), analyzer=_An())
+    server = serve_background(svc, host="127.0.0.1", port=0)
+    try:
+        port = server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/debug/flight/dumps",
+                                    timeout=10) as r:
+            idx = json.loads(r.read().decode())
+        assert len(idx["dumps"]) == 2 and idx["dump_dir"] == str(tmp_path)
+        name = idx["dumps"][0]["name"]
+        with urllib.request.urlopen(f"{base}/debug/flight/dumps/{name}",
+                                    timeout=10) as r:
+            one = json.loads(r.read().decode())
+        assert one["reason"] in ("health:stalled", "shutdown")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/debug/flight/dumps/nope.json",
+                                   timeout=10)
+        assert ei.value.code == 404
+    finally:
+        server.shutdown()
+
+
+# -------------------------------------------- bench honesty (satellite)
+
+@pytest.mark.slow
+def test_bench_steady_records_detection_latency():
+    from foremast_tpu.bench_cycle import run_steady
+
+    out = run_steady(n_jobs=40, cycles=4)
+    assert out["detection_latency_p50_s"] > 0.0
+    assert out["detection_latency_p99_s"] >= out["detection_latency_p50_s"]

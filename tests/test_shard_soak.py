@@ -76,7 +76,10 @@ def _doc(i: int) -> Document:
 
 
 class Replica:
-    """One in-process brain replica over the shared archive path."""
+    """One in-process brain replica over the shared archive path, wired
+    exactly as the runtime wires it: status digest on the membership
+    heartbeat (the /fleet federation medium), cycle ids on handoff/
+    adoption flight events, provenance handoff blobs both directions."""
 
     def __init__(self, rid: str, archive_path: str, fixtures: dict):
         self.rid = rid
@@ -89,7 +92,12 @@ class Replica:
             self.store, rid, shard_count=16, vnodes=32,
             heartbeat_seconds=0.0,  # heartbeat every tick
             member_ttl_seconds=MEMBER_TTL, worker=rid,
-            flight=self.analyzer.flight)
+            flight=self.analyzer.flight,
+            digest_fn=self.analyzer.status_digest,
+            cycle_id_fn=lambda: self.analyzer.current_cycle_id,
+            handoff_content_fn=lambda jid:
+            self.analyzer.provenance.handoff_json(
+                jid, replica=rid, worker=rid, reason="rebalance"))
         self.analyzer.shard = self.shard
         self.scored: set[str] = set()  # terminal verdicts THIS replica wrote
 
@@ -99,7 +107,9 @@ class Replica:
         self.shard.tick()
         n = self.store.adopt_stale_from_archive(
             worker=self.rid, owns_fn=self.shard.owns,
-            dead_holder_fn=self.shard.dead_holder)
+            dead_holder_fn=self.shard.dead_holder,
+            on_adopt=lambda d: self.analyzer.provenance.adopt(
+                d.id, d.processing_content))
         self.shard.mark_adopt_complete(n)
         out = self.analyzer.run_cycle(worker=self.rid, now=score_now)
         for jid, status in out.items():
@@ -171,6 +181,29 @@ def test_kill9_one_of_three_replicas_zero_lost_zero_double_scored(tmp_path):
                + r.shard.health_summary()["adopting"]
                for r in (A, B, C)) == 16
 
+    # -- the fleet-federation view: GET /fleet on a replica shows all
+    # three peers with FRESH digests (digests ride the heartbeats the
+    # membership laps above just wrote)
+    import json as _json
+    import urllib.request as _rq
+
+    from foremast_tpu.service.api import ForemastService, serve_background
+
+    svc = ForemastService(A.store, exporter=A.analyzer.exporter,
+                          analyzer=A.analyzer, shard=A.shard)
+    server = serve_background(svc, host="127.0.0.1", port=0)
+    try:
+        port = server.server_address[1]
+        with _rq.urlopen(f"http://127.0.0.1:{port}/fleet", timeout=10) as r:
+            fleet = _json.loads(r.read().decode())
+    finally:
+        server.shutdown()
+    rows = {row["replica"]: row for row in fleet["replicas"]}
+    assert set(rows) == {"A", "B", "C"}
+    assert all(not row["stale"] for row in rows.values())
+    assert all((row.get("digest") or {}).get("health") == "ok"
+               for row in rows.values())
+
     # -- the whole fleet is submitted at ONE replica; the ring distributes
     for i in range(N_JOBS):
         A.store.create(_doc(i))
@@ -214,6 +247,12 @@ def test_kill9_one_of_three_replicas_zero_lost_zero_double_scored(tmp_path):
     # MAX_STUCK_IN_SECONDS window the dead-holder gate bypasses
     assert recovery_s < 30.0, recovery_s
     assert A.shard.tick()["replicas"] == ["A", "C"]
+    # the killed replica's fleet row flipped STALE within MEMBER_TTL of
+    # its last heartbeat (it never withdrew, so not `left` — age did it)
+    b_row = {row["replica"]: row
+             for row in A.shard.fleet_snapshot()["replicas"]}["B"]
+    assert b_row["stale"] and not b_row["left"]
+    assert b_row["age_s"] > MEMBER_TTL
 
     # -- drive to completion past every endTime
     for _ in range(5):
@@ -253,3 +292,15 @@ def test_kill9_one_of_three_replicas_zero_lost_zero_double_scored(tmp_path):
               for e in r.analyzer.flight.snapshot(limit=200)]
     assert "replica-leave" in events
     assert "shard-rebalance" in events
+    # adoption events name the adopting replica's live cycle id (the
+    # releasing side's id rides each job's provenance handoff hops)
+    adoptions = [e for r in (A, C)
+                 for e in r.analyzer.flight.snapshot(limit=200)
+                 if e["type"] == "shard-adoption"]
+    assert adoptions
+    assert all(e["detail"]["cycle_id"] for e in adoptions)
+    # ---- detection latency was measured across the soak (all-canary
+    # fleet here; the per-class criterion is tests/test_fleet_plane.py)
+    for r in (A, C):
+        dig = r.analyzer.slo.digest()
+        assert dig.get("canary", {}).get("n", 0) > 0, r.rid
