@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
@@ -113,7 +114,8 @@ class _Entry:
     full-refetch geometry next cycle."""
 
     __slots__ = ("win", "qstart", "qend", "url_step", "nan_ts",
-                 "full_bytes", "full_points")
+                 "full_bytes", "full_points", "pushed_until",
+                 "push_blocked")
 
     def __init__(self, win, qstart, qend, url_step, nan_ts,
                  full_bytes, full_points):
@@ -124,6 +126,39 @@ class _Entry:
         self.nan_ts = nan_ts  # finite ts of non-finite-valued samples
         self.full_bytes = full_bytes  # last full response size (0 unknown)
         self.full_points = full_points
+        # newest PUSHED sample timestamp spliced in by ingest_append
+        # (0 = poll-only entry). While the requested range end stays
+        # inside the pushed horizon, fetch_window serves straight from
+        # the cache — zero backend queries on the streamed path. Any
+        # poll-driven refresh (full refetch or delta splice) resets it:
+        # the poll re-established the backend as the source of truth,
+        # and the next push re-arms the horizon.
+        self.pushed_until = 0.0
+        # resync latch (ingest_block): set when the receiver had to DROP
+        # spliceable samples for this query (buffer overfill, a mixed
+        # off-grid batch) — the push stream now has a hole the backend
+        # does not, so further splices must wait until a poll re-syncs
+        # the entry (the _splice/_full_grid refresh clears it)
+        self.push_blocked = False
+
+
+def _copy_frozen(out, w, boundary: int) -> None:
+    """Transplant the cached grid `w`'s slots below `boundary` into the
+    freshly resampled `out` — the frozen-region copy shared by the delta
+    splice and the ingest splice. ONE implementation on purpose: the
+    byte-identity contract depends on both splice paths computing the
+    same geometry, so a future fix here fixes both."""
+    off = int((out.start - w.start) // w.step)
+    n = out.values.shape[0]
+    src_lo, src_hi = off, off + min(boundary, n)
+    lo_clip = max(0, -src_lo)
+    src_lo += lo_clip
+    src_hi = min(max(src_hi, src_lo), w.values.shape[0])
+    if src_hi > src_lo:
+        dst_lo = lo_clip
+        dst_hi = dst_lo + (src_hi - src_lo)
+        out.values[dst_lo:dst_hi] = w.values[src_lo:src_hi]
+        out.mask[dst_lo:dst_hi] = w.mask[src_lo:src_hi]
 
 
 def _exact(ts: np.ndarray, step: int) -> bool:
@@ -162,11 +197,18 @@ class DeltaWindowSource:
     """
 
     def __init__(self, inner, max_entries: int = 8192,
-                 overlap_steps: int = 5, step: int = DEFAULT_STEP):
+                 overlap_steps: int = 5, step: int = DEFAULT_STEP,
+                 clock=None):
         self.inner = inner
         self.max_entries = max_entries
         self.overlap_steps = max(int(overlap_steps), 1)
         self.step = int(step)
+        # wall clock for the ingest-serve coverage proof (_try_ingest_
+        # serve): a query whose end lies in the future can still be
+        # served from the pushed cache when no NEW on-grid sample can
+        # exist yet (clock < pushed_until + step). Injectable for the
+        # bench/tests' synthetic time.
+        self.clock = clock or time.time
         self._cache: OrderedDict[str, _Entry] = OrderedDict()
         self._lock = make_lock("dataplane.delta.cache")
         # splice/grid work is pure Python+numpy on small arrays: the GIL
@@ -184,6 +226,12 @@ class DeltaWindowSource:
         self.bytes_delta = 0       # bytes actually fetched on delta queries
         self.bytes_saved = 0       # est. full-body bytes NOT re-downloaded
         self.points_saved = 0      # samples not re-fetched/re-parsed
+        # push-ingest seam (foremast_tpu/ingest): samples spliced in by
+        # ingest_append, fetches served entirely from the pushed cache,
+        # and per-reason append rejections
+        self.ingest_spliced_points = 0
+        self.ingest_hits = 0
+        self.ingest_rejects: dict[str, int] = {}
 
     # ------------------------------------------------------------ plumbing
     def fetch(self, url: str):
@@ -196,17 +244,22 @@ class DeltaWindowSource:
 
     def snapshot(self) -> dict:
         """Live view for /status."""
-        total = self.delta_hits + self.full_fetches
+        total = self.delta_hits + self.full_fetches + self.ingest_hits
         with self._lock:
             entries = len(self._cache)
         return {
             "entries": entries,
             "delta_hits": self.delta_hits,
             "full_fetches": self.full_fetches,
-            "hit_ratio": round(self.delta_hits / total, 4) if total else 0.0,
+            "hit_ratio": round(
+                (self.delta_hits + self.ingest_hits) / total, 4)
+            if total else 0.0,
             "bytes_saved": self.bytes_saved,
             "points_saved": self.points_saved,
             "fallbacks": dict(self.fallbacks),
+            "ingest_spliced_points": self.ingest_spliced_points,
+            "ingest_hits": self.ingest_hits,
+            "ingest_rejects": dict(self.ingest_rejects),
         }
 
     def _series(self, url: str):
@@ -220,9 +273,176 @@ class DeltaWindowSource:
         ts, vals = self.inner.fetch(url)
         return ts, vals, 0
 
+    def _cache_key(self, url: str, rng) -> str:
+        """The ONE cache-key derivation (fetch_window / ingest_append /
+        ingest_block): URL minus start/end values, plus the log2 bucket
+        of the range span — see fetch_window for why the span bucket
+        separates a query's current/historical window roles."""
+        span = max(int(round((rng[1] - rng[0]) / self.step)), 1)
+        return f"{strip_range_params(url)}#span={span.bit_length()}"
+
     def _count_fallback(self, reason: str):
         with self._lock:
             self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+
+    def _count_ingest_reject(self, reason: str):
+        with self._lock:
+            self.ingest_rejects[reason] = \
+                self.ingest_rejects.get(reason, 0) + 1
+
+    # ------------------------------------------------------------- ingest
+    def ingest_append(self, url: str, ts, vals) -> dict:
+        """Splice PUSHED samples into the cached window for this query —
+        the same frozen-copy + resample geometry as the delta splice, so
+        the grown window is byte-identical to a full refetch of a backend
+        holding the same samples (the interleaved push+poll property test
+        in tests/test_delta.py).
+
+        Returns an outcome dict the receiver turns into counters:
+        ``{"spliced": n, "advanced": bool, "reason": str|None}`` —
+        ``reason`` (when nothing spliced) is ``no_range`` (URL not
+        delta-capable), ``no_entry`` (nothing cached yet: the caller
+        buffers until a poll primes the entry), ``off_grid`` (push
+        timestamps not on the step grid), or ``stale`` (nothing newer
+        than the cache — duplicate delivery, dropped).
+
+        Only samples STRICTLY newer than the newest cached sample are
+        accepted: the frozen region stays immutable (the delta coherence
+        contract), and a pushed rewrite of history is exactly the
+        divergence the poll path's splice-mismatch canary exists to
+        catch, not something to honor."""
+        rng = parse_range_params(url)
+        if rng is None:
+            self._count_ingest_reject("no_range")
+            return {"spliced": 0, "advanced": False, "reason": "no_range"}
+        step = self.step
+        key = self._cache_key(url, rng)
+        with self._lock:
+            entry = self._cache.get(key)
+        if entry is None:
+            return {"spliced": 0, "advanced": False, "reason": "no_entry"}
+        if entry.push_blocked:
+            # the push stream for this query has a known hole (the
+            # receiver dropped spliceable samples): no splice is sound
+            # until the poll path re-syncs the entry from the backend
+            self._count_ingest_reject("resync")
+            return {"spliced": 0, "advanced": False, "reason": "resync"}
+        ts_f, vals_f, nan_new = _split_finite(ts, vals)
+        if not _exact(ts_f, step) or nan_new.size > _MAX_NAN_TS \
+                or ts_f.size == 0:
+            self._count_ingest_reject("off_grid")
+            return {"spliced": 0, "advanced": False, "reason": "off_grid"}
+        with self._cpu_lock:
+            w = entry.win
+            valid_ts = (w.start
+                        + np.nonzero(w.mask)[0].astype(np.float64) * w.step)
+            sample_ts = np.concatenate([valid_ts, entry.nan_ts])
+            last = float(np.max(sample_ts)) if sample_ts.size else -np.inf
+            fresh = ts_f > last
+            ts_new, vals_new = ts_f[fresh], vals_f[fresh]
+            nan_new = nan_new[nan_new > last]
+            if ts_new.size == 0:
+                return {"spliced": 0, "advanced": False, "reason": "stale"}
+            first_new = float(np.min(ts_new))
+            all_min = min(float(np.min(sample_ts)) if sample_ts.size
+                          else np.inf, first_new)
+            all_max = float(np.max(ts_new))
+            cap = TS_SPAN_CAP
+            end = align_step(float(np.clip(all_max, -cap, cap)), step) + step
+            start = max(align_step(float(np.clip(all_min, -cap, cap)), step),
+                        end - MAX_WINDOW_STEPS * step)
+            out = resample_to_grid(ts_new, vals_new, start, end, step)
+            boundary = int(max(first_new - start, 0) // step)
+            # frozen region: the cached grid's slots in [start, boundary)
+            _copy_frozen(out, w, boundary)
+
+            frozen_nan = entry.nan_ts[entry.nan_ts >= start]
+            nan_ts = np.unique(np.concatenate([frozen_nan, nan_new]))
+            if nan_ts.size > _MAX_NAN_TS:
+                self._count_ingest_reject("off_grid")
+                return {"spliced": 0, "advanced": False,
+                        "reason": "off_grid"}
+            total_points = int(out.mask.sum() + nan_ts.size)
+            with self._lock:
+                if self._cache.get(key) is not entry:
+                    # evicted while we were splicing: drop the work (a
+                    # later poll rebuilds the entry from the backend)
+                    return {"spliced": 0, "advanced": False,
+                            "reason": "evicted"}
+                grow = max(total_points - entry.full_points, 0)
+                if entry.full_points and entry.full_bytes:
+                    entry.full_bytes += int(
+                        grow * entry.full_bytes / entry.full_points)
+                entry.full_points = total_points
+                entry.win = out
+                entry.nan_ts = nan_ts
+                entry.pushed_until = max(entry.pushed_until, all_max)
+                self.ingest_spliced_points += int(ts_new.size)
+                self._cache.move_to_end(key)
+        return {"spliced": int(ts_new.size), "advanced": True,
+                "reason": None}
+
+    def ingest_block(self, url: str) -> None:
+        """Latch a query into resync mode: the caller dropped pushed
+        samples the backend still has, so the cached entry's pushed
+        horizon is no longer trustworthy — stop serving from it and
+        refuse further splices until a poll-driven refresh clears the
+        latch. No-op for unknown/uncached queries."""
+        rng = parse_range_params(url)
+        if rng is None:
+            return
+        key = self._cache_key(url, rng)
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                entry.pushed_until = 0.0
+                entry.push_blocked = True
+
+    def _try_ingest_serve(self, key, entry, rng):
+        """Serve a requested range entirely from the push-fed cache, or
+        None to fall through to the delta/full path. Safe only while the
+        pushed horizon covers every on-grid slot the query's end could
+        hold (``qend < pushed_until + step``) and the cache provably
+        retains every sample at/after the requested start."""
+        qstart, qend, url_step = rng
+        step = self.step
+        if url_step != entry.url_step or qstart < entry.qstart:
+            return None
+        with self._cpu_lock:
+            if entry.pushed_until <= 0:
+                return None
+            # coverage proof: every on-grid sample the backend could
+            # return at/below the EFFECTIVE end is already in the cache.
+            # A future query end clamps to the wall clock — the backend
+            # cannot hold samples from the future either.
+            eff_end = min(qend, float(self.clock()))
+            if eff_end >= entry.pushed_until + step:
+                return None
+            w = entry.win
+            if w.values.shape[0] >= MAX_WINDOW_STEPS:
+                # span-clipped cache: samples may have been dropped at
+                # the head, so full-refetch geometry is no longer
+                # provable from the cache alone
+                return None
+            valid_ts = (w.start
+                        + np.nonzero(w.mask)[0].astype(np.float64) * w.step)
+            all_ts = np.concatenate([valid_ts, entry.nan_ts])
+            sel = (all_ts >= qstart) & (all_ts <= qend)
+            if not np.any(sel):
+                return None
+            mn = float(np.min(all_ts[sel]))
+            mx = float(np.max(all_ts[sel]))
+            end = align_step(mx, step) + step
+            start = max(align_step(mn, step), end - MAX_WINDOW_STEPS * step)
+            off = int((start - w.start) // step)
+            n = int((end - start) // step)
+            if off < 0 or off + n > w.values.shape[0]:
+                return None
+            out = Window(w.values[off:off + n].copy(),
+                         w.mask[off:off + n].copy(), int(start), step)
+            with self._lock:
+                self._cache.move_to_end(key)
+        return out
 
     # ------------------------------------------------------------- fetch
     def fetch_window(self, url: str) -> Window:
@@ -250,8 +470,7 @@ class DeltaWindowSource:
         # 7-day spans land 9 buckets apart) while staying stable for
         # trailing windows (constant span) and for fixed-start/growing-
         # end windows (one extra miss per span doubling).
-        span = max(int(round((rng[1] - rng[0]) / self.step)), 1)
-        key = f"{strip_range_params(url)}#span={span.bit_length()}"
+        key = self._cache_key(url, rng)
         with self._lock:
             entry = self._cache.get(key)
             if entry is not None:
@@ -261,6 +480,15 @@ class DeltaWindowSource:
                 self.full_fetches += 1
             tracing.tracer.add_note("fetch_full")
             return self._full(url, key, rng)
+        if entry.pushed_until > 0:
+            # streamed path: pushed samples already cover the requested
+            # range end — serve the window without touching the backend
+            win = self._try_ingest_serve(key, entry, rng)
+            if win is not None:
+                with self._lock:
+                    self.ingest_hits += 1
+                tracing.tracer.add_note("fetch_ingest")
+                return win
         win = self._try_delta(url, key, rng, entry)
         with self._lock:
             if win is not None:
@@ -371,19 +599,10 @@ class DeltaWindowSource:
                     end - MAX_WINDOW_STEPS * step)
         out = resample_to_grid(ts_d, vals_d, start, end, step)
         boundary = int(max((delta_start - start), 0) // step)
-
-        # frozen region: copy the cached grid's slots in [start, boundary)
-        off = int((start - w.start) // w.step)  # both starts are aligned
         n = out.values.shape[0]
-        src_lo, src_hi = off, off + min(boundary, n)
-        lo_clip = max(0, -src_lo)
-        src_lo += lo_clip
-        src_hi = min(max(src_hi, src_lo), w.values.shape[0])
-        if src_hi > src_lo:
-            dst_lo = lo_clip
-            dst_hi = dst_lo + (src_hi - src_lo)
-            out.values[dst_lo:dst_hi] = w.values[src_lo:src_hi]
-            out.mask[dst_lo:dst_hi] = w.mask[src_lo:src_hi]
+        # frozen region: the cached grid's slots in [start, boundary)
+        # (both starts are aligned)
+        _copy_frozen(out, w, boundary)
 
         # splice-mismatch canary: the delta's overlap region (everything it
         # re-fetched below the previous last sample, bar the one most
@@ -435,5 +654,10 @@ class DeltaWindowSource:
             entry.win = out
             entry.qstart, entry.qend = qstart, qend
             entry.nan_ts = nan_ts
+            # a poll-driven splice re-established the backend as the
+            # source of truth; the pushed horizon re-arms on the next
+            # push, and any resync latch is satisfied
+            entry.pushed_until = 0.0
+            entry.push_blocked = False
             self._cache.move_to_end(key)
         return out

@@ -427,15 +427,23 @@ class CachingDataSource:
     failure is re-raised to its waiters — they arrived inside the same
     fetch window, so they share its outcome, not a retry storm."""
 
-    def __init__(self, inner, max_entries: int = 1024, ttl_seconds: float = 55.0):
+    def __init__(self, inner, max_entries: int = 1024, ttl_seconds: float = 55.0,
+                 clock=None):
         # default just under the 60 s metric step: one fresh fetch per new
         # sample, cycle-frequency dedupe in between
         self.inner = inner
         self.max_entries = max_entries
         self.ttl_seconds = ttl_seconds
+        # injectable clock: the streamed-ingest bench drives the TTL with
+        # synthetic time (wall time barely moves between its cycles, so
+        # real-time TTLs would never expire inside a bench run)
+        self.clock = clock or time.time
         self._cache: OrderedDict[str, tuple] = OrderedDict()  # url -> (res, at)
         self._lock = make_lock("dataplane.fetch.ttl_cache")
         self._flights: dict = {}  # key -> _Flight (in-progress miss)
+        # keys invalidated while a flight was in progress: the leader's
+        # publish skips caching them (see invalidate())
+        self._invalidated: set = set()
         self.hits = 0
         self.misses = 0
         self.single_flight_waits = 0  # threads that reused a leader's fetch
@@ -461,8 +469,23 @@ class CachingDataSource:
         if sd is not None:
             sd(deadline)
 
+    def invalidate(self, url: str) -> None:
+        """Drop both key spaces for one URL. The push-ingest receiver
+        calls this after splicing fresh samples into the delta layer
+        below — the TTL's staleness bound is exactly the wait streaming
+        exists to remove, so a known-advanced window must not be served
+        stale for the rest of its TTL. An IN-FLIGHT fetch of the same
+        key is poisoned too: its result may predate the splice, and the
+        single-flight publish would otherwise re-cache the pre-push
+        window for a full TTL."""
+        with self._lock:
+            for key in (url, ("window", url)):
+                self._cache.pop(key, None)
+                if key in self._flights:
+                    self._invalidated.add(key)
+
     def _cached(self, key, fn, *args):
-        now = time.time()
+        now = self.clock()
         with self._lock:
             if key in self._cache:
                 res, at = self._cache[key]
@@ -501,9 +524,18 @@ class CachingDataSource:
             # the pop starts a fresh fetch against the updated cache
             with self._lock:
                 self._flights.pop(key, None)
+                # the poison mark is consumed whatever the outcome: a
+                # FAILED invalidated flight must not suppress caching of
+                # the next successful fetch
+                poisoned = key in self._invalidated
+                self._invalidated.discard(key)
                 if flight.exc is None:
                     self.misses += 1
-                    self._cache[key] = (flight.result, now)
+                    if not poisoned:
+                        # (an invalidated-mid-flight result predates the
+                        # push splice — serve it to the waiters but
+                        # never cache it)
+                        self._cache[key] = (flight.result, now)
                     if len(self._cache) > self.max_entries:
                         self._cache.popitem(last=False)
             flight.done.set()

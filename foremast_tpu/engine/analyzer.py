@@ -373,6 +373,10 @@ class Analyzer:
             },
             objective=config.slo_objective)
         self.health.configure(slo_fn=self.slo.burn_summary)
+        # once-per-window-advance SLO dedupe: job_id -> newest judged
+        # sample ts already observed (_observe_latency). Entries die with
+        # the job (_prune_degraded_state).
+        self._slo_seen: dict[str, float] = {}
         # load shedding (CYCLE_DEADLINE_S): cumulative shed count + the
         # consecutive-shed streak per open job (a shed job sorts ahead of
         # its priority class next cycle, so a permanently-blown budget
@@ -742,11 +746,19 @@ class Analyzer:
         Two addends, each in a self-consistent clock domain:
           * poll/scrape wait — cycle `now` minus the newest judged
             sample's own timestamp (how long fresh evidence sat waiting
-            for the poll tick; the component the streaming dataplane
-            exists to remove, floored by the metric step / CYCLE_SECONDS
-            under poll-driven operation);
+            to be LOOKED at; under poll-driven operation this is the
+            TTL-cache + cycle-tick wait the streaming dataplane removes);
           * in-cycle tail — monotonic fold time minus the cycle start
             (fetch + dispatch + collect + fold for this job's cycle).
+
+        Each WINDOW ADVANCE is observed once: a cycle that re-judges a
+        job on the same newest sample is a re-confirmation of an
+        already-detected state, not a new detection, and counting its
+        ever-growing staleness would drown the latency of the advance
+        itself (with streaming, a verdict landing 0.5 s after the push
+        must not be followed by sweeps re-reporting the same sample at
+        10/20/30 s). Jobs with NO judgeable samples (newest_ts == 0)
+        keep the per-cycle observation — there is no advance to key on.
 
         No-op for jobs that ingested nothing this cycle (shed,
         quarantined, stale-served)."""
@@ -755,11 +767,23 @@ class Analyzer:
         tail0 = self._cycle_mono0 or st.ingest_at
         lat = max(time.monotonic() - tail0, 0.0)
         if st.newest_ts > 0:
+            if self._slo_seen.get(st.doc.id, 0.0) >= st.newest_ts:
+                st.ingest_at = 0.0
+                return  # this advance was already observed
+            self._slo_seen[st.doc.id] = st.newest_ts
             lat += max(now - st.newest_ts, 0.0)
         st.ingest_at = 0.0  # at most one observation per cycle
         self.slo.observe(slo_mod.classify(st.doc.strategy), lat)
         self.provenance.annotate(st.doc.id,
                                  detection_latency_s=round(lat, 6))
+
+    def reset_slo(self):
+        """Clear SLO observations AND the once-per-advance dedupe map
+        (bench legs isolate measured cycles from warm-up; resetting the
+        histograms without the map would mute the first post-reset
+        observation per job)."""
+        self._slo_seen.clear()
+        self.slo.reset()
 
     def _prov_content(self, job_id: str) -> str | None:
         """Compact provenance JSON for a terminal Document's
@@ -1884,14 +1908,25 @@ class Analyzer:
                 help="poison-job quarantine parkings (QUARANTINE_AFTER "
                      "consecutive scoring failures)")
 
-    def run_cycle(self, worker: str = "worker-0", now: float | None = None) -> dict:
-        """One engine cycle. Returns {job_id: new_status} for observability."""
+    def run_cycle(self, worker: str = "worker-0", now: float | None = None,
+                  job_ids=None, partial: bool = False) -> dict:
+        """One engine cycle. Returns {job_id: new_status} for observability.
+
+        ``job_ids``/``partial`` are the event-driven scheduler's seam
+        (engine/scheduler.py StreamScheduler): a PARTIAL cycle claims
+        only the named jobs — the ones whose windows just advanced via
+        push ingest — and runs them through the identical pipeline
+        rungs, so a partial cycle's verdicts are exactly the ones the
+        next full sweep would have produced, just earlier. Partial and
+        full cycles share this entry point and must never run
+        concurrently (the scheduler serializes them on one thread)."""
         # cycle correlation id: bound into the tracer BEFORE the cycle
         # span opens, so the span's attrs, every cross-thread child span,
         # every log record (TraceContextFilter), and every provenance
-        # record of this cycle carry the same grep-able id
+        # record of this cycle carry the same grep-able id. Partial
+        # cycles mint `-p` ids so a grep separates the two cycle kinds.
         self._cycle_seq += 1
-        cycle_id = f"{worker}-c{self._cycle_seq}"
+        cycle_id = f"{worker}-{'p' if partial else 'c'}{self._cycle_seq}"
         self.current_cycle_id = cycle_id
         t_cycle0 = time.perf_counter()
         self._cycle_mono0 = time.monotonic()
@@ -1920,7 +1955,8 @@ class Analyzer:
                 sd(fetch_dl)
             self.health.begin_cycle()
             try:
-                outcomes = self._run_cycle(worker, now, cycle_dl)
+                outcomes = self._run_cycle(worker, now, cycle_dl,
+                                           job_ids=job_ids, partial=partial)
             finally:
                 if sd is not None:
                     sd(None)
@@ -2039,7 +2075,8 @@ class Analyzer:
                 yield from rs
 
     def _run_cycle(self, worker: str, now: float,
-                   cycle_dl: Deadline | None = None) -> dict:
+                   cycle_dl: Deadline | None = None, job_ids=None,
+                   partial: bool = False) -> dict:
         from .pipeline import CyclePipeline
 
         with tracing.span("engine.claim"):
@@ -2048,6 +2085,7 @@ class Analyzer:
                 limit=self.config.max_claim_per_cycle,
                 max_stuck_seconds=self.config.max_stuck_seconds,
                 owns_fn=self.shard.owns if self.shard is not None else None,
+                only_ids=set(job_ids) if job_ids is not None else None,
             )
         outcomes: dict[str, str] = {}
         if self._quarantine:
@@ -2243,6 +2281,12 @@ class Analyzer:
         # `explain` shows the screen's numbers vs its thresholds
         triage_stats = triage_gate.stats if triage_gate is not None else {}
 
+        # a partial (event-driven) cycle's fresh scores carry their own
+        # path tag: `explain` answers "did this verdict wait for the
+        # tick, or did the push wake it?" without cycle-id archaeology
+        scored_path = prov.PATH_STREAM_SCORED if partial \
+            else prov.PATH_SCORED
+
         def _vpath(job_id: str) -> tuple:
             """(path, detail) for a judged job: memo-hit when EVERY result
             came from the fingerprint memo, triaged when the tier-0
@@ -2259,11 +2303,11 @@ class Analyzer:
                     detail += f", {m} memo"
                 return prov.PATH_TRIAGED, detail
             if t:
-                return (prov.PATH_SCORED,
+                return (scored_path,
                         f"{n - m - t}/{n} fresh, {m} memo, {t} triaged")
             if m:
-                return prov.PATH_SCORED, f"{n - m}/{n} fresh, {m} memo"
-            return prov.PATH_SCORED, ""
+                return scored_path, f"{n - m}/{n} fresh, {m} memo"
+            return scored_path, ""
 
         # fold per-metric results into per-job verdicts
         for it in all_pairs:
@@ -2563,6 +2607,7 @@ class Analyzer:
         self.last_cycle_stages = {
             "cycle_id": self.current_cycle_id,
             "jobs": len(claimed),
+            "partial": partial,
             "pipelined": pipe is not None,
             "stage_seconds": {k: round(v, 6) for k, v in stages.items()},
             "family_score_seconds": {
@@ -2585,25 +2630,31 @@ class Analyzer:
             "watchdog_fires": self.watchdog_fires_total - wd_cycle0,
             "quarantined_jobs": self.quarantined_count(now),
         }
-        self._prune_degraded_state(outcomes)
+        self._prune_degraded_state(outcomes, orphan_sweep=not partial)
         self.store.put_state("breath", self.breath.export())
         self.store.flush()
         return outcomes
 
-    def _prune_degraded_state(self, outcomes: dict):
+    def _prune_degraded_state(self, outcomes: dict,
+                              orphan_sweep: bool = True):
         """Drop per-job degraded-mode state for jobs that can never come
         back: terminal outcomes this cycle, plus jobs deleted out from
         under the analyzer (store gc, unwatch) — without the sweep the
         maps grow one orphan per churned canary id for the life of the
         process. O(map sizes) per cycle; the maps hold open jobs only
-        once this runs."""
+        once this runs. Partial cycles skip the orphan sweep (they would
+        re-scan fleet-sized maps per push burst); the next full sweep
+        covers it."""
         for jid, status in outcomes.items():
             if status in J.TERMINAL_STATUSES:
                 self._stale_state.pop(jid, None)
                 self._quarantine.pop(jid, None)
                 self._shed_streak.pop(jid, None)
+                self._slo_seen.pop(jid, None)
+        if not orphan_sweep:
+            return
         for table in (self._stale_state, self._quarantine,
-                      self._shed_streak):
+                      self._shed_streak, self._slo_seen):
             for jid in [j for j in table
                         if j not in outcomes and self.store.get(j) is None]:
                 table.pop(jid, None)

@@ -305,7 +305,7 @@ class JobStore:
 
     def claim_open_jobs(self, worker: str, limit: int = 1024,
                         max_stuck_seconds: float = 90.0,
-                        owns_fn=None) -> list[Document]:
+                        owns_fn=None, only_ids=None) -> list[Document]:
         """Lease up to `limit` runnable jobs for `worker`.
 
         A job is runnable if INITIAL, or stuck in an inprogress status longer
@@ -317,14 +317,28 @@ class JobStore:
         skipped — they belong to a peer, and the rebalance reconciler
         (release_unowned) hands any local copies off. Must be a cheap
         pure-host predicate: it runs per doc under the store lock.
+
+        `only_ids` scopes the claim to the named jobs — the event-driven
+        scheduler's partial cycles lease exactly the pushed jobs instead
+        of walking (and claiming) the whole fleet. When the set is small
+        relative to the store, the walk iterates the ids directly.
         """
         now = time.time()
         out = []
         claims = steals = 0
         with self._lock:
-            for doc in self._jobs.values():
+            if only_ids is not None and len(only_ids) * 4 < len(self._jobs):
+                # sorted: set iteration order is salted per process, and
+                # the claim order feeds deterministic bucket packing
+                candidates = [d for jid in sorted(only_ids)
+                              if (d := self._jobs.get(jid)) is not None]
+            else:
+                candidates = self._jobs.values()
+            for doc in candidates:
                 if len(out) >= limit:
                     break
+                if only_ids is not None and doc.id not in only_ids:
+                    continue
                 if owns_fn is not None and not owns_fn(doc.id):
                     continue
                 if doc.status == INITIAL:

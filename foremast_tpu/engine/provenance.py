@@ -34,13 +34,19 @@ from ..utils.locks import make_lock
 
 __all__ = [
     "ProvenanceRecorder", "PATHS",
-    "PATH_SCORED", "PATH_MEMO_HIT", "PATH_TRIAGED", "PATH_STALE_SERVED",
-    "PATH_SHED_CARRYOVER", "PATH_QUARANTINED", "PATH_WATCHDOG_FAILOVER",
-    "PATH_BLAST_RADIUS", "PATH_FETCH_RETRY", "PATH_NO_DATA",
+    "PATH_SCORED", "PATH_STREAM_SCORED", "PATH_MEMO_HIT", "PATH_TRIAGED",
+    "PATH_STALE_SERVED", "PATH_SHED_CARRYOVER", "PATH_QUARANTINED",
+    "PATH_WATCHDOG_FAILOVER", "PATH_BLAST_RADIUS", "PATH_FETCH_RETRY",
+    "PATH_NO_DATA",
 ]
 
 # -- verdict-path registry ---------------------------------------------------
 PATH_SCORED = "scored"                      # fresh device-scored verdict
+PATH_STREAM_SCORED = "stream-scored"        # scored by an event-driven
+#                                             partial cycle (push ingest
+#                                             woke the scheduler; the
+#                                             verdict did not wait for
+#                                             the global tick)
 PATH_MEMO_HIT = "memo-hit"                  # served from fingerprint memo
 PATH_TRIAGED = "triaged"                    # tier-0 screen cleared the row(s)
 PATH_STALE_SERVED = "stale-served"          # last fresh verdict re-served
@@ -52,9 +58,10 @@ PATH_FETCH_RETRY = "fetch-retry"            # transient fetch failure requeue
 PATH_NO_DATA = "no-data"                    # nothing judgeable (unknown/fail)
 
 PATHS = frozenset({
-    PATH_SCORED, PATH_MEMO_HIT, PATH_TRIAGED, PATH_STALE_SERVED,
-    PATH_SHED_CARRYOVER, PATH_QUARANTINED, PATH_WATCHDOG_FAILOVER,
-    PATH_BLAST_RADIUS, PATH_FETCH_RETRY, PATH_NO_DATA,
+    PATH_SCORED, PATH_STREAM_SCORED, PATH_MEMO_HIT, PATH_TRIAGED,
+    PATH_STALE_SERVED, PATH_SHED_CARRYOVER, PATH_QUARANTINED,
+    PATH_WATCHDOG_FAILOVER, PATH_BLAST_RADIUS, PATH_FETCH_RETRY,
+    PATH_NO_DATA,
 })
 
 # per-record bound on family score entries: a 40-metric job keeps its 16

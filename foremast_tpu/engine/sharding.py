@@ -180,6 +180,12 @@ class ShardManager:
         # to Documents released on a rebalance (provenance.handoff_json),
         # so the adopter's `explain` keeps the full decision chain.
         self.handoff_content_fn = handoff_content_fn
+        # advertisement blob merged into every membership heartbeat —
+        # the runtime stamps {"addr": "http://host:port"} here so peers
+        # can FORWARD pushed samples to the owning replica
+        # (foremast_tpu/ingest; docs/operations.md "Running push
+        # ingestion"). Empty = nothing advertised, forwarding rejects.
+        self.advertise: dict = {}
         # guards the swap of the view/ring/owner/state refs; readers
         # (owns, dead_holder — called per doc under the store lock) read
         # the refs WITHOUT it, which is safe because rebuilds swap whole
@@ -238,6 +244,20 @@ class ShardManager:
 
     def owner_of(self, job_id: str) -> str | None:
         return self._owners.get(shard_of(job_id, self.shard_count))
+
+    def owner_addr(self, job_id: str) -> str | None:
+        """The OWNING replica's advertised ingest address (its heartbeat
+        blob's ``addr``), or None when this replica owns the job, the
+        owner is unknown, or the owner advertises nothing. Lock-free:
+        reads the immutable-by-convention view refs, like owns()."""
+        owner = self.owner_of(job_id)
+        if owner is None or owner == self.replica_id:
+            return None
+        blob = self._members_view.get(owner)
+        if not isinstance(blob, dict):
+            return None
+        addr = blob.get("addr")
+        return addr if isinstance(addr, str) and addr else None
 
     def dead_holder(self, holder: str) -> bool:
         """Is a lease holder POSITIVELY dead per the membership view?
@@ -309,6 +329,8 @@ class ShardManager:
             self._last_heartbeat = now
         blob = {"replica": self.replica_id, "worker": self.worker,
                 "left": False}
+        if self.advertise:
+            blob.update(self.advertise)
         if self.digest_fn is not None:
             # the status digest rides the liveness blob (same medium, same
             # cadence — federation costs zero extra archive writes); a

@@ -36,8 +36,14 @@ budget; >1 = the error budget shrinks; a sustained burn >> 1 is the
 page. Pure observation: nothing here feeds back into scoring, so the
 verdict A/B identity contract (tests/test_provenance.py) covers it.
 
-This is the latency baseline ROADMAP item 4 (streaming dataplane) must
-beat — measured before improved, per SWIFT's trace-first methodology.
+This was the latency baseline the streaming dataplane had to beat —
+measured before improved, per SWIFT's trace-first methodology. With
+push ingestion (foremast_tpu/ingest) the poll/scrape wait collapses to
+push latency: the event scheduler scores a pushed job the moment its
+window advances, and the analyzer observes each window advance ONCE
+(Analyzer._observe_latency), so re-confirming sweeps cannot drown the
+advance's own latency. The polled-vs-streamed A/B lives in
+bench_cycle.run_stream_ab (`make perf`).
 """
 from __future__ import annotations
 

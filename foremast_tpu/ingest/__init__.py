@@ -1,0 +1,31 @@
+"""Push-based streaming ingest (remote-write + OTLP) for the brain.
+
+Three layers (each module's docstring carries the contract):
+
+  * ``wire``     — snappy codec + remote-write protobuf + OTLP JSON,
+                   normalized to ``(labels, [(ts, value)])`` series;
+  * ``receiver`` — route/buffer/splice/forward: pushed samples land in
+                   the ``DeltaWindowSource`` window cache (byte-identical
+                   to a refetch) and wake the event scheduler;
+  * the scheduler half lives in ``engine/scheduler.py``
+    (``StreamScheduler``): pushed jobs score IMMEDIATELY as partial
+    cycles, the periodic full sweep stays the reconciliation fallback.
+"""
+from .receiver import FORWARDED_HEADER, IngestReceiver, selector_matches
+from .wire import (
+    IngestDecodeError,
+    UnsupportedMedia,
+    decode_otlp_json,
+    decode_remote_write,
+    encode_remote_write,
+    snappy_available,
+    snappy_compress,
+    snappy_decompress,
+)
+
+__all__ = [
+    "IngestReceiver", "FORWARDED_HEADER", "selector_matches",
+    "IngestDecodeError", "UnsupportedMedia",
+    "decode_remote_write", "encode_remote_write", "decode_otlp_json",
+    "snappy_available", "snappy_compress", "snappy_decompress",
+]

@@ -1,0 +1,540 @@
+"""Push-ingest receiver: route pushed samples into the window cache.
+
+The subsystem between the HTTP receivers (``service/api.py`` mounts
+``POST /ingest/remote-write`` and ``POST /ingest/otlp``) and the engine:
+
+  * **Decode** — wire.py normalizes both transports to
+    ``(labels, [(ts, value)])`` series; Content-Type/-Encoding are
+    validated here so a wrong media type is a clean 415 with a reason
+    body and a counter, never a stack trace.
+  * **Route** — a series names its job either explicitly
+    (``foremast_job`` / ``foremast_metric`` labels — the *addressed push*
+    contract operators set up with ``write_relabel_configs``, see
+    docs/operations.md) or implicitly by ``app`` + ``namespace`` labels
+    matched against the open-job index. Samples for jobs this replica
+    does not own are re-encoded as remote-write and forwarded to the
+    owner named by the shard ring's membership view (one hop only — a
+    forwarded push that still lands on a non-owner is rejected, so a
+    rebalance race cannot loop a body around the ring).
+  * **Buffer** — a bounded per-job staging buffer (``buffer_samples``
+    per job, LRU across ``buffer_jobs`` jobs). Overfill answers 429
+    (remote-write's retry signal); dropped samples are never lost data —
+    the poll path remains the source of truth and picks them up on the
+    next reconciliation sweep. Nothing here ever blocks the scoring
+    thread: receivers run on HTTP threads and only touch the delta
+    cache's own short-held locks.
+  * **Splice** — buffered samples append into the PR 3
+    ``DeltaWindowSource`` cache (``ingest_append``: the same frozen-copy
+    geometry as the delta splice, byte-identical to a full refetch),
+    and the TTL window cache's entry for the materialized URL is
+    invalidated so the next engine fetch sees the advanced window.
+    Splicing requires the push to be *attributable to the query*: an
+    addressed push, or series labels that satisfy the query's plain
+    PromQL selector. Anything else is wakeup-only — the job is scheduled
+    for an immediate partial cycle whose windows come through the normal
+    poll path.
+  * **Notify** — jobs whose window advanced past a step boundary are
+    handed to the event scheduler (``engine/scheduler.py``) for an
+    immediate partial cycle instead of waiting for the global tick.
+"""
+from __future__ import annotations
+
+import logging
+import re
+import time
+import urllib.request
+from urllib.parse import parse_qs, unquote, urlsplit
+
+from .wire import (
+    IngestDecodeError,
+    UnsupportedMedia,
+    decode_otlp_json,
+    decode_remote_write,
+    encode_remote_write,
+    snappy_available,
+    snappy_compress,
+    snappy_decompress,
+)
+from ..dataplane.promql import materialize_placeholders
+from ..engine import jobs as J
+from ..utils.locks import make_lock
+
+log = logging.getLogger("foremast_tpu.ingest")
+
+__all__ = ["IngestReceiver", "selector_matches", "FORWARDED_HEADER"]
+
+# one-hop forwarding marker: a body carrying it that still lands on a
+# non-owner is rejected instead of forwarded again (rebalance races must
+# not loop pushes around the ring)
+FORWARDED_HEADER = "X-Foremast-Forwarded"
+
+TRANSPORT_REMOTE_WRITE = "remote_write"
+TRANSPORT_OTLP = "otlp"
+
+# a plain instant-vector selector: name{label="value",...} with only
+# equality matchers — the only query shape a pushed raw series can be
+# PROVEN to satisfy (regex/negative matchers and PromQL functions would
+# need an evaluator; those queries stay wakeup-only)
+_SELECTOR_RE = re.compile(
+    r'^\s*([a-zA-Z_:][a-zA-Z0-9_:]*)\s*(?:\{(.*)\})?\s*$')
+_MATCHER_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*')
+
+
+def _unescape(v: str) -> str:
+    return v.replace('\\"', '"').replace("\\\\", "\\")
+
+
+def selector_matches(query: str, labels: dict) -> bool:
+    """True when `query` is a plain equality selector the pushed series'
+    labels satisfy — the proof that this series IS what the job's
+    query_range would return (modulo the backend's own aggregation,
+    which a plain selector does not perform)."""
+    m = _SELECTOR_RE.match(query or "")
+    if not m:
+        return False
+    if labels.get("__name__") != m.group(1):
+        return False
+    body = m.group(2)
+    if not body or not body.strip():
+        return True
+    leftover = _MATCHER_RE.sub(",", body)
+    if leftover.strip(", \t"):
+        return False  # non-equality matchers / junk: not provable
+    for key, val in _MATCHER_RE.findall(body):
+        if labels.get(key) != _unescape(val):
+            return False
+    return True
+
+
+def _query_of(url: str) -> str:
+    """The PromQL query= param of a range-query URL ('' when absent)."""
+    try:
+        qs = parse_qs(urlsplit(url).query)
+    except ValueError:
+        return ""
+    vals = qs.get("query")
+    return unquote(vals[0]) if vals else ""
+
+
+class _Buffer:
+    """Bounded per-job sample staging: `per_job` samples per job across
+    at most `max_jobs` jobs (LRU). Mutated only under the receiver's
+    lock."""
+
+    def __init__(self, per_job: int, max_jobs: int):
+        self.per_job = max(int(per_job), 1)
+        self.max_jobs = max(int(max_jobs), 1)
+        # job_id -> {metric -> [(ts, val)]}; insertion order is the LRU
+        self._jobs: dict[str, dict[str, list]] = {}
+        self._counts: dict[str, int] = {}
+        self.total = 0
+
+    def room(self, job_id: str, n: int) -> bool:
+        return self._counts.get(job_id, 0) + n <= self.per_job
+
+    def add(self, job_id: str, metric: str, samples: list) -> None:
+        per = self._jobs.get(job_id)
+        if per is None:
+            while len(self._jobs) >= self.max_jobs:
+                evicted, dropped = self._pop_oldest()
+                self.total -= dropped
+                self._counts.pop(evicted, None)
+            per = self._jobs[job_id] = {}
+            self._counts[job_id] = 0
+        per.setdefault(metric, []).extend(samples)
+        self._counts[job_id] = self._counts.get(job_id, 0) + len(samples)
+        self.total += len(samples)
+
+    def _pop_oldest(self):
+        job_id = next(iter(self._jobs))
+        per = self._jobs.pop(job_id)
+        return job_id, sum(len(v) for v in per.values())
+
+    def take(self, job_id: str, metric: str) -> list:
+        per = self._jobs.get(job_id)
+        if not per:
+            return []
+        samples = per.pop(metric, [])
+        self._counts[job_id] = max(
+            self._counts.get(job_id, 0) - len(samples), 0)
+        self.total -= len(samples)
+        if not per:
+            self._jobs.pop(job_id, None)
+            self._counts.pop(job_id, None)
+        return samples
+
+    def drop_job(self, job_id: str) -> None:
+        per = self._jobs.pop(job_id, None)
+        if per:
+            self.total -= sum(len(v) for v in per.values())
+        self._counts.pop(job_id, None)
+
+    def fill_ratio(self) -> float:
+        """Fill of the FULLEST job buffer (0..1) — the backpressure
+        signal: 1.0 means some job is rejecting pushes."""
+        if not self._counts:
+            return 0.0
+        return min(max(self._counts.values()) / self.per_job, 1.0)
+
+
+class IngestReceiver:
+    """Decode + route + buffer + splice + notify (module docstring)."""
+
+    def __init__(self, store, delta_source=None, cache_source=None,
+                 shard=None, exporter=None, notify_fn=None,
+                 buffer_samples: int = 4096, buffer_jobs: int = 8192,
+                 forward: bool = True, forward_timeout: float = 2.0,
+                 index_ttl: float = 2.0):
+        self.store = store
+        self.delta = delta_source
+        self.cache = cache_source
+        self.shard = shard
+        self.exporter = exporter
+        # scheduler tap (engine/scheduler.py StreamScheduler.notify);
+        # the runtime wires it after the scheduler exists
+        self.notify_fn = notify_fn
+        self.forward_enabled = bool(forward)
+        self.forward_timeout = float(forward_timeout)
+        self.index_ttl = float(index_ttl)
+        self._lock = make_lock("ingest.receiver")
+        self._buffer = _Buffer(buffer_samples, buffer_jobs)
+        # (app, namespace) -> [job ids]; rebuilt from the open-job set at
+        # most every index_ttl seconds (and on lookup miss)
+        self._index: dict[tuple, list] = {}
+        self._index_at = 0.0
+        # job_id -> newest pushed sample ts seen (wakeup dedupe).
+        # LRU-bounded like the buffer: churned canary ids must not grow
+        # the map for the life of the process.
+        from collections import OrderedDict
+
+        self._watermarks: OrderedDict[str, float] = OrderedDict()
+        # observability (all cumulative; /status + /metrics)
+        self.samples_total: dict[str, int] = {}
+        self.rejected_total: dict[str, int] = {}
+        self.forwarded_total = 0
+        self.spliced_points_total = 0
+        self.wakeups_total = 0
+        self.requests_total = 0
+
+    # --------------------------------------------------------------- http
+    def handle(self, transport: str, raw: bytes, content_type: str = "",
+               content_encoding: str = "", forwarded: bool = False,
+               now: float | None = None) -> tuple[int, dict]:
+        """One push request -> (HTTP status, JSON payload). 415/400 carry
+        a machine-readable ``reason``; per-series rejections ride the
+        ``rejected`` map of a 200 so one bad series never fails a batch;
+        429 means every routable sample hit buffer backpressure (the
+        retry signal remote-write honors)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self.requests_total += 1
+        try:
+            series = self._decode(transport, raw, content_type,
+                                  content_encoding)
+        except UnsupportedMedia as e:
+            self._reject("unsupported_media", 1)
+            return 415, {"error": str(e), "reason": "unsupported_media"}
+        except IngestDecodeError as e:
+            self._reject("decode_error", 1)
+            return 400, {"error": str(e), "reason": "decode_error"}
+        accepted = 0
+        rejected: dict[str, int] = {}
+        advanced: set[str] = set()
+        to_forward: dict[str, list] = {}  # owner addr -> [series]
+
+        def rej(reason: str, n: int):
+            rejected[reason] = rejected.get(reason, 0) + n
+            self._reject(reason, n)
+
+        for labels, samples in series:
+            if not samples:
+                continue
+            docs = self._route(labels, now)
+            if not docs:
+                rej("unknown_job", len(samples))
+                continue
+            # a series fanning out to several jobs counts its samples
+            # ONCE and travels to each remote owner ONCE — counters and
+            # forwards are per series, outcomes per job
+            accepted_any = False
+            fwd_addrs: set[str] = set()
+            for doc in docs:
+                if self.shard is not None and not self.shard.owns(doc.id):
+                    if forwarded:
+                        rej("not_owner", len(samples))
+                        continue
+                    addr = (self.shard.owner_addr(doc.id)
+                            if self.forward_enabled else None)
+                    if addr:
+                        if addr not in fwd_addrs:
+                            fwd_addrs.add(addr)
+                            to_forward.setdefault(addr, []).append(
+                                (labels, samples))
+                    else:
+                        rej("not_owner", len(samples))
+                    continue
+                ok, reason, adv = self._accept(doc, labels, samples, now)
+                if ok:
+                    accepted_any = True
+                else:
+                    rej(reason, len(samples))
+                if adv:
+                    advanced.add(doc.id)
+            if accepted_any:
+                accepted += len(samples)
+        # wake the scheduler for LOCALLY accepted jobs BEFORE dispatching
+        # forwards: a dead peer address costs forward_timeout in urlopen,
+        # and the local partial cycle must not wait behind it
+        if advanced and self.notify_fn is not None:
+            try:
+                self.notify_fn(advanced)
+            except Exception:  # noqa: BLE001 - scheduling is best-effort
+                log.exception("ingest notify failed")
+        # forwards dispatch OUTSIDE any lock (network I/O)
+        for addr, fwd in to_forward.items():
+            n = sum(len(s) for _, s in fwd)
+            if self._forward(addr, fwd):
+                with self._lock:
+                    self.forwarded_total += n
+                if self.exporter is not None:
+                    self.exporter.record_counter(
+                        "foremastbrain:ingest_forwarded_total", {}, n,
+                        help="pushed samples re-routed to the owning "
+                             "replica via the shard ring")
+            else:
+                rej("forward_failed", n)
+        if accepted and self.exporter is not None:
+            self.exporter.record_counter(
+                "foremastbrain:ingest_samples_total",
+                {"transport": transport}, accepted,
+                help="pushed samples accepted per ingest transport")
+        with self._lock:
+            self.samples_total[transport] = \
+                self.samples_total.get(transport, 0) + accepted
+        status = 200
+        if accepted == 0 and rejected.get("buffer_full"):
+            status = 429
+        return status, {
+            "accepted_samples": accepted,
+            "rejected": rejected,
+            "jobs_advanced": len(advanced),
+            "transport": transport,
+        }
+
+    def _decode(self, transport, raw, content_type, content_encoding):
+        ctype = (content_type or "").split(";")[0].strip().lower()
+        enc = (content_encoding or "").strip().lower()
+        if transport == TRANSPORT_REMOTE_WRITE:
+            if ctype and ctype != "application/x-protobuf":
+                raise UnsupportedMedia(
+                    f"remote-write expects application/x-protobuf, "
+                    f"got {ctype!r}")
+            if enc in ("snappy",):
+                if not snappy_available():
+                    raise UnsupportedMedia(
+                        "snappy codec unavailable on this replica; send "
+                        "Content-Encoding: identity")
+                raw = snappy_decompress(raw)
+            elif enc not in ("", "identity"):
+                raise UnsupportedMedia(
+                    f"unsupported Content-Encoding {enc!r} (snappy or "
+                    f"identity)")
+            return decode_remote_write(raw)
+        if transport == TRANSPORT_OTLP:
+            if ctype == "application/x-protobuf":
+                raise UnsupportedMedia(
+                    "OTLP/HTTP protobuf is not supported; send the JSON "
+                    "encoding (application/json)")
+            if ctype and ctype != "application/json":
+                raise UnsupportedMedia(
+                    f"OTLP expects application/json, got {ctype!r}")
+            if enc not in ("", "identity"):
+                raise UnsupportedMedia(
+                    f"unsupported Content-Encoding {enc!r}")
+            return decode_otlp_json(raw)
+        raise UnsupportedMedia(f"unknown ingest transport {transport!r}")
+
+    # ------------------------------------------------------------ routing
+    def _route(self, labels: dict, now: float) -> list:
+        """Open-job Documents a pushed series addresses."""
+        job_id = labels.get("foremast_job")
+        if job_id:
+            doc = self.store.get(job_id)
+            if doc is not None and doc.status in J.OPEN_STATUSES:
+                return [doc]
+            return []
+        app, ns = labels.get("app"), labels.get("namespace")
+        if not app or not ns:
+            return []
+        ids = self._index_lookup((app, ns), now)
+        docs = []
+        for jid in ids:
+            doc = self.store.get(jid)
+            if doc is not None and doc.status in J.OPEN_STATUSES:
+                docs.append(doc)
+        return docs
+
+    def _index_lookup(self, key: tuple, now: float) -> list:
+        with self._lock:
+            if now - self._index_at < self.index_ttl:
+                # a fresh index answers misses too: unknown (app, ns)
+                # pushes must cost a dict lookup, not a full-store
+                # rebuild per series
+                return list(self._index.get(key, ()))
+        index: dict[tuple, list] = {}
+        for doc in self.store.by_status(*J.OPEN_STATUSES):
+            index.setdefault((doc.app_name, doc.namespace), []).append(
+                doc.id)
+        with self._lock:
+            self._index = index
+            self._index_at = now
+            return list(index.get(key, ()))
+
+    # ----------------------------------------------------------- accept
+    def _accept(self, doc, labels: dict, samples: list,
+                now: float) -> tuple[bool, str, bool]:
+        """Buffer + splice one series for one owned job. Returns
+        (accepted, reject_reason, window_advanced)."""
+        metric, mq, provable = self._match_metric(doc, labels)
+        newest = max(ts for ts, _ in samples)
+        with self._lock:
+            advanced = newest > self._watermarks.get(doc.id, 0.0)
+            if advanced:
+                self._watermarks[doc.id] = newest
+            if doc.id in self._watermarks:
+                self._watermarks.move_to_end(doc.id)
+            while len(self._watermarks) > self._buffer.max_jobs:
+                self._watermarks.popitem(last=False)
+        if metric is None or self.delta is None or not provable \
+                or not mq.current:
+            # wakeup-only: the partial cycle's windows come through the
+            # normal poll path (delta tail query), so nothing to stage
+            with self._lock:
+                self.wakeups_total += 1
+            return True, "", advanced
+        url = materialize_placeholders(mq.current, now)
+        with self._lock:
+            if not self._buffer.room(doc.id, len(samples)):
+                overflow = True
+            else:
+                overflow = False
+                self._buffer.add(doc.id, metric, list(samples))
+                staged = self._buffer.take(doc.id, metric)
+        if overflow:
+            # dropping spliceable samples punches a hole in the push
+            # stream the backend does not have: latch the query into
+            # resync so no later splice can paper over it (the poll
+            # path heals the entry and lifts the latch)
+            self.delta.ingest_block(url)
+            return False, "buffer_full", False
+        res = self.delta.ingest_append(
+            url, [ts for ts, _ in staged], [v for _, v in staged])
+        reason = res.get("reason")
+        if reason == "no_entry":
+            # nothing cached yet (no poll has primed this query):
+            # re-stage bounded; the next poll primes the entry and the
+            # following push drains the backlog
+            with self._lock:
+                self._buffer.add(doc.id, metric, staged)
+            return True, "", advanced
+        if reason == "off_grid":
+            # the batch carried unspliceable timestamps and was dropped
+            # whole — same hole hazard as an overflow
+            self.delta.ingest_block(url)
+            return False, "off_grid", advanced
+        if res.get("spliced"):
+            with self._lock:
+                self.spliced_points_total += int(res["spliced"])
+            if self.exporter is not None:
+                self.exporter.record_counter(
+                    "foremastbrain:ingest_spliced_points_total", {},
+                    int(res["spliced"]),
+                    help="pushed samples spliced into the delta window "
+                         "cache")
+            if self.cache is not None:
+                # the TTL layer must not serve the pre-push window for
+                # the rest of its TTL
+                self.cache.invalidate(url)
+        # off_grid / stale / evicted: staged samples are dropped — the
+        # poll path owns them (off-grid data was never spliceable;
+        # stale duplicates are already in the cache)
+        return True, "", advanced
+
+    def _match_metric(self, doc, labels: dict):
+        """(metric_name, MetricQueries, provable) — provable=True when
+        the push may be SPLICED (addressed, or the query's plain
+        selector matches the labels); name-only matches are wakeup-only."""
+        name = labels.get("foremast_metric")
+        if name:
+            mq = doc.metrics.get(name)
+            if mq is not None:
+                return name, mq, True
+            return None, None, False
+        series_name = labels.get("__name__", "")
+        for mname, mq in doc.metrics.items():
+            query = _query_of(mq.current)
+            if query and selector_matches(query, labels):
+                return mname, mq, True
+        if series_name and series_name in doc.metrics:
+            return series_name, doc.metrics[series_name], False
+        return None, None, False
+
+    # ---------------------------------------------------------- forward
+    def _forward(self, addr: str, series: list) -> bool:
+        """Re-encode + POST one owner's series to its /ingest endpoint.
+        Best-effort with a short timeout: a dead owner costs one counted
+        failure, never a hung HTTP thread; the data still reaches the
+        owner through its own poll path."""
+        body = encode_remote_write(series)
+        headers = {"Content-Type": "application/x-protobuf",
+                   FORWARDED_HEADER: "1"}
+        if snappy_available():
+            body = snappy_compress(body)
+            headers["Content-Encoding"] = "snappy"
+        url = addr.rstrip("/") + "/ingest/remote-write"
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.forward_timeout) as r:
+                return 200 <= r.status < 300
+        except Exception as e:  # noqa: BLE001 - network boundary
+            log.warning("ingest forward to %s failed: %s", addr, e)
+            return False
+
+    # ---------------------------------------------------- observability
+    def _reject(self, reason: str, n: int):
+        with self._lock:
+            self.rejected_total[reason] = \
+                self.rejected_total.get(reason, 0) + n
+        if self.exporter is not None:
+            self.exporter.record_counter(
+                "foremastbrain:ingest_rejected_total", {"reason": reason},
+                n, help="pushed samples rejected per reason")
+
+    def refresh_metrics(self):
+        """Scrape-time gauge re-stamp (service/api.py metrics loop)."""
+        if self.exporter is None:
+            return
+        with self._lock:
+            fill = self._buffer.fill_ratio()
+        self.exporter.record_gauge(
+            "foremastbrain:ingest_buffer_fill_ratio", {}, round(fill, 4),
+            help="Fill of the fullest per-job ingest staging buffer "
+                 "(1.0 = rejecting pushes with 429).")
+
+    def snapshot(self) -> dict:
+        """Live /status section."""
+        with self._lock:
+            return {
+                "requests": self.requests_total,
+                "samples": dict(self.samples_total),
+                "rejected": dict(self.rejected_total),
+                "forwarded": self.forwarded_total,
+                "spliced_points": self.spliced_points_total,
+                "wakeups": self.wakeups_total,
+                "buffered_samples": self._buffer.total,
+                "buffer_fill_ratio": round(self._buffer.fill_ratio(), 4),
+                "snappy": snappy_available(),
+            }

@@ -1,0 +1,368 @@
+"""Push-ingest wire formats: snappy, remote-write protobuf, OTLP JSON.
+
+The receivers mounted in ``service/api.py`` accept the two push
+transports fleets already speak:
+
+  * **Prometheus remote-write** — snappy-compressed protobuf
+    ``prometheus.WriteRequest`` (``application/x-protobuf`` +
+    ``Content-Encoding: snappy``). The message is three nested shapes
+    (WriteRequest -> TimeSeries -> Label/Sample), so rather than grow a
+    protobuf dependency the container may not have, this module carries a
+    ~60-line wire-format reader: varints, the four wire types, unknown
+    fields skipped by type — exactly what ``protoc`` output would do,
+    minus the codegen.
+  * **OTLP/HTTP metrics** — the JSON encoding of
+    ``ExportMetricsServiceRequest`` (``application/json``). Gauge and sum
+    data points map onto the same (labels, samples) shape; histogram/
+    summary points are skipped (the engine judges raw series, not
+    pre-bucketed distributions).
+
+Snappy: the container does not ship ``python-snappy``, so the block
+format (the remote-write framing — NOT the streaming/framed format) is
+implemented here directly: decompression handles all four tag types;
+compression emits the always-valid all-literal encoding (used by the
+bench, tests, and cross-replica forwarding). ``snappy_available()`` is
+the degrade seam: when a deployment disables the codec (or a future
+import swap fails), receivers answer 415 with a reason body instead of a
+stack trace (tests/test_ingest.py pins that path).
+
+Every decoder normalizes to one shape::
+
+    Series = (labels: dict[str, str], samples: list[(ts_seconds, value)])
+
+Timestamps divide to seconds EXACTLY when they sit on second boundaries
+(integer division, not float) — the delta splice path requires exact-grid
+timestamps, and ``1.7e18 ns / 1e9`` in float64 does not round-trip.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = [
+    "IngestDecodeError", "UnsupportedMedia",
+    "snappy_available", "snappy_compress", "snappy_decompress",
+    "decode_remote_write", "encode_remote_write", "decode_otlp_json",
+]
+
+# decompressed-body ceiling: a 4-byte snappy header can claim a 4 GiB
+# output; a push endpoint must not allocate attacker-chosen buffers
+MAX_DECODED_BYTES = 64 * 1024 * 1024
+
+
+class IngestDecodeError(Exception):
+    """Body claims a supported format but does not parse (-> HTTP 400)."""
+
+
+class UnsupportedMedia(Exception):
+    """Content-Type/-Encoding this receiver does not speak (-> HTTP 415)."""
+
+
+# --------------------------------------------------------------------- snappy
+# Degrade seam: tests (and emergency ops) can flip this off to exercise
+# the codec-unavailable path — receivers answer a clean 415 + counter.
+_SNAPPY_ENABLED = True
+
+
+def snappy_available() -> bool:
+    return _SNAPPY_ENABLED
+
+
+def _uvarint(data: bytes, i: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        if i >= len(data):
+            raise IngestDecodeError("truncated varint")
+        b = data[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+        if shift > 63:
+            raise IngestDecodeError("varint overflow")
+
+
+def _uvarint_encode(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    """Snappy block-format decompression (the remote-write framing)."""
+    if not _SNAPPY_ENABLED:
+        raise UnsupportedMedia("snappy codec unavailable")
+    n, i = _uvarint(data, 0)
+    if n > MAX_DECODED_BYTES:
+        raise IngestDecodeError(
+            f"snappy header claims {n} bytes (cap {MAX_DECODED_BYTES})")
+    out = bytearray()
+    ln = len(data)
+    while i < ln:
+        tag = data[i]
+        i += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            size = tag >> 2
+            if size >= 60:
+                nb = size - 59
+                if i + nb > ln:
+                    raise IngestDecodeError("truncated literal length")
+                size = int.from_bytes(data[i:i + nb], "little")
+                i += nb
+            size += 1
+            if i + size > ln:
+                raise IngestDecodeError("truncated literal")
+            out += data[i:i + size]
+            i += size
+        else:  # copy
+            if kind == 1:
+                size = ((tag >> 2) & 0x7) + 4
+                if i >= ln:
+                    raise IngestDecodeError("truncated copy offset")
+                off = ((tag >> 5) << 8) | data[i]
+                i += 1
+            elif kind == 2:
+                size = (tag >> 2) + 1
+                if i + 2 > ln:
+                    raise IngestDecodeError("truncated copy offset")
+                off = int.from_bytes(data[i:i + 2], "little")
+                i += 2
+            else:
+                size = (tag >> 2) + 1
+                if i + 4 > ln:
+                    raise IngestDecodeError("truncated copy offset")
+                off = int.from_bytes(data[i:i + 4], "little")
+                i += 4
+            if off == 0 or off > len(out):
+                raise IngestDecodeError("snappy copy offset out of range")
+            if off >= size:
+                start = len(out) - off
+                out += out[start:start + size]
+            else:
+                # overlapping copy: the run repeats the trailing `off`
+                # bytes — append in off-sized chunks
+                while size > 0:
+                    start = len(out) - off
+                    chunk = out[start:start + min(off, size)]
+                    out += chunk
+                    size -= len(chunk)
+        if len(out) > MAX_DECODED_BYTES:
+            raise IngestDecodeError("snappy body exceeds decode cap")
+    if len(out) != n:
+        raise IngestDecodeError(
+            f"snappy length mismatch: header {n}, decoded {len(out)}")
+    return bytes(out)
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """All-literal snappy block encoding — always valid, never smaller;
+    used by the bench, tests, and cross-replica forwarding."""
+    if not _SNAPPY_ENABLED:
+        raise UnsupportedMedia("snappy codec unavailable")
+    out = bytearray(_uvarint_encode(len(data)))
+    i = 0
+    while i < len(data):
+        chunk = data[i:i + 65536]
+        size = len(chunk) - 1
+        if size < 60:
+            out.append(size << 2)
+        else:
+            nb = (size.bit_length() + 7) // 8
+            out.append((59 + nb) << 2)
+            out += size.to_bytes(nb, "little")
+        out += chunk
+        i += len(chunk)
+    return bytes(out)
+
+
+# ------------------------------------------------------------- protobuf wire
+_WT_VARINT, _WT_I64, _WT_LEN, _WT_I32 = 0, 1, 2, 5
+
+
+def _fields(data: bytes):
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    LEN fields yield their raw bytes; I64 yields 8 raw bytes (the caller
+    knows whether they are a double or a fixed64)."""
+    i, ln = 0, len(data)
+    while i < ln:
+        key, i = _uvarint(data, i)
+        field, wt = key >> 3, key & 7
+        if wt == _WT_VARINT:
+            val, i = _uvarint(data, i)
+        elif wt == _WT_I64:
+            if i + 8 > ln:
+                raise IngestDecodeError("truncated fixed64")
+            val = data[i:i + 8]
+            i += 8
+        elif wt == _WT_LEN:
+            size, i = _uvarint(data, i)
+            if i + size > ln:
+                raise IngestDecodeError("truncated length-delimited field")
+            val = data[i:i + size]
+            i += size
+        elif wt == _WT_I32:
+            if i + 4 > ln:
+                raise IngestDecodeError("truncated fixed32")
+            val = data[i:i + 4]
+            i += 4
+        else:
+            raise IngestDecodeError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _int64(n: int) -> int:
+    """Two's-complement int64 view of a decoded varint."""
+    return n - (1 << 64) if n >= (1 << 63) else n
+
+
+def _ts_seconds_from_ms(ms: int) -> float:
+    # exact when on a second boundary (the delta grid requires exactness)
+    return float(ms // 1000) if ms % 1000 == 0 else ms / 1000.0
+
+
+def decode_remote_write(raw: bytes) -> list[tuple[dict, list]]:
+    """Uncompressed ``prometheus.WriteRequest`` bytes -> [Series]."""
+    import struct
+
+    series = []
+    try:
+        for field, wt, val in _fields(raw):
+            if field != 1 or wt != _WT_LEN:
+                continue  # metadata (field 3) and unknowns skip
+            labels: dict[str, str] = {}
+            samples: list[tuple[float, float]] = []
+            for f2, wt2, v2 in _fields(val):
+                if f2 == 1 and wt2 == _WT_LEN:  # Label
+                    name = value = ""
+                    for f3, wt3, v3 in _fields(v2):
+                        if f3 == 1 and wt3 == _WT_LEN:
+                            name = v3.decode("utf-8", "replace")
+                        elif f3 == 2 and wt3 == _WT_LEN:
+                            value = v3.decode("utf-8", "replace")
+                    if name:
+                        labels[name] = value
+                elif f2 == 2 and wt2 == _WT_LEN:  # Sample
+                    value, ts_ms = 0.0, 0
+                    for f3, wt3, v3 in _fields(v2):
+                        if f3 == 1 and wt3 == _WT_I64:
+                            value = struct.unpack("<d", v3)[0]
+                        elif f3 == 2 and wt3 == _WT_VARINT:
+                            ts_ms = _int64(v3)
+                    samples.append((_ts_seconds_from_ms(ts_ms), value))
+            series.append((labels, samples))
+    except IngestDecodeError:
+        raise
+    except Exception as e:  # noqa: BLE001 - decode boundary
+        raise IngestDecodeError(f"malformed WriteRequest: {e}") from e
+    return series
+
+
+def _pb_key(field: int, wt: int) -> bytes:
+    return _uvarint_encode((field << 3) | wt)
+
+
+def _pb_len(field: int, payload: bytes) -> bytes:
+    return _pb_key(field, _WT_LEN) + _uvarint_encode(len(payload)) + payload
+
+
+def encode_remote_write(series: list[tuple[dict, list]]) -> bytes:
+    """[Series] -> uncompressed ``WriteRequest`` bytes (bench/tests/
+    forwarding — the inverse of :func:`decode_remote_write`)."""
+    import struct
+
+    out = bytearray()
+    for labels, samples in series:
+        ts_msg = bytearray()
+        for name, value in labels.items():
+            lab = (_pb_len(1, str(name).encode())
+                   + _pb_len(2, str(value).encode()))
+            ts_msg += _pb_len(1, lab)
+        for ts_s, value in samples:
+            ms = int(round(float(ts_s) * 1000.0))
+            samp = (_pb_key(1, _WT_I64) + struct.pack("<d", float(value))
+                    + _pb_key(2, _WT_VARINT)
+                    + _uvarint_encode(ms & ((1 << 64) - 1)))
+            ts_msg += _pb_len(2, samp)
+        out += _pb_len(1, bytes(ts_msg))
+    return bytes(out)
+
+
+# ---------------------------------------------------------------- OTLP JSON
+def _otlp_attr_value(v: dict) -> str:
+    for key in ("stringValue", "intValue", "doubleValue", "boolValue"):
+        if key in v:
+            return str(v[key])
+    return ""
+
+
+def _otlp_attrs(attrs) -> dict:
+    out = {}
+    for kv in attrs or ():
+        if isinstance(kv, dict) and isinstance(kv.get("key"), str):
+            out[kv["key"]] = _otlp_attr_value(kv.get("value") or {})
+    return out
+
+
+def _otlp_ts_seconds(nano) -> float:
+    ns = int(nano)
+    return float(ns // 1_000_000_000) if ns % 1_000_000_000 == 0 \
+        else ns / 1e9
+
+
+def decode_otlp_json(raw: bytes) -> list[tuple[dict, list]]:
+    """OTLP/HTTP metrics JSON body -> [Series]. Gauge and sum data points
+    only; histogram/summary metrics are skipped (counted by the receiver
+    as unsupported points, never an error for the rest of the batch)."""
+    try:
+        body = json.loads(raw)
+    except ValueError as e:
+        raise IngestDecodeError(f"invalid OTLP JSON: {e}") from e
+    if not isinstance(body, dict):
+        raise IngestDecodeError("OTLP body must be a JSON object")
+    series = []
+    for rm in body.get("resourceMetrics") or ():
+        if not isinstance(rm, dict):
+            continue
+        res_attrs = _otlp_attrs(
+            (rm.get("resource") or {}).get("attributes"))
+        for sm in rm.get("scopeMetrics") or ():
+            if not isinstance(sm, dict):
+                continue
+            for metric in sm.get("metrics") or ():
+                if not isinstance(metric, dict):
+                    continue
+                name = metric.get("name", "")
+                points = None
+                for kind in ("gauge", "sum"):
+                    if isinstance(metric.get(kind), dict):
+                        points = metric[kind].get("dataPoints") or ()
+                        break
+                if points is None:
+                    continue
+                for dp in points:
+                    if not isinstance(dp, dict):
+                        continue
+                    labels = {"__name__": str(name)}
+                    labels.update(res_attrs)
+                    labels.update(_otlp_attrs(dp.get("attributes")))
+                    try:
+                        ts = _otlp_ts_seconds(dp.get("timeUnixNano", 0))
+                        if "asDouble" in dp:
+                            val = float(dp["asDouble"])
+                        elif "asInt" in dp:
+                            val = float(int(dp["asInt"]))
+                        else:
+                            continue
+                    except (TypeError, ValueError):
+                        # one malformed point must not fail the batch
+                        # (the receiver's per-series rejection contract)
+                        continue
+                    series.append((labels, [(ts, val)]))
+    return series

@@ -33,6 +33,15 @@ Env surface (union of the reference services'):
   FLEET_DIGEST           publish the status digest in membership
                          heartbeats — the GET /fleet federation medium
                          (docs/operations.md "Watching the whole fleet")
+  INGEST /               push-based streaming dataplane
+  INGEST_BUFFER_SAMPLES  (foremast_tpu/ingest + engine/scheduler.py):
+  INGEST_FORWARD /       remote-write + OTLP receivers on /ingest/*,
+  INGEST_ADVERTISE_ADDR  pushed samples spliced into the delta window
+  INGEST_DEBOUNCE_MS     cache, event-driven partial cycles for pushed
+                         jobs, cross-replica forwarding via the shard
+                         ring's advertised addresses (docs/operations.md
+                         "Running push ingestion"); INGEST=0 restores
+                         the pure poll loop exactly
   SLO_CANARY_S /         detection-latency SLO targets per job class and
   SLO_CONTINUOUS_S /     the attainment objective the error budget
   SLO_HPA_S /            derives from (engine/slo.py; histograms + burn
@@ -128,6 +137,11 @@ class Runtime:
         member_ttl_seconds: float = 15.0,
         static_replicas=None,
         fleet_digest: bool = True,
+        ingest: bool | None = None,
+        ingest_buffer_samples: int = 4096,
+        ingest_forward: bool = True,
+        ingest_advertise_addr: str = "",
+        ingest_debounce_ms: float = 150.0,
     ):
         self.config = config or from_env()
         # persistent XLA compile cache (COMPILE_CACHE_PATH): point the
@@ -319,11 +333,38 @@ class Runtime:
             if n:
                 log.info("warm-started %d LSTM model(s) from %s",
                          n, lstm_cache_path)
+        # -- push-ingest receiver (INGEST; foremast_tpu/ingest): the
+        # streaming dataplane's front half. Samples pushed to /ingest/*
+        # splice into the delta window cache (byte-identical to a
+        # refetch) and wake the event scheduler; unowned jobs forward to
+        # the owner advertised on the shard ring. INGEST=0 skips the
+        # layer entirely — the poll loop is byte-for-byte yesterday's. --
+        self.ingest = None
+        self.ingest_debounce_seconds = max(float(ingest_debounce_ms), 0.0) \
+            / 1000.0
+        self.ingest_advertise_addr = ingest_advertise_addr
+        if ingest is None:
+            ingest = True
+        if ingest:
+            from .ingest import IngestReceiver
+
+            self.ingest = IngestReceiver(
+                self.store,
+                delta_source=self.delta_source,
+                cache_source=self.cache_source,
+                shard=self.shard,
+                exporter=self.exporter,
+                buffer_samples=ingest_buffer_samples,
+                forward=ingest_forward,
+            )
+        # event-driven scheduler (engine/scheduler.py StreamScheduler):
+        # constructed in start() where cadence + worker name are known
+        self.scheduler = None
         self.service = ForemastService(
             self.store, exporter=self.exporter, query_endpoint=query_endpoint,
             analyzer=self.analyzer, resilience=self.resilience,
             delta_source=self.delta_source, cache_source=self.cache_source,
-            shard=self.shard,
+            shard=self.shard, ingest=self.ingest,
         )
         self.service.chaos_active = bool(self.chaos_injectors)
         self.wavefront_sink = wavefront_sink
@@ -394,6 +435,14 @@ class Runtime:
             # advertise it so peers' dead-holder checks can map a holder
             # back to a live replica (engine/sharding.py dead_holder)
             self.shard.worker = worker
+            if self.ingest is not None and self.ingest.forward_enabled:
+                # advertise this replica's ingest address on the ring so
+                # peers can forward pushed samples for jobs we own
+                # (INGEST_ADVERTISE_ADDR overrides the derived default —
+                # 0.0.0.0 binds and NATed pods need the reachable name)
+                addr = self.ingest_advertise_addr or \
+                    f"http://{socket.gethostname()}:{port}"
+                self.shard.advertise = {"addr": addr}
             # liveness advertisement gets its OWN thread: if it only rode
             # the worker loop, one slow cycle (cold compile, adoption
             # burst) would age the heartbeat past MEMBER_TTL_S and peers
@@ -443,78 +492,100 @@ class Runtime:
             self._stop.wait(interval)
 
     def _worker_loop(self, cycle_seconds: float, worker: str):
-        while not self._stop.is_set():
-            t0 = time.time()
+        """Event-driven engine loop (engine/scheduler.py): pushed jobs
+        score immediately as partial cycles between the periodic full
+        reconciliation sweeps. With no ingest traffic the scheduler
+        degrades to exactly the old poll loop — one full sweep per
+        CYCLE_SECONDS."""
+        from .engine.scheduler import StreamScheduler
+
+        sched = StreamScheduler(
+            self.analyzer,
+            full_cycle_fn=lambda: self._full_sweep(worker),
+            cycle_seconds=cycle_seconds, worker=worker,
+            debounce_seconds=self.ingest_debounce_seconds,
+            exporter=self.exporter)
+        self.scheduler = sched
+        self.service.scheduler = sched
+        if self.ingest is not None:
+            # the receiver's wakeup tap: pushed jobs whose windows
+            # advanced land in the scheduler's pending set
+            self.ingest.notify_fn = sched.notify
+        sched.run(self._stop)
+
+    def _full_sweep(self, worker: str):
+        """One full reconciliation lap: membership/rebalance tick,
+        adoption scan, the fleet-wide engine cycle, and the per-lap
+        chores (sink flush, model-cache save, store gc). This is the
+        body the pre-streaming poll loop ran every CYCLE_SECONDS —
+        unchanged, just invoked by the scheduler now."""
+        t0 = time.time()
+        if self.shard is not None:
+            # membership heartbeat + rebalance; a membership change
+            # forces an IMMEDIATE adoption scan (the new owner must
+            # pick up handed-off/dead-peer jobs now, not on the
+            # leisurely adopt cadence). Own try: a broken shard
+            # layer must degrade to sole-owner behavior, never
+            # stop the scoring loop.
             try:
-                if self.shard is not None:
-                    # membership heartbeat + rebalance; a membership change
-                    # forces an IMMEDIATE adoption scan (the new owner must
-                    # pick up handed-off/dead-peer jobs now, not on the
-                    # leisurely adopt cadence). Own try: a broken shard
-                    # layer must degrade to sole-owner behavior, never
-                    # stop the scoring loop.
-                    try:
-                        tick = self.shard.tick()
-                        if tick.get("membership_changed"):
-                            self._last_adopt = 0.0
-                            log.info(
-                                "shard rebalance: %d replica(s), "
-                                "+%d/-%d shard(s), %d handoff(s)",
-                                len(tick["replicas"]),
-                                tick["gained_shards"], tick["lost_shards"],
-                                tick["handoffs"])
-                    except Exception:  # noqa: BLE001
-                        log.exception("shard tick error")
-                if (self.adopt_interval_seconds > 0
-                        and self.store.archive is not None
-                        and t0 - self._last_adopt >= self.adopt_interval_seconds):
-                    self._last_adopt = t0
-                    adopted_ids: list[str] = []
+                tick = self.shard.tick()
+                if tick.get("membership_changed"):
+                    self._last_adopt = 0.0
+                    log.info(
+                        "shard rebalance: %d replica(s), "
+                        "+%d/-%d shard(s), %d handoff(s)",
+                        len(tick["replicas"]),
+                        tick["gained_shards"], tick["lost_shards"],
+                        tick["handoffs"])
+            except Exception:  # noqa: BLE001
+                log.exception("shard tick error")
+        if (self.adopt_interval_seconds > 0
+                and self.store.archive is not None
+                and t0 - self._last_adopt >= self.adopt_interval_seconds):
+            self._last_adopt = t0
+            adopted_ids: list[str] = []
 
-                    def _on_adopt(doc):
-                        # handoff-surviving provenance: the blob the
-                        # releasing replica attached travels back into
-                        # our recorder, so `explain` here shows the full
-                        # chain including the handoff hop
-                        adopted_ids.append(doc.id)
-                        self.analyzer.provenance.adopt(
-                            doc.id, doc.processing_content)
+            def _on_adopt(doc):
+                # handoff-surviving provenance: the blob the
+                # releasing replica attached travels back into
+                # our recorder, so `explain` here shows the full
+                # chain including the handoff hop
+                adopted_ids.append(doc.id)
+                self.analyzer.provenance.adopt(
+                    doc.id, doc.processing_content)
 
-                    n = self.store.adopt_stale_from_archive(
-                        worker=worker,
-                        max_stuck_seconds=self.config.max_stuck_seconds,
-                        skew_margin_seconds=self.adopt_skew_margin_seconds,
-                        owns_fn=(self.shard.owns
-                                 if self.shard is not None else None),
-                        dead_holder_fn=(self.shard.dead_holder
-                                        if self.shard is not None else None),
-                        on_adopt=_on_adopt,
-                    )
-                    if self.shard is not None:
-                        self.shard.mark_adopt_complete(n, jobs=adopted_ids)
-                    if n:
-                        log.info("adopted %d stale job(s) from the archive",
-                                 n)
-                self.analyzer.run_cycle(worker=worker)
-                if self.wavefront_sink is not None:
-                    self.wavefront_sink.flush()
-                if (self.lstm_cache_path
-                        and self.analyzer._lstm_param_version
-                        != self._lstm_saved_version):
-                    # only cycles that actually trained write (bounded by
-                    # the per-cycle train budget; LRU reorders don't).
-                    # Own try: an unwritable cache path must not skip the
-                    # gc below every cycle and grow RAM without bound.
-                    try:
-                        self.analyzer.save_lstm_cache(self.lstm_cache_path)
-                        self._lstm_saved_version = \
-                            self.analyzer._lstm_param_version
-                    except Exception as e:  # noqa: BLE001
-                        log.warning("lstm cache save failed: %s", e)
-                self.store.gc(max_age_seconds=self.job_retention_seconds)
-            except Exception:  # noqa: BLE001 - worker must survive a bad cycle
-                log.exception("cycle error")
-            self._stop.wait(max(0.0, cycle_seconds - (time.time() - t0)))
+            n = self.store.adopt_stale_from_archive(
+                worker=worker,
+                max_stuck_seconds=self.config.max_stuck_seconds,
+                skew_margin_seconds=self.adopt_skew_margin_seconds,
+                owns_fn=(self.shard.owns
+                         if self.shard is not None else None),
+                dead_holder_fn=(self.shard.dead_holder
+                                if self.shard is not None else None),
+                on_adopt=_on_adopt,
+            )
+            if self.shard is not None:
+                self.shard.mark_adopt_complete(n, jobs=adopted_ids)
+            if n:
+                log.info("adopted %d stale job(s) from the archive",
+                         n)
+        self.analyzer.run_cycle(worker=worker)
+        if self.wavefront_sink is not None:
+            self.wavefront_sink.flush()
+        if (self.lstm_cache_path
+                and self.analyzer._lstm_param_version
+                != self._lstm_saved_version):
+            # only sweeps that actually trained write (bounded by
+            # the per-cycle train budget; LRU reorders don't).
+            # Own try: an unwritable cache path must not skip the
+            # gc below every sweep and grow RAM without bound.
+            try:
+                self.analyzer.save_lstm_cache(self.lstm_cache_path)
+                self._lstm_saved_version = \
+                    self.analyzer._lstm_param_version
+            except Exception as e:  # noqa: BLE001
+                log.warning("lstm cache save failed: %s", e)
+        self.store.gc(max_age_seconds=self.job_retention_seconds)
 
     def request_stop(self):
         """Signal-safe: ask run_forever to exit and shut down cleanly
@@ -689,6 +760,11 @@ def main():
         member_ttl_seconds=knobs.read("MEMBER_TTL_S"),
         static_replicas=static_replicas,
         fleet_digest=knobs.read("FLEET_DIGEST"),
+        ingest=knobs.read("INGEST"),
+        ingest_buffer_samples=knobs.read("INGEST_BUFFER_SAMPLES"),
+        ingest_forward=knobs.read("INGEST_FORWARD"),
+        ingest_advertise_addr=knobs.read("INGEST_ADVERTISE_ADDR"),
+        ingest_debounce_ms=knobs.read("INGEST_DEBOUNCE_MS"),
     )
     proxy = knobs.read("WAVEFRONT_PROXY")
     if proxy:

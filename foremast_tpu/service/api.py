@@ -2,6 +2,13 @@
 
 Endpoints (reference: foremast-service/cmd/manager/main.go:326-346):
   POST /v1/healthcheck/create          submit an analysis job
+  POST /ingest/remote-write            Prometheus remote-write receiver
+                                       (snappy + protobuf WriteRequest;
+                                       foremast_tpu/ingest) — pushed
+                                       samples splice into the window
+                                       cache and wake partial cycles
+  POST /ingest/otlp                    OTLP/HTTP metrics receiver (JSON
+                                       encoding), same routing
   GET  /v1/healthcheck/id/<jobId>      job status + hpa logs
   GET  /alert/<app>/<namespace>/<strategy>   recent HPA logs for the app
   GET  /api/v1/<queryproxy>?...        CORS proxy to the metric store
@@ -287,7 +294,8 @@ class ForemastService:
 
     def __init__(self, store: JobStore, exporter: VerdictExporter | None = None,
                  query_endpoint: str = "", analyzer=None, resilience=None,
-                 delta_source=None, cache_source=None, shard=None):
+                 delta_source=None, cache_source=None, shard=None,
+                 ingest=None, scheduler=None):
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.query_endpoint = query_endpoint  # metric-store base for the proxy
@@ -305,6 +313,14 @@ class ForemastService:
         # optional sharded-brain handle (engine/sharding.py ShardManager):
         # /status gets a shards section, /metrics the shard gauges
         self.shard = shard
+        # optional push-ingest receiver (foremast_tpu/ingest): mounts the
+        # /ingest/* endpoints; /status gets an ingest section, /metrics
+        # the ingest counters + buffer gauge
+        self.ingest = ingest
+        # optional event scheduler handle (engine/scheduler.py
+        # StreamScheduler, stamped by the runtime at start): /status gets
+        # the partial-cycle counters and the pending-job depth
+        self.scheduler = scheduler
         self.chaos_active = False  # stamped by the runtime when chaos is on
         # set by make_server: () -> the HTTP admission gate's shed counter
         self.http_shed_count = None
@@ -433,7 +449,7 @@ class ForemastService:
         # breaker fires no transitions, and a stale-evicted state gauge
         # would clear dashboards while the circuit is still open
         for holder in (self.resilience, getattr(self.store, "archive", None),
-                       getattr(self.analyzer, "slo", None)):
+                       getattr(self.analyzer, "slo", None), self.ingest):
             refresh = getattr(holder, "refresh_metrics", None)
             if refresh is not None:
                 refresh()
@@ -610,6 +626,12 @@ class ForemastService:
             lines.append(
                 "foremastbrain:delta_fetch_points_saved_total "
                 f"{snap['points_saved']}")
+            # streamed path: windows served entirely from the push-fed
+            # cache (zero backend queries) — the ingest analogue of a
+            # delta hit
+            lines.append(
+                "foremastbrain:ingest_served_windows_total "
+                f"{snap['ingest_hits']}")
         if self.http_shed_count is not None:
             lines.append(f"foremast_http_shed_total {self.http_shed_count()}")
         self_gauges = "\n".join(lines) + "\n"
@@ -648,6 +670,15 @@ class ForemastService:
             # steady-state incremental fetch health: hit ratio, bytes not
             # re-downloaded, and why any full refetches happened
             out["delta_fetch"] = self.delta_source.snapshot()
+        if self.ingest is not None:
+            # push-ingest health: accepted/rejected samples per reason,
+            # forwards, buffer backpressure (docs/operations.md
+            # "Running push ingestion")
+            out["ingest"] = self.ingest.snapshot()
+        if self.scheduler is not None:
+            # event-driven scheduling: partial cycles vs sweeps, pending
+            # pushed jobs awaiting their partial cycle
+            out["scheduler"] = self.scheduler.snapshot()
         if self.shard is not None:
             # sharded-brain view: which slice of the fleet this replica
             # owns, membership health, rebalance/handoff history
@@ -853,6 +884,32 @@ class ForemastService:
             "dump_dir": flight.dump_dir,
         }
 
+    _INGEST_TRANSPORTS = {
+        "/ingest/remote-write": "remote_write",
+        "/ingest/otlp": "otlp",
+    }
+
+    def ingest_push(self, path: str, raw: bytes,
+                    headers) -> tuple[int, dict]:
+        """POST /ingest/remote-write | /ingest/otlp — push receivers
+        (foremast_tpu/ingest). Content-Type/-Encoding are validated by
+        the receiver: wrong media answers 415, an undecodable body 400 —
+        both with a machine-readable reason — and buffer backpressure
+        answers 429 (the retry signal remote-write honors). 503 when the
+        runtime was built without ingest (INGEST=0)."""
+        if self.ingest is None:
+            return 503, {"error": "push ingestion disabled (INGEST=0)",
+                         "reason": "ingest_disabled"}
+        from ..ingest import FORWARDED_HEADER
+
+        transport = self._INGEST_TRANSPORTS[path]
+        return self.ingest.handle(
+            transport, raw,
+            content_type=headers.get("Content-Type", ""),
+            content_encoding=headers.get("Content-Encoding", ""),
+            forwarded=bool(headers.get(FORWARDED_HEADER)),
+        )
+
     def dashboard(self):
         try:
             from ..dashboard import index_html
@@ -868,7 +925,8 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
         def log_message(self, fmt, *args):  # quiet
             pass
 
-        def _send(self, status: int, payload, content_type=None):
+        def _send(self, status: int, payload, content_type=None,
+                  extra_headers=None):
             body = (
                 payload.encode()
                 if isinstance(payload, str)
@@ -883,6 +941,8 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
             self.send_header("Content-Type", ct)
             self.send_header("Content-Length", str(len(body)))
             self.send_header("Access-Control-Allow-Origin", "*")
+            for name, value in (extra_headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -955,7 +1015,20 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
             parsed = urlparse(self.path)
             try:
                 length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
+                raw = self.rfile.read(length)
+                if parsed.path in ForemastService._INGEST_TRANSPORTS:
+                    # push bodies are binary (snappy protobuf) — they
+                    # must never pass through the JSON parse below. 429s
+                    # carry Retry-After: the backpressure signal
+                    # remote-write queues back off on (the documented
+                    # contract, matching the admission gate's 503)
+                    status, payload = service.ingest_push(
+                        parsed.path, raw, self.headers)
+                    self._send(status, payload,
+                               extra_headers={"Retry-After": "1"}
+                               if status == 429 else None)
+                    return
+                body = json.loads(raw or b"{}")
                 if parsed.path == "/v1/healthcheck/create":
                     self._send(*service.create(body))
                 else:

@@ -213,6 +213,23 @@ register("FLEET_DIGEST", True, parse_bool,
          "SLO attainment) in its membership heartbeat blob — the GET "
          "/fleet federation medium; 0 keeps heartbeats liveness-only")
 
+# -- push-based streaming dataplane (foremast_tpu/ingest; runtime.py) --
+register("INGEST", True, parse_bool,
+         "push ingestion endpoints (/ingest/remote-write, /ingest/otlp) "
+         "+ event-driven partial cycles; 0 restores the pure poll loop")
+register("INGEST_BUFFER_SAMPLES", 4096, int,
+         "per-job ingest staging-buffer sample ceiling; overfill answers "
+         "429 (backpressure) and the poll path remains source of truth")
+register("INGEST_FORWARD", True, parse_bool,
+         "forward pushed samples for non-owned jobs to the owning "
+         "replica advertised on the shard ring; 0 rejects them instead")
+register("INGEST_ADVERTISE_ADDR", "", str,
+         "ingest address advertised in membership heartbeats for "
+         "cross-replica forwarding (default: http://<hostname>:<PORT>)")
+register("INGEST_DEBOUNCE_MS", 150.0, float,
+         "partial-cycle debounce: how long the event scheduler lets a "
+         "push burst coalesce before scoring the advanced jobs")
+
 # -- multi-host world (parallel/distributed.py) --
 register("COORDINATOR_ADDRESS", "", str,
          "jax.distributed coordinator (multi-host deploys)")

@@ -586,3 +586,149 @@ def test_lstm_train_memo_skips_retraining_on_unchanged_window():
     assert eng._lstm_param_version == trained_before  # no re-training
     assert eng.lstm_train_memo_hits >= 1
     assert key in eng._lstm_cache  # rehydrated under its key
+
+
+# --------------------------------------- push ingest splice (ISSUE 12)
+def test_splice_property_interleaved_push_and_poll():
+    """ISSUE 12 backpressure/identity property: PUSHED samples splice
+    into the cached grid through the same geometry as the delta splice,
+    polls and pushes interleave freely (including pushes that LAG the
+    backend and polls that lag the pushes), and every fetched window —
+    whether served from the push-fed cache or spliced/refetched from
+    the backend — is byte-identical to a fresh full refetch."""
+    rng = np.random.default_rng(1207)
+    be = _Backend()
+    grid = {"t": T0 + 39 * STEP}  # newest on-grid sample slot
+    # the wall clock sits just past the newest possible sample — the
+    # streamed regime (pushes arrive ~instantly after their timestamps)
+    clock = {"now": grid["t"] + 0.5}
+    delta_src = DeltaWindowSource(be.source(), clock=lambda: clock["now"])
+    full_src = be.source()
+    name = "pp"
+    be.series[name] = [
+        (T0 + k * STEP, round(float(rng.normal(10, 2)), 4))
+        for k in range(40) if rng.random() > 0.1
+    ]
+
+    def push(samples):
+        return delta_src.ingest_append(
+            _url(name, T0, clock["now"]),
+            [t for t, _ in samples], [v for _, v in samples])
+
+    # remote-write delivery model: per-series pushes are IN ORDER and
+    # retried until delivered (the protocol contract the splice relies
+    # on) — lag means a suffix arrives late, never that a sample is
+    # skipped while later ones land (the receiver latches any such hole
+    # into resync mode; see test_push_hole_latches_resync below)
+    backlog: list = []
+    spliced = served = 0
+    for round_i in range(60):
+        adv = int(rng.integers(0, 3)) * STEP
+        prev = grid["t"]
+        grid["t"] += adv
+        clock["now"] = grid["t"] + 0.5
+        fresh = []
+        t = prev + STEP
+        while t <= grid["t"]:
+            if rng.random() > 0.15:
+                v = float("nan") if rng.random() < 0.08 else \
+                    round(float(rng.normal(10, 2)), 4)
+                fresh.append((t, v))
+            t += STEP
+        be.series[name].extend(fresh)
+        backlog.extend(fresh)
+        mode = rng.random()
+        if mode < 0.5 and backlog:
+            # the whole backlog lands (push caught up with scrape)
+            res = push(backlog)
+            spliced += res["spliced"]
+            backlog = []
+        elif mode < 0.7 and len(backlog) > 1:
+            # lagging delivery: an in-order prefix lands, the rest stays
+            # queued (a poll may win the race; the late delivery then
+            # rejects as `stale` — already reconciled)
+            cut = len(backlog) // 2
+            res = push(backlog[:cut])
+            spliced += res["spliced"]
+            backlog = backlog[cut:]
+        # else: poll-only round (push lag) — the delta splice catches up
+        if round_i % 2:
+            url = _url(name, T0, clock["now"])
+        else:
+            url = _url(name, max(T0, clock["now"] - 30 * STEP),
+                       clock["now"])
+        before_hits = delta_src.ingest_hits
+        win_d = delta_src.fetch_window(url)
+        served += delta_src.ingest_hits - before_hits
+        win_f = full_src.fetch_window(url)
+        _assert_windows_equal(win_d, win_f, f"push+poll round {round_i}")
+    assert spliced > 10, "the ingest splice path never ran"
+    assert served > 5, "no window was ever served from the pushed cache"
+    # the poll path keeps priming entries; splice rejects stay benign
+    snap = delta_src.snapshot()
+    assert snap["ingest_spliced_points"] == spliced
+
+
+def test_push_rewrite_is_rejected_and_poll_heals():
+    """A push that REWRITES cached history (same ts, new value) is
+    dropped as stale — the frozen-region contract — and a backend
+    rewrite beyond the overlap is healed by the poll path's canary,
+    never by trusting the push."""
+    be = _Backend()
+    clock = {"now": float(T0 + 10 * STEP)}
+    delta_src = DeltaWindowSource(be.source(), clock=lambda: clock["now"])
+    be.series["rw"] = [(T0 + k * STEP, 1.0 + k) for k in range(10)]
+    url = _url("rw", T0, clock["now"])
+    delta_src.fetch_window(url)
+    res = delta_src.ingest_append(url, [T0 + 5 * STEP], [99.0])
+    assert res["spliced"] == 0 and res["reason"] == "stale"
+    # cache unchanged: identical to a fresh full refetch
+    _assert_windows_equal(delta_src.fetch_window(url),
+                          be.source().fetch_window(url), "post-reject")
+
+
+def test_push_before_any_poll_reports_no_entry():
+    be = _Backend()
+    delta_src = DeltaWindowSource(be.source())
+    be.series["cold"] = [(T0, 1.0)]
+    res = delta_src.ingest_append(_url("cold", T0, T0 + STEP),
+                                  [float(T0)], [1.0])
+    assert res == {"spliced": 0, "advanced": False, "reason": "no_entry"}
+
+
+def test_push_hole_latches_resync_until_poll_heals():
+    """A dropped spliceable push (buffer overfill, off-grid batch) is a
+    HOLE the backend does not have: ingest_block latches the entry, later
+    pushes refuse with `resync` (no papering over the gap), serving from
+    the pushed cache stops, and one poll-driven refresh lifts the latch."""
+    be = _Backend()
+    grid_t = T0 + 9 * STEP
+    clock = {"now": grid_t + 0.5}
+    delta_src = DeltaWindowSource(be.source(), clock=lambda: clock["now"])
+    be.series["h"] = [(T0 + k * STEP, 1.0 + k) for k in range(10)]
+
+    def url_now():
+        return _url("h", T0, clock["now"])
+
+    delta_src.fetch_window(url_now())  # prime
+    # the backend gains a sample the push path LOSES (the receiver calls
+    # ingest_block when it drops one)
+    be.series["h"].append((grid_t + STEP, 99.0))
+    delta_src.ingest_block(url_now())
+    grid_t += 2 * STEP
+    clock["now"] = grid_t + 0.5
+    be.series["h"].append((grid_t, 12.0))
+    res = delta_src.ingest_append(url_now(), [float(grid_t)], [12.0])
+    assert res["reason"] == "resync" and res["spliced"] == 0
+    # the poll path reconciles (window identical to a full refetch,
+    # INCLUDING the lost sample) and lifts the latch
+    _assert_windows_equal(delta_src.fetch_window(url_now()),
+                          be.source().fetch_window(url_now()), "healed")
+    grid_t += STEP
+    clock["now"] = grid_t + 0.5
+    be.series["h"].append((grid_t, 13.0))
+    res = delta_src.ingest_append(url_now(), [float(grid_t)], [13.0])
+    assert res["spliced"] == 1, res
+    _assert_windows_equal(delta_src.fetch_window(url_now()),
+                          be.source().fetch_window(url_now()),
+                          "post-resync push")

@@ -1,0 +1,214 @@
+"""Streaming-ingest soak (`make soak-stream`, ISSUE 12): push + poll
+interleaved against a LIVE runtime under chaos latency and a hard
+blackout. The claim under test: a job whose samples arrive as pushes
+keeps scoring through the blackout — its windows come from the push-fed
+delta cache, zero backend round-trips — while poll-only jobs ride the
+degraded-mode machinery (stale serving) and the health state machine
+walks DEGRADED -> OK end to end over the wire. Flight-dump artifacts are
+written by the runtime's own recorder on failure (CI uploads them).
+
+Marked slow+chaos so tier-1 (-m 'not slow') stays fast.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from foremast_tpu.dataplane.delta import parse_range_params
+from foremast_tpu.dataplane.fetch import FetchError, RawFixtureDataSource
+from foremast_tpu.engine import Document, EngineConfig, MetricQueries
+from foremast_tpu.engine.archive import FileArchive
+from foremast_tpu.ingest import encode_remote_write, snappy_compress
+from foremast_tpu.runtime import Runtime
+from foremast_tpu.utils.timeutils import to_rfc3339
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+SEED = 20260812
+STEP = 60
+
+# chaos latency throughout (spikes early, a low-rate hung socket) — the
+# BLACKOUT itself is the test-driven brownout of the poll jobs' store
+# shard below, so its phases are deterministic rather than call-counted
+CHAOS_SPEC = (
+    f"seed={SEED};"
+    "fetch.spike=0..10:0.01;"
+    "fetch.hang=0.02:0.03"
+)
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _wait_for(predicate, budget_s, interval=0.1):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_stream_soak_push_scores_through_blackout(tmp_path):
+    rng = np.random.default_rng(SEED)
+    now0 = int(time.time()) // STEP * STEP
+    t0 = now0 - 60 * STEP
+    series = {}
+    for jid in ("pushed", "poll0", "poll1"):
+        series[f"{jid}/cur"] = [
+            (t0 + k * STEP, round(float(rng.normal(5.0, 0.2)), 4))
+            for k in range(60)]
+        series[f"{jid}/hist"] = [
+            (t0 - 500 * STEP + k * STEP,
+             round(float(rng.normal(5.0, 0.2)), 4))
+            for k in range(560)]
+
+    # the brownout: the store shard serving the POLL jobs' series goes
+    # dark mid-soak (test-driven, deterministic); the pushed job's
+    # series live on a separate healthy shard — and its CURRENT window
+    # needs no shard at all once pushes feed the delta cache
+    outage = {"on": False}
+
+    def resolver(url: str) -> bytes:
+        parts = url.split("?", 1)[0].rsplit("/", 2)
+        name = parts[-2] + "/" + parts[-1]
+        if outage["on"] and "//prom-poll" in url:
+            raise FetchError("store shard down (soak brownout)")
+        qs, qe, _ = parse_range_params(url)
+        samples = [(t, v) for t, v in series.get(name, [])
+                   if qs <= t <= qe]
+        return json.dumps({
+            "status": "success",
+            "data": {"resultType": "matrix", "result": [
+                {"metric": {"__name__": "m"},
+                 "values": [[t, str(v)] for t, v in samples]}]},
+        }).encode()
+
+    archive = FileArchive(str(tmp_path / "archive.jsonl"))
+    rt = Runtime(
+        config=EngineConfig(
+            fetch_concurrency=2,
+            max_stuck_seconds=1e9,
+            retry_max_attempts=2,
+            retry_base_delay=0.001,
+            retry_max_delay=0.01,
+            breaker_failure_threshold=3,
+            breaker_recovery_seconds=0.1,
+            fetch_cycle_deadline_seconds=2.0,
+        ),
+        data_source=RawFixtureDataSource(resolver=resolver),
+        cache=False,  # the TTL cache would hide the brownout from jobs
+        archive=archive,
+        chaos_spec=CHAOS_SPEC,
+        ingest_debounce_ms=20.0,
+    )
+
+    def url(host, name, s, e):
+        return (f"http://{host}:9090/{name}"
+                f"?query=x&start={s:.0f}&end={e:.0f}&step={STEP}")
+
+    for jid in ("pushed", "poll0", "poll1"):
+        host = "prom-push" if jid == "pushed" else "prom-poll"
+        rt.store.create(Document(
+            id=jid, app_name=f"app-{jid}", namespace="soak",
+            strategy="canary",
+            start_time=to_rfc3339(t0), end_time=to_rfc3339(now0 + 86400),
+            metrics={"error5xx": MetricQueries(
+                current=url(host, f"{jid}/cur", t0, now0 + 86400),
+                historical=url(host, f"{jid}/hist",
+                               t0 - 500 * STEP, t0))},
+        ))
+
+    rt.start(host="127.0.0.1", port=0, cycle_seconds=0.3)
+    pusher_stop = threading.Event()
+    push_errors: list = []
+    try:
+        port = rt._server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+
+        def readyz_state():
+            _, payload = _get(f"{base}/readyz")
+            return json.loads(payload)["state"]
+
+        def prov_path(jid):
+            _, payload = _get(f"{base}/jobs/{jid}/explain")
+            return (json.loads(payload).get("provenance") or {}).get(
+                "path", "")
+
+        # a pusher thread streams one fresh on-grid sample per tick for
+        # the pushed job, remote-write over the real HTTP endpoint
+        def pusher():
+            k = 0
+            while not pusher_stop.is_set():
+                k += 1
+                ts = float(now0 + k * STEP)
+                val = round(float(5.0 + 0.01 * k), 4)
+                series["pushed/cur"].append((ts, val))
+                raw = snappy_compress(encode_remote_write([(
+                    {"foremast_job": "pushed",
+                     "foremast_metric": "error5xx"}, [(ts, val)])]))
+                req = urllib.request.Request(
+                    f"{base}/ingest/remote-write", data=raw,
+                    headers={"Content-Type": "application/x-protobuf",
+                             "Content-Encoding": "snappy"},
+                    method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        if r.status != 200:
+                            push_errors.append(r.status)
+                except Exception as e:  # noqa: BLE001 - soak records
+                    push_errors.append(repr(e))
+                pusher_stop.wait(0.25)
+
+        # let the poll loop warm every window first, then start pushing
+        assert _wait_for(lambda: prov_path("poll0") != "", 30.0)
+        t_push = threading.Thread(target=pusher, daemon=True)
+        t_push.start()
+
+        # phase 1: the poll shard goes dark — the POLLED path degrades
+        # (stale serving / fetch retries), visible over the wire
+        outage["on"] = True
+        assert _wait_for(lambda: readyz_state() == "degraded", 45.0), \
+            readyz_state()
+        # ... while the PUSHED job keeps producing fresh stream-scored
+        # verdicts with its windows served from the push-fed cache
+        assert _wait_for(
+            lambda: prov_path("pushed") == "stream-scored", 30.0), \
+            prov_path("pushed")
+        _, payload = _get(f"{base}/status")
+        status_doc = json.loads(payload)
+        assert status_doc["ingest"]["samples"]["remote_write"] >= 1
+        assert status_doc["scheduler"]["partial_cycles"] >= 1
+        assert status_doc["delta_fetch"]["ingest_hits"] >= 1
+
+        # phase 2: the shard comes back; health recovers OK
+        outage["on"] = False
+        assert _wait_for(lambda: readyz_state() == "ok", 60.0), \
+            readyz_state()
+        # polled jobs are back to fresh verdicts and nothing was lost
+        _, payload = _get(f"{base}/status")
+        jobs = json.loads(payload)["jobs"]
+        assert sum(jobs.values()) == 3
+        # the soak's pushes were all accepted (429/5xx would show here)
+        assert not push_errors, push_errors[:5]
+
+        # ingest metrics render under the scrape grammar content type
+        code, metrics = _get(f"{base}/metrics")
+        assert code == 200
+        body = metrics.decode()
+        assert "foremastbrain:ingest_samples_total" in body
+        assert "foremastbrain:partial_cycles_total" in body
+    finally:
+        pusher_stop.set()
+        rt.stop()
+    # graceful stop released the leases for peer adoption
+    assert rt.store.lease_releases_total >= 0
