@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos soak soak-sharded soak-stream clean
+.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos soak soak-sharded soak-stream soak-restart clean
 
 test: lint       ## full suite (CPU, 8 virtual devices via conftest), gated on lint
 	$(PY) -m pytest tests/ -q
@@ -36,10 +36,11 @@ bench-watch:     ## background tunnel watcher: banks BENCH_LOCAL_r05.json at fir
 prewarm:         ## compile the scoring-program grid into COMPILE_CACHE_PATH (default /tmp/foremast-compile-cache)
 	$(CPU_ENV) COMPILE_CACHE_PATH=$${COMPILE_CACHE_PATH:-/tmp/foremast-compile-cache} $(PY) -m foremast_tpu prewarm
 
-perf:            ## perf regression gates (zero steady-state recompiles, delta hit ratio >= 0.9, zero no-change launches, triage launch cut, streamed-ingest p99 <= 10s at byte-identical verdicts) + steady-state and streamed-ingest A/B legs
+perf:            ## perf regression gates (zero steady-state recompiles, delta hit ratio >= 0.9, zero no-change launches, triage launch cut, streamed-ingest p99 <= 10s at byte-identical verdicts) + steady-state, streamed-ingest and cold-vs-warm-restart legs
 	$(CPU_ENV) $(PY) -m pytest tests/ -m perf -q
 	$(CPU_ENV) BENCH_CYCLE_STEADY=1 BENCH_CYCLE_JOBS=$${BENCH_CYCLE_JOBS:-500} BENCH_CYCLE_REPS=$${BENCH_CYCLE_REPS:-8} $(PY) -m foremast_tpu.bench_cycle
 	$(CPU_ENV) BENCH_CYCLE_STREAM=1 BENCH_CYCLE_JOBS=$${BENCH_CYCLE_STREAM_JOBS:-200} $(PY) -m foremast_tpu.bench_cycle
+	$(CPU_ENV) BENCH_CYCLE_RESTART=1 BENCH_CYCLE_JOBS=$${BENCH_CYCLE_RESTART_JOBS:-300} $(PY) -m foremast_tpu.bench_cycle
 
 fuzz:            ## extended native-parser fuzz campaign (100k mutations)
 	$(CPU_ENV) $(PY) tests/test_native_fuzz.py --child 100000
@@ -55,6 +56,9 @@ soak-sharded:    ## multi-replica kill -9 chaos soak (<120s): 3 replicas over on
 
 soak-stream:     ## streaming-ingest soak (<120s): push + poll interleaved under chaos latency and a store-shard brownout; pushed jobs keep stream-scoring through the blackout, health DEGRADED->OK
 	$(CPU_ENV) $(PY) -m pytest tests/test_stream_soak.py -q
+
+soak-restart:    ## crash-durability soak (<60s): kill -9 a replica mid-push-stream, restart over the same WINDOW_STORE_DIR; WAL+segment replay, zero refetch storm, verdicts == never-restarted baseline (torn-WAL chaos leg included)
+	$(CPU_ENV) $(PY) -m pytest tests/test_restart_soak.py -q
 
 demo:            ## hermetic rollback demo (no cluster)
 	$(CPU_ENV) $(PY) -m foremast_tpu demo

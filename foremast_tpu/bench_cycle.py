@@ -1038,6 +1038,137 @@ def run_stream_ab(n_jobs: int = 200, cycles: int = 18) -> dict:
     }
 
 
+def run_restart(n_jobs: int = 500, window_steps: int = 128) -> dict:
+    """Cold-start vs warm-restart leg (BENCH_CYCLE_RESTART=1): measure
+    the refetch-storm win of the crash-durable window store
+    (dataplane/winstore.py) instead of asserting it.
+
+    Phase 1 boots a fleet COLD (empty store): the first cycle pays one
+    full-body fetch per window. A checkpoint then folds the cache into
+    segments and the engine is torn down — the kill. Phase 2 rebuilds
+    everything over the same store dir, replays segments+WAL, and runs
+    the first post-restart cycle: covered windows re-query only their
+    narrow tails. The bytes/fetch deltas ARE the storm that no longer
+    happens. Also reports the hot-tier RAM ceiling with the warm tier
+    on (hot LRU capped at n/4, remainder spilled) vs off (everything
+    resident) — the measured memory-per-job number ROADMAP item 3 asks
+    for."""
+    import re as _re
+
+    import numpy as np
+
+    from .dataplane.delta import DeltaWindowSource
+    from .dataplane.fetch import RawFixtureDataSource
+    from .dataplane.winstore import WindowStore
+    from .engine import jobs as J
+    from .engine.analyzer import Analyzer
+    from .engine.config import EngineConfig
+    from .utils.timeutils import to_rfc3339
+
+    step = 60
+    t0 = 1_700_000_000 // step * step
+    W = window_steps
+    horizon = 6 * W + 8
+    rng = np.random.default_rng(17)
+    shapes = 10.0 + rng.normal(0.0, 2.0, (64, horizon))
+    clock = {"now": float(t0 + (5 * W + 1) * step)}
+    served = {"bytes": 0}
+    rng_re = _re.compile(r"[?&]start=([0-9.]+).*[?&]end=([0-9.]+)")
+
+    def resolver(url: str) -> bytes:
+        i = int(url.rsplit("job=", 1)[1].split("&", 1)[0]) % 64
+        m = rng_re.search(url)
+        qs, qe = float(m.group(1)), float(m.group(2))
+        body = _range_body(t0, shapes[i], qs, min(qe, clock["now"]), step)
+        served["bytes"] += len(body)
+        return body
+
+    def url(i, tag, s, e):
+        return (f"http://prom/q?job={i}&w={tag}"
+                f"&start={s:.0f}&end={e:.0f}&step={step}")
+
+    far = t0 + (horizon - 1) * step
+
+    def mk_docs():
+        return [J.Document(
+            id=f"restart-{i}", app_name=f"app-{i % 128}",
+            namespace="bench", strategy="canary",
+            start_time=to_rfc3339(t0), end_time=to_rfc3339(far + 86_400),
+            metrics={"latency": J.MetricQueries(
+                current=url(i, "cur", t0 + 4 * W * step, far),
+                historical=url(i, "hist", t0, t0 + 4 * W * step))},
+        ) for i in range(n_jobs)]
+
+    def resident_bytes(src):
+        with src._lock:
+            return sum(
+                e.win.values.nbytes + e.win.mask.nbytes + e.nan_ts.nbytes
+                for e in src._cache.values())
+
+    def boot(store_dir, max_entries):
+        inner = RawFixtureDataSource(resolver=resolver)
+        ws = WindowStore(store_dir, checkpoint_min_seconds=0.0) \
+            if store_dir else None
+        src = DeltaWindowSource(inner, max_entries=max_entries, store=ws)
+        t_rec = time.perf_counter()
+        rec = ws.recover(src) if ws is not None else {}
+        rec_s = time.perf_counter() - t_rec
+        store = J.JobStore()
+        for d in mk_docs():
+            store.create(d)
+        engine = Analyzer(EngineConfig(), src, store)
+        served["bytes"] = 0
+        inner.requests.clear()
+        t_cyc = time.perf_counter()
+        engine.run_cycle(now=clock["now"])
+        return {
+            "engine": engine, "src": src, "ws": ws, "inner": inner,
+            "recovery_s": round(rec_s, 3), "recovery": rec,
+            "first_cycle_s": round(time.perf_counter() - t_cyc, 3),
+            "fetches": len(inner.requests),
+            "bytes_fetched": served["bytes"],
+            "full_fetches": src.full_fetches,
+            "delta_hits": src.delta_hits,
+        }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store_dir = os.path.join(tmp, "winstore")
+        cold = boot(store_dir, max_entries=4 * n_jobs)
+        # the shutdown checkpoint (or the last sweep's) — then the kill
+        cold["ws"].checkpoint(cold["src"], force=True)
+        seg_bytes = cold["ws"].snapshot()["segment_bytes"]
+        warm = boot(store_dir, max_entries=4 * n_jobs)
+
+        # memory ceiling: same fleet, hot LRU capped vs uncapped
+        capped = boot(store_dir, max_entries=max(n_jobs // 4, 8))
+        resident_on = resident_bytes(capped["src"])
+        resident_off = resident_bytes(warm["src"])
+
+    for leg in (cold, warm, capped):
+        for k in ("engine", "src", "ws", "inner", "recovery"):
+            leg.pop(k, None)
+    return {
+        "metric": "warm_restart_first_cycle_s",
+        "value": warm["first_cycle_s"],
+        "unit": "s",
+        "jobs": n_jobs,
+        "cold": cold,
+        "warm_restart": warm,
+        "refetch_bytes_avoided": cold["bytes_fetched"]
+        - warm["bytes_fetched"],
+        "first_cycle_speedup": round(
+            cold["first_cycle_s"] / max(warm["first_cycle_s"], 1e-9), 2),
+        "segment_bytes": seg_bytes,
+        # RAM ceiling: resident window bytes with the hot tier capped at
+        # n/4 entries (warm tier holds the rest) vs everything hot —
+        # multiply per-job by 1e5 for the 100k-job projection
+        "resident_bytes_tier_on": resident_on,
+        "resident_bytes_tier_off": resident_off,
+        "resident_bytes_per_job_tier_on": round(resident_on / n_jobs, 1),
+        "resident_bytes_per_job_tier_off": round(resident_off / n_jobs, 1),
+    }
+
+
 def run_steady_ab(n_jobs: int = 2000, cycles: int = 12) -> dict:
     """The A/B the perf gate and docs quote: identical stream, delta+memo
     on vs. the full-refetch path."""
@@ -1073,6 +1204,10 @@ def main() -> None:
     if _env_bool(os.environ, "BENCH_CYCLE_PROVENANCE", False):
         n = int(os.environ.get("BENCH_CYCLE_JOBS", "1500"))
         print(json.dumps(run_provenance_ab(n, max(cycles, 4))))
+        return
+    if _env_bool(os.environ, "BENCH_CYCLE_RESTART", False):
+        n = int(os.environ.get("BENCH_CYCLE_JOBS", "500"))
+        print(json.dumps(run_restart(n)))
         return
     mix = _env_bool(os.environ, "BENCH_CYCLE_MIX", False)
     print(json.dumps(run(n, cycles, mix=mix)))

@@ -115,7 +115,7 @@ class _Entry:
 
     __slots__ = ("win", "qstart", "qend", "url_step", "nan_ts",
                  "full_bytes", "full_points", "pushed_until",
-                 "push_blocked")
+                 "push_blocked", "dirty")
 
     def __init__(self, win, qstart, qend, url_step, nan_ts,
                  full_bytes, full_points):
@@ -126,6 +126,10 @@ class _Entry:
         self.nan_ts = nan_ts  # finite ts of non-finite-valued samples
         self.full_bytes = full_bytes  # last full response size (0 unknown)
         self.full_points = full_points
+        # crash-durability bookkeeping (dataplane/winstore.py): True when
+        # this entry's state has changed since it was last spilled to the
+        # warm segment tier (a fresh entry has never been spilled)
+        self.dirty = True
         # newest PUSHED sample timestamp spliced in by ingest_append
         # (0 = poll-only entry). While the requested range end stays
         # inside the pushed horizon, fetch_window serves straight from
@@ -198,9 +202,19 @@ class DeltaWindowSource:
 
     def __init__(self, inner, max_entries: int = 8192,
                  overlap_steps: int = 5, step: int = DEFAULT_STEP,
-                 clock=None):
+                 clock=None, store=None):
         self.inner = inner
         self.max_entries = max_entries
+        # crash-durable warm tier (dataplane/winstore.py WindowStore;
+        # None = today's RAM-only cache, byte-for-byte). With a store,
+        # LRU eviction SPILLS dirty entries to the columnar segment
+        # instead of dropping them, a cache miss PROMOTES from the
+        # segment before falling back to a backend fetch, and the
+        # runtime checkpoints dirty entries every sweep.
+        self.store = store
+        # entries evicted under a lock, awaiting their spill write (file
+        # I/O must not run under the cache/cpu locks)
+        self._spill_pending: list = []
         self.overlap_steps = max(int(overlap_steps), 1)
         self.step = int(step)
         # wall clock for the ingest-serve coverage proof (_try_ingest_
@@ -232,6 +246,9 @@ class DeltaWindowSource:
         self.ingest_spliced_points = 0
         self.ingest_hits = 0
         self.ingest_rejects: dict[str, int] = {}
+        # warm-tier traffic (store is None => both stay 0)
+        self.warm_spills = 0
+        self.warm_promotes = 0
 
     # ------------------------------------------------------------ plumbing
     def fetch(self, url: str):
@@ -260,6 +277,8 @@ class DeltaWindowSource:
             "ingest_spliced_points": self.ingest_spliced_points,
             "ingest_hits": self.ingest_hits,
             "ingest_rejects": dict(self.ingest_rejects),
+            "warm_spills": self.warm_spills,
+            "warm_promotes": self.warm_promotes,
         }
 
     def _series(self, url: str):
@@ -290,6 +309,143 @@ class DeltaWindowSource:
             self.ingest_rejects[reason] = \
                 self.ingest_rejects.get(reason, 0) + 1
 
+    # ---------------------------------------------------------- warm tier
+    def _entry_state(self, key: str, entry: _Entry) -> dict:
+        """Serializable snapshot of one entry for the columnar segment.
+        References only — ``entry.win``/``nan_ts`` are replaced, never
+        mutated in place, so taking them under ``_lock`` is enough."""
+        w = entry.win
+        return {
+            "key": key, "qstart": entry.qstart, "qend": entry.qend,
+            "url_step": entry.url_step, "start": w.start, "step": w.step,
+            "values": w.values, "mask": w.mask, "nan_ts": entry.nan_ts,
+            "full_bytes": entry.full_bytes,
+            "full_points": entry.full_points,
+            "pushed_until": entry.pushed_until,
+            "push_blocked": entry.push_blocked,
+        }
+
+    def _evict_overflow_locked(self) -> None:
+        """LRU trim (caller holds ``_lock``). With a warm tier, dirty
+        evictees queue for a spill write OUTSIDE the locks (the caller
+        runs ``_flush_spills`` after releasing them); without one they
+        drop exactly as before."""
+        while len(self._cache) > self.max_entries:
+            key, entry = self._cache.popitem(last=False)
+            if self.store is not None and entry.dirty:
+                self._spill_pending.append((key, entry))
+
+    def _requeue_spills(self, items) -> None:
+        """Put unwritten evictee spills back for a later retry, bounded:
+        a permanently-full disk must degrade durability, not grow RAM."""
+        with self._lock:
+            self._spill_pending = (items + self._spill_pending)[:4096]
+
+    def _flush_spills(self) -> None:
+        """Write queued evictee spills (no cache lock held). A failed
+        write (disk full) degrades — counted and REQUEUED, never raised:
+        this runs on the FETCH path after a successful backend fetch,
+        and durability I/O must not fail the cycle that already has its
+        data. The requeue matters: these entries may hold acked pushes
+        whose WAL records a checkpoint wants to retire, so their state
+        must stay flushable until it lands (spill_dirty drains this
+        queue before any WAL generation is dropped)."""
+        if self.store is None:
+            return
+        with self._lock:
+            if not self._spill_pending:
+                return
+            pending, self._spill_pending = self._spill_pending, []
+            states = [self._entry_state(k, e) for k, e in pending]
+        for i, st in enumerate(states):
+            try:
+                self.store.spill(st)
+            except OSError as e:
+                self.store.count_spill_error(e)
+                self._requeue_spills(pending[i:])
+                return
+            with self._lock:
+                self.warm_spills += 1
+
+    def _promote(self, key: str) -> _Entry | None:
+        """Load ``key`` from the warm segment into the hot LRU (cache
+        miss path). Returns the hot entry, or None when the warm tier
+        has nothing either. The segment read happens before the cache
+        lock; a racing prime wins and the load is discarded."""
+        if self.store is None:
+            return None
+        state = self.store.load(key)
+        if state is None:
+            return None
+        from .winstore import WindowStore
+
+        entry = _Entry(WindowStore.state_window(state), state["qstart"],
+                       state["qend"], state["url_step"],
+                       np.asarray(state["nan_ts"], np.float64),
+                       state["full_bytes"], state["full_points"])
+        entry.pushed_until = state["pushed_until"]
+        entry.push_blocked = bool(state["push_blocked"])
+        entry.dirty = False  # it IS the segment's state
+        with self._lock:
+            cur = self._cache.get(key)
+            if cur is not None:
+                return cur
+            self._cache[key] = entry
+            self._cache.move_to_end(key)
+            self.warm_promotes += 1
+            self._evict_overflow_locked()
+        self._flush_spills()
+        return entry
+
+    def spill_dirty(self) -> int:
+        """Checkpoint half: write every dirty hot entry AND every queued
+        evictee to the warm segment (winstore.checkpoint drives this
+        after rotating the WAL — evictees sitting in ``_spill_pending``
+        belong to the checkpoint too, because the WAL generation about
+        to be dropped may hold their acked pushes). Snapshot under the
+        lock, write outside it; a failed write re-marks/requeues its
+        entry and RAISES so the checkpoint keeps ``wal.old`` — the
+        record-or-effect invariant."""
+        if self.store is None:
+            return 0
+        with self._lock:
+            pending, self._spill_pending = self._spill_pending, []
+            states_p = [self._entry_state(k, e) for k, e in pending]
+        spilled = 0
+        for i, st in enumerate(states_p):
+            try:
+                self.store.spill(st)
+            except OSError:
+                self._requeue_spills(pending[i:])
+                raise
+            spilled += 1
+        with self._lock:
+            batch = [(k, e) for k, e in self._cache.items() if e.dirty]
+            states = []
+            for k, e in batch:
+                states.append(self._entry_state(k, e))
+                e.dirty = False
+        for (k, e), st in zip(batch, states):
+            try:
+                self.store.spill(st)
+            except OSError:
+                e.dirty = True
+                raise
+            spilled += 1
+        with self._lock:
+            self.warm_spills += spilled
+        return spilled
+
+    def force_resync(self) -> None:
+        """Latch EVERY cached entry into resync mode (WAL corruption on
+        recovery: pushed horizons can no longer be trusted store-wide;
+        the poll path heals each entry and lifts its latch)."""
+        with self._lock:
+            for entry in self._cache.values():
+                entry.pushed_until = 0.0
+                entry.push_blocked = True
+                entry.dirty = True
+
     # ------------------------------------------------------------- ingest
     def ingest_append(self, url: str, ts, vals) -> dict:
         """Splice PUSHED samples into the cached window for this query —
@@ -303,14 +459,23 @@ class DeltaWindowSource:
         ``reason`` (when nothing spliced) is ``no_range`` (URL not
         delta-capable), ``no_entry`` (nothing cached yet: the caller
         buffers until a poll primes the entry), ``off_grid`` (push
-        timestamps not on the step grid), or ``stale`` (nothing newer
-        than the cache — duplicate delivery, dropped).
+        timestamps not on the step grid), ``stale`` (nothing newer
+        than the cache — duplicate delivery, dropped), or ``late``
+        (below).
 
         Only samples STRICTLY newer than the newest cached sample are
         accepted: the frozen region stays immutable (the delta coherence
         contract), and a pushed rewrite of history is exactly the
         divergence the poll path's splice-mismatch canary exists to
-        catch, not something to honor."""
+        catch, not something to honor. Older timestamps are safe to drop
+        only when the cache already HOLDS them (duplicate delivery —
+        remote-write retries after a lost ack). An older timestamp the
+        cache does NOT hold is a LATE arrival: batch k landing after
+        k+1 was spliced. Dropping it silently would leave a hole the
+        backend doesn't have inside the pushed horizon, so the entry
+        latches into resync instead (``reason="late"``) and the poll
+        path heals it — the byte-identical-or-resync contract pinned by
+        the push-chaos property tests."""
         rng = parse_range_params(url)
         if rng is None:
             self._count_ingest_reject("no_range")
@@ -319,6 +484,11 @@ class DeltaWindowSource:
         key = self._cache_key(url, rng)
         with self._lock:
             entry = self._cache.get(key)
+        if entry is None:
+            # warm tier: a spilled (or crash-recovered) entry serves the
+            # splice as if it never left RAM — this is also how boot-time
+            # WAL replay finds its entries
+            entry = self._promote(key)
         if entry is None:
             return {"spliced": 0, "advanced": False, "reason": "no_entry"}
         if entry.push_blocked:
@@ -340,6 +510,28 @@ class DeltaWindowSource:
             last = float(np.max(sample_ts)) if sample_ts.size else -np.inf
             fresh = ts_f > last
             ts_new, vals_new = ts_f[fresh], vals_f[fresh]
+            # late-arrival canary: a non-fresh timestamp the cache does
+            # not hold means the push stream reordered ACROSS batches —
+            # dropping it would punch a hole inside the pushed horizon
+            # that the backend doesn't have. Latch resync; the poll path
+            # heals the entry and lifts the latch. (Timestamps the cache
+            # DOES hold are plain duplicate delivery and drop free.)
+            # Only timestamps inside the RETAINED span [w.start, last]
+            # are evidence: below it, a missing ts is indistinguishable
+            # from a clipped-out duplicate (remote-write retries of
+            # long-queued data), and pre-span history is outside the
+            # module's coherence contract anyway — the serve path never
+            # vouches for slots below w.start.
+            old_ts = np.concatenate([ts_f[~fresh], nan_new[nan_new <= last]])
+            old_ts = old_ts[old_ts >= float(w.start)]
+            if old_ts.size and not np.isin(old_ts, sample_ts).all():
+                with self._lock:
+                    if self._cache.get(key) is entry:
+                        entry.pushed_until = 0.0
+                        entry.push_blocked = True
+                        entry.dirty = True
+                self._count_ingest_reject("late")
+                return {"spliced": 0, "advanced": False, "reason": "late"}
             nan_new = nan_new[nan_new > last]
             if ts_new.size == 0:
                 return {"spliced": 0, "advanced": False, "reason": "stale"}
@@ -377,6 +569,7 @@ class DeltaWindowSource:
                 entry.win = out
                 entry.nan_ts = nan_ts
                 entry.pushed_until = max(entry.pushed_until, all_max)
+                entry.dirty = True
                 self.ingest_spliced_points += int(ts_new.size)
                 self._cache.move_to_end(key)
         return {"spliced": int(ts_new.size), "advanced": True,
@@ -387,16 +580,25 @@ class DeltaWindowSource:
         samples the backend still has, so the cached entry's pushed
         horizon is no longer trustworthy — stop serving from it and
         refuse further splices until a poll-driven refresh clears the
-        latch. No-op for unknown/uncached queries."""
+        latch. No-op for queries with no cached state ANYWHERE — then
+        there is no pushed horizon to poison and the first prime comes
+        from a poll."""
         rng = parse_range_params(url)
         if rng is None:
             return
         key = self._cache_key(url, rng)
         with self._lock:
             entry = self._cache.get(key)
-            if entry is not None:
+        if entry is None:
+            # the hole hazard applies to SPILLED entries too: a warm
+            # state with a pushed horizon must come back latched, or a
+            # later promote would serve around the dropped samples
+            entry = self._promote(key)
+        if entry is not None:
+            with self._lock:
                 entry.pushed_until = 0.0
                 entry.push_blocked = True
+                entry.dirty = True  # the latch must survive a restart
 
     def _try_ingest_serve(self, key, entry, rng):
         """Serve a requested range entirely from the push-fed cache, or
@@ -441,7 +643,8 @@ class DeltaWindowSource:
             out = Window(w.values[off:off + n].copy(),
                          w.mask[off:off + n].copy(), int(start), step)
             with self._lock:
-                self._cache.move_to_end(key)
+                if self._cache.get(key) is entry:  # evicted mid-serve?
+                    self._cache.move_to_end(key)
         return out
 
     # ------------------------------------------------------------- fetch
@@ -476,6 +679,11 @@ class DeltaWindowSource:
             if entry is not None:
                 self._cache.move_to_end(key)
         if entry is None:
+            # warm tier first: a spilled/recovered entry promotes back to
+            # the hot LRU and serves through the normal pushed/delta
+            # paths — a restart costs a segment read, not a refetch storm
+            entry = self._promote(key)
+        if entry is None:
             with self._lock:
                 self.full_fetches += 1
             tracing.tracer.add_note("fetch_full")
@@ -508,7 +716,9 @@ class DeltaWindowSource:
         exact-grid (spliceable next cycle)."""
         ts, vals, nbytes = self._series(url)
         with self._cpu_lock:
-            return self._full_grid(ts, vals, nbytes, key, rng)
+            win = self._full_grid(ts, vals, nbytes, key, rng)
+        self._flush_spills()
+        return win
 
     def _full_grid(self, ts, vals, nbytes, key, rng) -> Window:
         win = grid_from_series(ts, vals, self.step)
@@ -529,8 +739,7 @@ class DeltaWindowSource:
             self._cache[key] = _Entry(win, qstart, qend, url_step,
                                       nan_ts, nbytes, int(ts_f.size))
             self._cache.move_to_end(key)
-            while len(self._cache) > self.max_entries:
-                self._cache.popitem(last=False)
+            self._evict_overflow_locked()
         return win
 
     def _try_delta(self, url, key, rng, entry) -> Window | None:
@@ -538,6 +747,14 @@ class DeltaWindowSource:
         full refetch (the caller counts it; reasons counted here)."""
         qstart, qend, url_step = rng
         step = self.step
+        if entry.push_blocked:
+            # resync latch: the entry's frozen region may hide holes the
+            # backend does not have (late pushes dropped, WAL corruption)
+            # DEEPER than the overlap window, where the tail query and
+            # its splice-mismatch canary never look. Only a full refetch
+            # re-establishes trust (and re-primes a clean entry).
+            self._count_fallback("resync")
+            return None
         if url_step != entry.url_step:
             self._count_fallback("step_change")
             return None
@@ -654,10 +871,16 @@ class DeltaWindowSource:
             entry.win = out
             entry.qstart, entry.qend = qstart, qend
             entry.nan_ts = nan_ts
+            entry.dirty = True
             # a poll-driven splice re-established the backend as the
             # source of truth; the pushed horizon re-arms on the next
             # push, and any resync latch is satisfied
             entry.pushed_until = 0.0
             entry.push_blocked = False
-            self._cache.move_to_end(key)
+            # the entry may have been EVICTED by a concurrent fetch while
+            # this splice held only the cpu lock (a hot cache smaller
+            # than the in-flight fetch set): the spliced window is still
+            # correct to return, but a bare move_to_end would KeyError
+            if self._cache.get(key) is entry:
+                self._cache.move_to_end(key)
         return out

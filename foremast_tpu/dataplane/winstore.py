@@ -1,0 +1,558 @@
+"""Crash-durable window store: per-replica WAL + columnar warm segments.
+
+All window state used to live in Python object graphs that die with the
+process: a restarted replica forgot every cached window — acked pushes
+included — and hammered the backend with a full-refetch storm while its
+detection-latency SLO burned. This module is the durability layer under
+``dataplane/delta.py``'s window cache, with two on-disk halves:
+
+  * **WAL** (``wal.log``) — every push batch that ADVANCES a cached
+    window is appended here after its splice and *before* the ingest
+    receiver acks, so an ``/ingest/*`` 2xx means the spliced samples
+    survive ``kill -9`` (batches that did not splice stay poll-covered
+    — the backend remains their source of truth). Splice-then-WAL
+    ordering is load-bearing: the splice dirty-marks the entry before
+    the record exists, so a concurrent checkpoint provably captures
+    either the record (it lands in the post-rotation generation) or its
+    effect (the dirty entry spills) — never neither. Records are
+    CRC-framed; a torn tail (crash mid-append — the push was never
+    acked) truncates cleanly, while mid-file corruption (valid frames
+    AFTER the bad one — real disk damage) stops replay and latches the
+    recovered entries into the PR 12 resync mode so the poll path
+    re-establishes the backend as source of truth.
+  * **Segments** (``segments.dat``) — warm windows spill here in a
+    columnar layout: one frame per entry holding a small JSON header
+    plus the raw ``float32`` value column, the bit-packed validity
+    mask, and the ``float64`` NaN-timestamp column. Reads are
+    zero-copy ``np.frombuffer`` views over an ``mmap`` — promoting a
+    warm window back to the hot tier costs an index lookup and a page
+    fault, not a parse. The file is append-only; when it exceeds
+    ``segment_max_bytes`` it compacts newest-wins per key (the same
+    discipline as ``engine/archive.FileArchive``).
+
+The tiering contract with ``DeltaWindowSource``:
+
+  * hot  = the in-RAM ``_Entry`` LRU, exactly as before;
+  * warm = segment frames. LRU eviction SPILLS a dirty entry instead of
+    dropping it; a cache miss PROMOTES from the segment index before
+    falling back to a backend fetch.
+
+``checkpoint()`` makes the two halves consistent: rotate the WAL
+(``wal.log`` → ``wal.old``), spill every dirty hot entry, then drop
+``wal.old``. Replay is idempotent (``ingest_append`` rejects samples at
+or below the cached horizon), so a crash at ANY point in that sequence
+recovers exactly: segments hold a state no newer than the WAL's first
+record's precondition, and re-applying an already-spilled push is a
+counted no-op. ``recover()`` is the boot half: rebuild the segment
+index, replay ``wal.old`` + ``wal.log`` through the delta splice, then
+run one full checkpoint so the WAL starts empty.
+
+Durability scope, stated honestly: pushes are durable per-request (the
+WAL append precedes the ack); poll-fetched state is durable as of the
+last checkpoint — losing it costs a narrow delta re-query, never a
+wrong verdict, because the backend remains the source of truth for
+everything polled. ``fsync`` is off by default: the frames survive
+process death (``kill -9``) without it; flip ``WINDOW_STORE_FSYNC=1``
+when the threat model includes machine crashes.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import mmap
+import os
+import struct
+import time
+import zlib
+
+import numpy as np
+
+from ..ops.windowing import Window
+from ..utils.locks import make_lock
+
+log = logging.getLogger("foremast_tpu.winstore")
+
+__all__ = ["WindowStore"]
+
+# frame: MAGIC | u32 payload_len | u32 crc32(payload) | payload.
+# One os.write per frame on an O_APPEND fd, so concurrent appends never
+# interleave and a crash can only ever tear the LAST frame.
+_MAGIC = b"FWS1"
+_HEAD = struct.Struct("<II")
+_FRAME_OVERHEAD = len(_MAGIC) + _HEAD.size
+
+# scan outcomes (recover() surfaces them as counters)
+SCAN_OK = "ok"
+SCAN_TORN = "torn_tail"
+SCAN_CORRUPT = "corrupt"
+
+
+def _frame(payload: bytes) -> bytes:
+    return _MAGIC + _HEAD.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def _scan(buf) -> tuple[list[tuple[int, int]], str, int]:
+    """Walk ``buf`` frame by frame -> ([(payload_off, payload_len)],
+    status, bad_off). A bad frame ends the scan; status distinguishes a
+    torn tail (nothing parseable after it — the crash-mid-append shape,
+    safe to truncate) from mid-file corruption (a valid MAGIC exists
+    later — disk damage; the caller must assume records were lost)."""
+    frames: list[tuple[int, int]] = []
+    i, n = 0, len(buf)
+    while i < n:
+        end = i + _FRAME_OVERHEAD
+        if (buf[i:i + len(_MAGIC)] != _MAGIC or end > n):
+            break
+        plen, crc = _HEAD.unpack(buf[i + len(_MAGIC):end])
+        if end + plen > n or zlib.crc32(buf[end:end + plen]) != crc:
+            break
+        frames.append((end, plen))
+        i = end + plen
+    if i >= n:
+        return frames, SCAN_OK, n
+    # classify: any later frame boundary means the middle is damaged
+    status = SCAN_CORRUPT if buf.find(_MAGIC, i + 1) != -1 else SCAN_TORN
+    return frames, status, i
+
+
+def _pack_state(state: dict) -> bytes:
+    """Columnar segment payload: header JSON + value column (f32) +
+    bit-packed mask + NaN-timestamp column (f64)."""
+    values = np.ascontiguousarray(state["values"], dtype=np.float32)
+    mask = np.packbits(np.asarray(state["mask"], dtype=bool))
+    nan_ts = np.ascontiguousarray(state["nan_ts"], dtype=np.float64)
+    header = {
+        "key": state["key"],
+        "qstart": state["qstart"],
+        "qend": state["qend"],
+        "url_step": state["url_step"],
+        "start": int(state["start"]),
+        "step": int(state["step"]),
+        "n": int(values.shape[0]),
+        "n_nan": int(nan_ts.shape[0]),
+        "full_bytes": int(state["full_bytes"]),
+        "full_points": int(state["full_points"]),
+        "pushed_until": float(state["pushed_until"]),
+        "push_blocked": bool(state["push_blocked"]),
+    }
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    return (struct.pack("<I", len(hjson)) + hjson + values.tobytes()
+            + mask.tobytes() + nan_ts.tobytes())
+
+
+def _unpack_header(buf, off: int) -> tuple[dict, int]:
+    """(header, offset-of-columns) for the payload at ``off``."""
+    (hlen,) = struct.unpack_from("<I", buf, off)
+    header = json.loads(bytes(buf[off + 4:off + 4 + hlen]).decode())
+    return header, off + 4 + hlen
+
+
+def _unpack_state(buf, off: int) -> dict:
+    """Segment payload -> entry-state dict. ``values``/``nan_ts`` are
+    zero-copy ``np.frombuffer`` views over ``buf`` (the caller keeps the
+    mmap alive through the arrays' base reference); the mask unpacks to
+    a fresh bool array (bit-packed on disk)."""
+    header, coff = _unpack_header(buf, off)
+    n, n_nan = header["n"], header["n_nan"]
+    values = np.frombuffer(buf, dtype=np.float32, count=n, offset=coff)
+    moff = coff + 4 * n
+    mlen = (n + 7) // 8
+    mask = np.unpackbits(
+        np.frombuffer(buf, dtype=np.uint8, count=mlen, offset=moff),
+        count=n).astype(bool)
+    nan_ts = np.frombuffer(buf, dtype=np.float64, count=n_nan,
+                           offset=moff + mlen)
+    header["values"] = values
+    header["mask"] = mask
+    header["nan_ts"] = nan_ts
+    return header
+
+
+class WindowStore:
+    """Crash-durable tier under the delta window cache (module docstring).
+
+    Thread-safe: the WAL and the segment file each have their own lock;
+    neither is ever held while the other is taken, and no delta-cache
+    lock is held across a call in here (``DeltaWindowSource`` snapshots
+    under its locks and writes outside them)."""
+
+    def __init__(self, dir_path: str, segment_max_bytes: int = 256 << 20,
+                 fsync: bool = False, wal_injector=None,
+                 checkpoint_min_seconds: float = 5.0):
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+        self.seg_path = os.path.join(dir_path, "segments.dat")
+        self.wal_path = os.path.join(dir_path, "wal.log")
+        self.wal_old_path = os.path.join(dir_path, "wal.old")
+        self.segment_max_bytes = int(segment_max_bytes)
+        self.fsync = bool(fsync)
+        # chaos seam (resilience/faults.py, target ``wal``): a non-OK
+        # decision tears the next WAL frame mid-write — the crash-during-
+        # append shape the recovery scan must truncate cleanly
+        self.wal_injector = wal_injector
+        self.checkpoint_min_seconds = float(checkpoint_min_seconds)
+        self._wal_lock = make_lock("dataplane.winstore.wal")
+        self._seg_lock = make_lock("dataplane.winstore.segment")
+        # key -> (payload_off, payload_len) in the CURRENT segment file;
+        # newest-wins (later spills overwrite the slot)
+        self._index: dict[str, tuple[int, int]] = {}
+        self._seg_mm: mmap.mmap | None = None  # lazy, re-made on growth
+        self._seg_mm_size = 0
+        self._last_checkpoint = 0.0
+        # recovery INDICATOR (surfaced on /status): the last recover()
+        # hit WAL corruption and latched the store into resync. The
+        # latch itself lives in the entry/segment states, not here —
+        # see latch_warm_entries.
+        self.force_block = False
+        # observability (/status + /metrics)
+        self.spills = 0
+        self.promote_loads = 0
+        self.compactions = 0
+        self.wal_appends = 0
+        self.wal_samples = 0
+        self.wal_errors = 0
+        self.wal_torn_writes = 0
+        self.spill_errors = 0
+        self.checkpoints = 0
+        self.recovery: dict = {}
+
+    def count_spill_error(self, err) -> None:
+        """A spill write failed (disk full): callers on the fetch path
+        degrade instead of failing the cycle — the entry stays
+        poll-covered, and the counter is the operator's signal."""
+        self.spill_errors += 1
+        log.warning("segment spill failed (entry stays RAM/poll-covered "
+                    "until the next checkpoint): %s", err)
+
+    # ------------------------------------------------------------- helpers
+    def _append(self, path: str, payload: bytes, tear: bool = False) -> bool:
+        frame = _frame(payload)
+        if tear:
+            # torn write: only a prefix of the frame reaches the disk —
+            # what a crash mid-append leaves behind
+            frame = frame[:max(len(frame) // 2, 1)]
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, frame)
+            if self.fsync:
+                os.fsync(fd)
+        finally:
+            os.close(fd)
+        return True
+
+    @staticmethod
+    def _read_file(path: str) -> bytes:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return b""
+
+    def _seg_buffer(self):
+        """The segment file as an mmap covering its current size (made
+        under the segment lock; re-made after growth/compaction). The
+        returned buffer stays valid for outstanding ``np.frombuffer``
+        views even after a later compaction renames the file over it —
+        POSIX keeps the mapping alive."""
+        size = os.path.getsize(self.seg_path) \
+            if os.path.exists(self.seg_path) else 0
+        if size == 0:
+            return None
+        if self._seg_mm is None or self._seg_mm_size != size:
+            fd = os.open(self.seg_path, os.O_RDONLY)
+            try:
+                self._seg_mm = mmap.mmap(fd, size, access=mmap.ACCESS_READ)
+                self._seg_mm_size = size
+            finally:
+                os.close(fd)
+        return self._seg_mm
+
+    # ------------------------------------------------------------------ WAL
+    def wal_append(self, url: str, ts, vals) -> bool:
+        """Append one accepted push batch; called by the ingest receiver
+        BEFORE it acks. Failures degrade (counted, logged) rather than
+        fail the push: durability must not turn a full disk into an
+        ingest outage — the poll path still owns the data."""
+        ts_a = np.ascontiguousarray(ts, dtype=np.float64)
+        vals_a = np.ascontiguousarray(vals, dtype=np.float64)
+        header = json.dumps(
+            {"url": url, "n": int(ts_a.shape[0])},
+            separators=(",", ":")).encode()
+        payload = (struct.pack("<I", len(header)) + header
+                   + ts_a.tobytes() + vals_a.tobytes())
+        tear = False
+        if self.wal_injector is not None:
+            from ..resilience.faults import OK as _OK
+
+            tear = self.wal_injector.decide() != _OK
+        try:
+            with self._wal_lock:
+                self._append(self.wal_path, payload, tear=tear)
+                self.wal_appends += 1
+                self.wal_samples += int(ts_a.shape[0])
+                if tear:
+                    self.wal_torn_writes += 1
+        except OSError as e:
+            self.wal_errors += 1
+            log.warning("WAL append failed (push stays RAM-only until "
+                        "the next poll): %s", e)
+            return False
+        return True
+
+    @staticmethod
+    def _wal_records(buf):
+        """[(url, ts, vals)] decoded from one WAL buffer + scan status."""
+        frames, status, _ = _scan(buf)
+        records = []
+        for off, _plen in frames:
+            header, coff = _unpack_header(buf, off)
+            n = header["n"]
+            ts = np.frombuffer(buf, dtype=np.float64, count=n, offset=coff)
+            vals = np.frombuffer(buf, dtype=np.float64, count=n,
+                                 offset=coff + 8 * n)
+            records.append((header["url"], ts, vals))
+        return records, status
+
+    # ------------------------------------------------------------ segments
+    def spill(self, state: dict) -> None:
+        """Append one entry state to the warm segment (newest-wins) and
+        update the in-RAM index; compacts when the file outgrows its
+        budget."""
+        payload = _pack_state(state)
+        with self._seg_lock:
+            self._spill_locked(state["key"], payload)
+
+    def _spill_locked(self, key: str, payload: bytes) -> None:
+        size = os.path.getsize(self.seg_path) \
+            if os.path.exists(self.seg_path) else 0
+        self._append(self.seg_path, payload)
+        self._index[key] = (size + _FRAME_OVERHEAD, len(payload))
+        self.spills += 1
+        if size + _FRAME_OVERHEAD + len(payload) > self.segment_max_bytes:
+            self._compact_locked()
+
+    def latch_warm_entries(self) -> int:
+        """Rewrite every warm state carrying a pushed horizon with the
+        resync latch set (``push_blocked=True``, horizon cleared). Runs
+        ONCE at a corrupt-WAL recovery: no horizon on disk predating the
+        damage can be trusted, but the latch must live in the RECORDS —
+        a process-lifetime flag would re-latch entries that a poll
+        already healed and re-spilled, degrading every later promote
+        into a full refetch forever."""
+        latched = 0
+        with self._seg_lock:
+            buf = self._seg_buffer()
+            if buf is None:
+                return 0
+            states = []
+            for key, (off, _plen) in list(self._index.items()):
+                try:
+                    state = _unpack_state(buf, off)
+                except (ValueError, KeyError, json.JSONDecodeError):
+                    continue
+                if state["push_blocked"] and state["pushed_until"] == 0.0:
+                    continue
+                state["push_blocked"] = True
+                state["pushed_until"] = 0.0
+                states.append(state)
+            for state in states:
+                # the states' columns are views over the old mapping,
+                # which stays valid through these appends/compactions
+                self._spill_locked(state["key"], _pack_state(state))
+                latched += 1
+        return latched
+
+    def load(self, key: str) -> dict | None:
+        """Entry state for ``key`` from the warm tier, or None. Values/
+        NaN columns are zero-copy views over the segment mmap."""
+        with self._seg_lock:
+            loc = self._index.get(key)
+            if loc is None:
+                return None
+            buf = self._seg_buffer()
+            if buf is None or loc[0] + loc[1] > len(buf):
+                return None
+            try:
+                state = _unpack_state(buf, loc[0])
+            except (ValueError, KeyError, json.JSONDecodeError):
+                # a record the index points at no longer parses: drop it
+                # (the poll path re-primes the entry from the backend)
+                self._index.pop(key, None)
+                return None
+            self.promote_loads += 1
+            return state
+
+    def _compact_locked(self) -> None:
+        """Rewrite the segment keeping only each key's newest record
+        (the LRU's keys are a subset — dead keys age out here). Atomic:
+        build ``.tmp``, rename over, re-index."""
+        buf = self._seg_buffer()
+        if buf is None:
+            return
+        tmp = self.seg_path + ".tmp"
+        new_index: dict[str, tuple[int, int]] = {}
+        with open(tmp, "wb") as f:
+            off = 0
+            for key, (poff, plen) in self._index.items():
+                if poff + plen > len(buf):
+                    continue
+                payload = bytes(buf[poff:poff + plen])
+                f.write(_frame(payload))
+                new_index[key] = (off + _FRAME_OVERHEAD, len(payload))
+                off += _FRAME_OVERHEAD + len(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.seg_path)
+        self._index = new_index
+        self._seg_mm = None  # old views stay valid; next read re-maps
+        self._seg_mm_size = 0
+        self.compactions += 1
+
+    def _build_index_locked(self) -> tuple[int, str]:
+        """Rebuild the index from the segment file. Returns (#frames
+        indexed, scan status) — a torn segment tail just loses the one
+        frame the crash was writing (its entry re-primes from the
+        backend)."""
+        self._index = {}
+        self._seg_mm = None
+        self._seg_mm_size = 0
+        buf = self._seg_buffer()
+        if buf is None:
+            return 0, SCAN_OK
+        frames, status, _ = _scan(buf)
+        for off, plen in frames:
+            try:
+                header, _ = _unpack_header(buf, off)
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+            self._index[header["key"]] = (off, plen)
+        return len(frames), status
+
+    # ------------------------------------------------------------ recovery
+    def recover(self, delta) -> dict:
+        """Boot-time replay: rebuild the segment index, replay
+        ``wal.old`` + ``wal.log`` through ``delta.ingest_append`` (which
+        promotes segment entries on demand), then checkpoint so the WAL
+        restarts empty. Idempotent — replaying a record whose samples
+        the cache already holds is a counted ``stale`` no-op, which is
+        also why crashing anywhere inside a previous checkpoint is safe.
+
+        On WAL corruption (valid frames after a bad one): stop there,
+        latch every recovered entry into resync (``force_block``) so the
+        poll path re-syncs from the backend before any further push is
+        trusted — the PR 12 latch, applied store-wide."""
+        t0 = time.monotonic()
+        with self._seg_lock:
+            seg_frames, seg_status = self._build_index_locked()
+            seg_entries = len(self._index)
+        replayed = spliced = stale = dropped = 0
+        wal_status = SCAN_OK
+        for path in (self.wal_old_path, self.wal_path):
+            buf = self._read_file(path)
+            if not buf:
+                continue
+            records, status = self._wal_records(buf)
+            if status == SCAN_CORRUPT:
+                wal_status = SCAN_CORRUPT
+            elif status == SCAN_TORN and wal_status == SCAN_OK:
+                wal_status = SCAN_TORN
+            for url, ts, vals in records:
+                replayed += 1
+                res = delta.ingest_append(url, ts, vals)
+                if res.get("spliced"):
+                    spliced += res["spliced"]
+                elif res.get("reason") == "stale":
+                    stale += 1
+                else:
+                    dropped += 1
+        if wal_status == SCAN_CORRUPT:
+            # records after the damage are LOST while the backend still
+            # has them: no pushed horizon recovered here can be trusted.
+            # Latch the hot entries in place and REWRITE the warm states
+            # with the latch (not a live flag — states spilled after
+            # recovery carry their own healed latch state, and must not
+            # be re-latched on every later promote).
+            self.force_block = True  # recovery indicator (/status)
+            delta.force_resync()
+            latched = self.latch_warm_entries()
+            log.warning("WAL corruption mid-file: replay stopped; all "
+                        "recovered entries latched into resync (%d warm "
+                        "states rewritten; the poll path re-establishes "
+                        "the backend as truth)", latched)
+        # fold the replayed state into segments and start a fresh WAL;
+        # force past the rate limit — boot is exactly once
+        self.checkpoint(delta, force=True)
+        self.recovery = {
+            "segment_frames": seg_frames,
+            "segment_entries": seg_entries,
+            "segment_scan": seg_status,
+            "wal_records_replayed": replayed,
+            "wal_samples_spliced": spliced,
+            "wal_records_stale": stale,
+            "wal_records_dropped": dropped,
+            "wal_scan": wal_status,
+            "seconds": round(time.monotonic() - t0, 4),
+        }
+        return dict(self.recovery)
+
+    # ---------------------------------------------------------- checkpoint
+    def checkpoint(self, delta, force: bool = False) -> dict:
+        """Rotate WAL -> spill dirty hot entries -> drop the rotated
+        generation. Rate-limited (``checkpoint_min_seconds``) so the
+        scheduler can call it after every partial cycle without
+        thrashing the disk; the full sweep and shutdown pass force=True
+        semantics via cadence/explicitly."""
+        now = time.monotonic()
+        if not force and now - self._last_checkpoint \
+                < self.checkpoint_min_seconds:
+            return {}
+        self._last_checkpoint = now
+        with self._wal_lock:
+            wal_bytes = os.path.getsize(self.wal_path) \
+                if os.path.exists(self.wal_path) else 0
+            had_old = os.path.exists(self.wal_old_path)
+            if wal_bytes and not had_old:
+                os.replace(self.wal_path, self.wal_old_path)
+        spilled = delta.spill_dirty()
+        # only drop the rotated generation once the spill committed its
+        # contents (or proved there was nothing dirty to commit)
+        with self._wal_lock:
+            try:
+                os.unlink(self.wal_old_path)
+            except FileNotFoundError:
+                pass
+        self.checkpoints += 1
+        return {"spilled": spilled, "wal_bytes_rotated": wal_bytes}
+
+    # ------------------------------------------------------- observability
+    def snapshot(self) -> dict:
+        with self._seg_lock:
+            seg_entries = len(self._index)
+        seg_bytes = os.path.getsize(self.seg_path) \
+            if os.path.exists(self.seg_path) else 0
+        wal_bytes = os.path.getsize(self.wal_path) \
+            if os.path.exists(self.wal_path) else 0
+        return {
+            "dir": self.dir,
+            "segment_bytes": seg_bytes,
+            "segment_entries": seg_entries,
+            "wal_bytes": wal_bytes,
+            "wal_appends": self.wal_appends,
+            "wal_samples": self.wal_samples,
+            "wal_errors": self.wal_errors,
+            "wal_torn_writes": self.wal_torn_writes,
+            "spill_errors": self.spill_errors,
+            "spills": self.spills,
+            "promote_loads": self.promote_loads,
+            "compactions": self.compactions,
+            "checkpoints": self.checkpoints,
+            "force_block": self.force_block,
+            "recovery": dict(self.recovery),
+        }
+
+    # ---------------------------------------------------------- entry glue
+    @staticmethod
+    def state_window(state: dict) -> Window:
+        """Entry-state dict -> grid Window (promote path)."""
+        return Window(state["values"], state["mask"],
+                      int(state["start"]), int(state["step"]))

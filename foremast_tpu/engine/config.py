@@ -92,7 +92,11 @@ class EngineConfig:
     delta_fetch: bool = True
     # delta window-cache entries (WINDOW_CACHE_MAX): one per distinct
     # (query, window-role) URL identity — ~3 per job; also bounds the
-    # score-memo table at 4x this value
+    # score-memo table at 4x this value. This is the HOT-tier (RAM)
+    # ceiling: with WINDOW_STORE_DIR set (dataplane/winstore.py),
+    # eviction spills dirty entries to the columnar warm segment and a
+    # miss promotes them back, so at million-job scale this knob bounds
+    # resident window memory without forfeiting the cached state.
     window_cache_max: int = 8192
     # fingerprint score memoization (SCORE_MEMO; engine/pipeline.py):
     # hash each job's packed scorer inputs per (job, family, T-bucket) and

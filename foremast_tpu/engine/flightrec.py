@@ -38,7 +38,7 @@ __all__ = [
     "EVENT_STALE_SERVE", "EVENT_WATCHDOG", "EVENT_BREAKER",
     "EVENT_LEASE_HANDOFF", "EVENT_DUMP",
     "EVENT_REPLICA_JOIN", "EVENT_REPLICA_LEAVE", "EVENT_REBALANCE",
-    "EVENT_SHARD_ADOPTION",
+    "EVENT_SHARD_ADOPTION", "EVENT_STORE_RECOVERY",
 ]
 
 # -- event-type registry -----------------------------------------------------
@@ -57,12 +57,17 @@ EVENT_REPLICA_JOIN = "replica-join"
 EVENT_REPLICA_LEAVE = "replica-leave"
 EVENT_REBALANCE = "shard-rebalance"
 EVENT_SHARD_ADOPTION = "shard-adoption"
+# crash-durable window store (dataplane/winstore.py): boot-time
+# segment+WAL replay finished — detail carries the recovery stats
+# (replayed records, scan statuses, seconds), so an incident dump after
+# a restart self-documents what the replica recovered from disk
+EVENT_STORE_RECOVERY = "window-store-recovery"
 
 EVENT_TYPES = frozenset({
     EVENT_HEALTH_TRANSITION, EVENT_SHED, EVENT_QUARANTINE,
     EVENT_STALE_SERVE, EVENT_WATCHDOG, EVENT_BREAKER, EVENT_LEASE_HANDOFF,
     EVENT_DUMP, EVENT_REPLICA_JOIN, EVENT_REPLICA_LEAVE, EVENT_REBALANCE,
-    EVENT_SHARD_ADOPTION,
+    EVENT_SHARD_ADOPTION, EVENT_STORE_RECOVERY,
 })
 
 MAX_DUMPS = 8  # newest dump files kept on disk per dump dir

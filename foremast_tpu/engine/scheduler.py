@@ -84,9 +84,17 @@ class StreamScheduler:
     def __init__(self, analyzer: Analyzer, full_cycle_fn,
                  cycle_seconds: float = 10.0, worker: str = "worker-0",
                  debounce_seconds: float = 0.15,
-                 max_partial_jobs: int = 4096, exporter=None):
+                 max_partial_jobs: int = 4096, exporter=None,
+                 checkpoint_fn=None):
         self.analyzer = analyzer
         self.full_cycle_fn = full_cycle_fn
+        # durability chore after each partial cycle (the runtime's
+        # window-store checkpoint): pushed-dirtied window state folds
+        # into the warm segments between sweeps, so a long CYCLE_SECONDS
+        # under sustained push traffic bounds WAL growth at the
+        # checkpoint rate limit, not the sweep cadence. Best-effort —
+        # the callee rate-limits and swallows its own I/O failures.
+        self.checkpoint_fn = checkpoint_fn
         self.cycle_seconds = max(float(cycle_seconds), 0.05)
         self.worker = worker
         # pushes arrive per scrape target; the debounce window folds one
@@ -204,6 +212,11 @@ class StreamScheduler:
                          "cycles")
         except Exception:  # noqa: BLE001 - the loop must survive
             log.exception("partial cycle failed")
+        if self.checkpoint_fn is not None:
+            try:
+                self.checkpoint_fn()
+            except Exception:  # noqa: BLE001 - durability is best-effort
+                log.exception("post-partial checkpoint failed")
         return True
 
     # ------------------------------------------------------ observability

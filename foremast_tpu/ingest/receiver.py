@@ -185,12 +185,19 @@ class IngestReceiver:
                  shard=None, exporter=None, notify_fn=None,
                  buffer_samples: int = 4096, buffer_jobs: int = 8192,
                  forward: bool = True, forward_timeout: float = 2.0,
-                 index_ttl: float = 2.0):
+                 index_ttl: float = 2.0, window_store=None):
         self.store = store
         self.delta = delta_source
         self.cache = cache_source
         self.shard = shard
         self.exporter = exporter
+        # crash-durability seam (dataplane/winstore.py): every push
+        # batch that ADVANCES the cached window is WAL'd before this
+        # receiver returns — the HTTP ack only leaves the process after
+        # handle() does, so an /ingest/* 2xx means the spliced samples
+        # survive kill -9 (batches that didn't splice are poll-covered:
+        # the backend remains their source of truth)
+        self.window_store = window_store
         # scheduler tap (engine/scheduler.py StreamScheduler.notify);
         # the runtime wires it after the scheduler exists
         self.notify_fn = notify_fn
@@ -443,7 +450,28 @@ class IngestReceiver:
             # whole — same hole hazard as an overflow
             self.delta.ingest_block(url)
             return False, "off_grid", advanced
+        if reason == "late":
+            # cross-batch reorder: the splice latched the entry into
+            # resync itself (a late timestamp the cache doesn't hold
+            # would punch a hole the backend doesn't have); the poll
+            # path heals and the stream re-arms
+            return False, "late", advanced
         if res.get("spliced"):
+            if self.window_store is not None:
+                # durability before the ack, AFTER the splice: the WAL
+                # holds exactly the batches that advanced durable state,
+                # and because the splice dirty-marks the entry BEFORE the
+                # record exists, a concurrent checkpoint can never drop a
+                # record whose effect isn't already in a segment (rotate
+                # -> spill -> unlink always captures one or the other).
+                # Batches that did NOT splice need no WAL: no_entry stays
+                # in the RAM staging buffer with the poll path as its
+                # source of truth, stale is already durable, off_grid/
+                # late were rejected and latched. Replay stays idempotent
+                # either way (stale rejection).
+                self.window_store.wal_append(
+                    url, [ts for ts, _ in staged],
+                    [v for _, v in staged])
             with self._lock:
                 self.spliced_points_total += int(res["spliced"])
             if self.exporter is not None:
@@ -537,4 +565,7 @@ class IngestReceiver:
                 "buffered_samples": self._buffer.total,
                 "buffer_fill_ratio": round(self._buffer.fill_ratio(), 4),
                 "snappy": snappy_available(),
+                # True => accepted pushes are WAL'd before the ack
+                # (docs/operations.md "Surviving a restart")
+                "durable": self.window_store is not None,
             }

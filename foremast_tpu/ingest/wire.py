@@ -295,6 +295,15 @@ def encode_remote_write(series: list[tuple[dict, list]]) -> bytes:
 
 
 # ---------------------------------------------------------------- OTLP JSON
+def _seq(v) -> tuple | list:
+    """A JSON value that SHOULD be an array, defensively: anything else
+    (int, string, object — type-confused or hostile bodies) iterates as
+    empty instead of raising out of the decode path. Found by the ingest
+    fuzz suite: ``{"resourceMetrics": 5}`` must 400/skip, not crash the
+    receiver thread."""
+    return v if isinstance(v, (list, tuple)) else ()
+
+
 def _otlp_attr_value(v: dict) -> str:
     for key in ("stringValue", "intValue", "doubleValue", "boolValue"):
         if key in v:
@@ -304,7 +313,7 @@ def _otlp_attr_value(v: dict) -> str:
 
 def _otlp_attrs(attrs) -> dict:
     out = {}
-    for kv in attrs or ():
+    for kv in _seq(attrs):
         if isinstance(kv, dict) and isinstance(kv.get("key"), str):
             out[kv["key"]] = _otlp_attr_value(kv.get("value") or {})
     return out
@@ -327,22 +336,22 @@ def decode_otlp_json(raw: bytes) -> list[tuple[dict, list]]:
     if not isinstance(body, dict):
         raise IngestDecodeError("OTLP body must be a JSON object")
     series = []
-    for rm in body.get("resourceMetrics") or ():
+    for rm in _seq(body.get("resourceMetrics")):
         if not isinstance(rm, dict):
             continue
         res_attrs = _otlp_attrs(
             (rm.get("resource") or {}).get("attributes"))
-        for sm in rm.get("scopeMetrics") or ():
+        for sm in _seq(rm.get("scopeMetrics")):
             if not isinstance(sm, dict):
                 continue
-            for metric in sm.get("metrics") or ():
+            for metric in _seq(sm.get("metrics")):
                 if not isinstance(metric, dict):
                     continue
                 name = metric.get("name", "")
                 points = None
                 for kind in ("gauge", "sum"):
                     if isinstance(metric.get(kind), dict):
-                        points = metric[kind].get("dataPoints") or ()
+                        points = _seq(metric[kind].get("dataPoints"))
                         break
                 if points is None:
                     continue

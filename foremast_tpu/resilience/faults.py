@@ -10,7 +10,7 @@ FOREMAST_CHAOS grammar (full reference: docs/resilience.md):
     spec    := clause (';' clause)*
     clause  := 'seed=' INT
              | target '.' fault '=' value
-    target  := 'fetch' | 'archive' | 'kube'
+    target  := 'fetch' | 'archive' | 'kube' | 'push' | 'wal'
     fault   := 'error'   '=' PROB            random injected error
              | 'latency' '=' PROB ':' SECS   random added latency
              | 'timeout' '=' PROB ':' SECS   latency then error (slow fail)
@@ -30,6 +30,22 @@ FOREMAST_CHAOS grammar (full reference: docs/resilience.md):
                                              for SECS — the transport
                                              timeout, nothing returned
                                              sooner — then fails
+             | 'duplicate' '=' PROB          push target: a batch is
+                                             delivered TWICE (remote-
+                                             write retry after a lost
+                                             ack)
+             | 'reorder' '=' PROB            push target: samples within
+                                             the batch arrive shuffled
+             | 'late'    '=' PROB ':' HOLD   push target: the batch is
+                                             held back and delivered
+                                             after HOLD later batches
+                                             (out-of-order delivery
+                                             across requests)
+             | 'torn'    '=' PROB            wal target: the WAL frame
+                                             is written only half-way
+                                             (crash mid-append) — the
+                                             recovery scan must truncate
+                                             it cleanly
 
     example: "seed=42;fetch.error=0.3;fetch.latency=0.2:0.05;archive.outage=40..80"
 
@@ -98,12 +114,23 @@ class FaultPlan:
     # — nothing comes back sooner), then fail
     hang_rate: float = 0.0
     hang_seconds: float = 0.0
+    # push-path delivery chaos (target ``push``; FaultyPushStream):
+    # duplicated batches, shuffled in-batch sample order, and batches
+    # held back `late_hold` deliveries (out-of-order across requests)
+    duplicate_rate: float = 0.0
+    reorder_rate: float = 0.0
+    late_rate: float = 0.0
+    late_hold: int = 0
+    # torn WAL writes (target ``wal``; dataplane/winstore.py): the frame
+    # reaches the disk only half-way, as a crash mid-append would leave it
+    torn_rate: float = 0.0
 
     def active(self) -> bool:
         return bool(
             self.error_rate or self.latency_rate or self.timeout_rate
             or self.garbage_rate or self.flap_down or self.outages
-            or self.spikes or self.hang_rate
+            or self.spikes or self.hang_rate or self.duplicate_rate
+            or self.reorder_rate or self.late_rate or self.torn_rate
         )
 
 
@@ -133,7 +160,8 @@ def parse_chaos_spec(spec: str) -> tuple[int, dict[str, FaultPlan]]:
             seed = int(value)
             continue
         target, dot, fault = key.partition(".")
-        if not dot or target not in ("fetch", "archive", "kube"):
+        if not dot or target not in ("fetch", "archive", "kube", "push",
+                                     "wal"):
             raise ValueError(f"chaos clause {clause!r}: unknown target")
         plan = plans.setdefault(target, FaultPlan())
         if fault == "error":
@@ -162,13 +190,30 @@ def parse_chaos_spec(spec: str) -> tuple[int, dict[str, FaultPlan]]:
             plan.spikes.append((int(lo), int(hi), float(secs)))
         elif fault == "hang":
             plan.hang_rate, plan.hang_seconds = _parse_pair(value, fault)
+        elif fault == "duplicate":
+            if target != "push":
+                raise ValueError("duplicate applies to the push target only")
+            plan.duplicate_rate = float(value)
+        elif fault == "reorder":
+            if target != "push":
+                raise ValueError("reorder applies to the push target only")
+            plan.reorder_rate = float(value)
+        elif fault == "late":
+            if target != "push":
+                raise ValueError("late applies to the push target only")
+            rate, hold = _parse_pair(value, fault)
+            plan.late_rate, plan.late_hold = rate, max(int(hold), 1)
+        elif fault == "torn":
+            if target != "wal":
+                raise ValueError("torn applies to the wal target only")
+            plan.torn_rate = float(value)
         else:
             raise ValueError(f"chaos clause {clause!r}: unknown fault {fault!r}")
     return seed, plans
 
 
 # decision tokens returned by FaultInjector.decide()
-OK, ERROR, GARBAGE = "ok", "error", "garbage"
+OK, ERROR, GARBAGE, TORN = "ok", "error", "garbage", "torn"
 
 
 class FaultInjector:
@@ -189,6 +234,13 @@ class FaultInjector:
         self.injected_errors = 0
         self.injected_latency = 0
         self.injected_garbage = 0
+        self.injected_torn = 0
+        # push-path stream (decide_push): its own call counter so adding
+        # push clauses never shifts the decide() stream's indices
+        self.push_calls = 0
+        self.injected_duplicates = 0
+        self.injected_reorders = 0
+        self.injected_late = 0
 
     def decide(self) -> str:
         """Advance one call: maybe sleep (latency), then return OK / ERROR
@@ -239,6 +291,8 @@ class FaultInjector:
                 outcome = ERROR
             elif p.garbage_rate > 0 and self._rng.random() < p.garbage_rate:
                 outcome = GARBAGE
+            elif p.torn_rate > 0 and self._rng.random() < p.torn_rate:
+                outcome = TORN
             if outcome == OK and p.latency_rate > 0 \
                     and self._rng.random() < p.latency_rate:
                 delay = p.latency_seconds
@@ -247,6 +301,8 @@ class FaultInjector:
                 self.injected_errors += 1
             elif outcome == GARBAGE:
                 self.injected_garbage += 1
+            elif outcome == TORN:
+                self.injected_torn += 1
             if delay > 0:
                 self.injected_latency += 1
         if delay > 0:
@@ -257,6 +313,36 @@ class FaultInjector:
         with self._lock:
             body = GARBAGE_BODIES[self.injected_garbage % len(GARBAGE_BODIES)]
         return body
+
+    def decide_push(self) -> tuple[bool, bool, bool]:
+        """Advance one PUSH delivery: (duplicate, reorder, late). Its own
+        counter and draw chain, so configuring push chaos never shifts
+        the decide() stream (and vice versa — the two streams share one
+        seeded RNG, but each draw is gated on its own rate, and mixing
+        push clauses with call-path clauses on one target is not a
+        supported plan shape)."""
+        p = self.plan
+        with self._lock:
+            self.push_calls += 1
+            dup = p.duplicate_rate > 0 \
+                and self._rng.random() < p.duplicate_rate
+            reorder = p.reorder_rate > 0 \
+                and self._rng.random() < p.reorder_rate
+            late = p.late_rate > 0 and self._rng.random() < p.late_rate
+            if dup:
+                self.injected_duplicates += 1
+            if reorder:
+                self.injected_reorders += 1
+            if late:
+                self.injected_late += 1
+        return dup, reorder, late
+
+    def shuffled(self, seq: list) -> list:
+        """Deterministically shuffled copy (the reorder fault)."""
+        out = list(seq)
+        with self._lock:
+            self._rng.shuffle(out)
+        return out
 
 
 class FaultyDataSource:
@@ -345,6 +431,55 @@ class FaultyArchive:
 
     def search(self, *args, **kw):
         return self._act("search", [], *args, **kw)
+
+
+class FaultyPushStream:
+    """Chaos wrapper for a PUSH batch stream (target ``push``): the
+    delivery faults a real remote-write client inflicts — duplicated
+    batches (retry after a lost ack), shuffled in-batch sample order,
+    and batches held back to arrive after later ones. Deterministic from
+    the injector's seed, like every other chaos shape.
+
+    ``mutate(batch)`` maps one would-be delivery onto the list of
+    batches to deliver NOW (empty when held late, several when a
+    duplicate or a held batch's release rides along); ``flush()`` drains
+    anything still held — call it when the stream ends, or the late
+    batches were simply dropped (which the receiver must ALSO survive:
+    the poll path owns them)."""
+
+    def __init__(self, injector: FaultInjector):
+        self.injector = injector
+        # [(release_after_push_call, batch), ...]
+        self._held: list = []
+
+    def mutate(self, batch):
+        inj = self.injector
+        dup, reorder, late = inj.decide_push()
+        if reorder:
+            labels, samples = batch
+            batch = (labels, inj.shuffled(samples))
+        out = []
+        if late:
+            self._held.append((inj.push_calls + inj.plan.late_hold, batch))
+        else:
+            out.append(batch)
+            if dup:
+                out.append(batch)
+        # release held batches whose hold window has passed — AFTER the
+        # current batch, which is exactly the out-of-order shape
+        still = []
+        for release_at, held in self._held:
+            if inj.push_calls >= release_at:
+                out.append(held)
+            else:
+                still.append((release_at, held))
+        self._held = still
+        return out
+
+    def flush(self):
+        out = [b for _, b in self._held]
+        self._held = []
+        return out
 
 
 class FaultyKube:

@@ -42,6 +42,13 @@ Env surface (union of the reference services'):
                          ring's advertised addresses (docs/operations.md
                          "Running push ingestion"); INGEST=0 restores
                          the pure poll loop exactly
+  WINDOW_STORE_DIR /     crash-durable window tier (dataplane/
+  WINDOW_STORE_*         winstore.py): accepted pushes WAL'd before
+                         their /ingest ack, warm windows spilled to
+                         columnar mmap-read segments, boot replays both
+                         so a restarted replica serves covered windows
+                         with zero backend refetches (docs/operations.md
+                         "Surviving a restart"); unset = RAM-only
   SLO_CANARY_S /         detection-latency SLO targets per job class and
   SLO_CONTINUOUS_S /     the attainment objective the error budget
   SLO_HPA_S /            derives from (engine/slo.py; histograms + burn
@@ -142,6 +149,10 @@ class Runtime:
         ingest_forward: bool = True,
         ingest_advertise_addr: str = "",
         ingest_debounce_ms: float = 150.0,
+        window_store_dir: str = "",
+        window_store_segment_max_mb: int = 256,
+        window_store_fsync: bool = False,
+        window_store_checkpoint_seconds: float = 5.0,
     ):
         self.config = config or from_env()
         # persistent XLA compile cache (COMPILE_CACHE_PATH): point the
@@ -235,6 +246,30 @@ class Runtime:
             source = DeltaWindowSource(
                 source, max_entries=self.config.window_cache_max)
             self.delta_source = source
+        # -- crash-durable window store (WINDOW_STORE_DIR;
+        # dataplane/winstore.py): per-replica push WAL + columnar warm
+        # segments under the delta cache. Boot replays segments+WAL so a
+        # restarted replica serves its covered windows without a refetch
+        # storm; every accepted push is WAL'd before its /ingest ack.
+        # Empty dir (the default) = window state is RAM-only, exactly as
+        # before. --
+        self.window_store = None
+        self._recovery_stats = None
+        if window_store_dir and self.delta_source is not None:
+            from .dataplane.winstore import WindowStore
+
+            self.window_store = WindowStore(
+                window_store_dir,
+                segment_max_bytes=max(int(window_store_segment_max_mb), 1)
+                * (1 << 20),
+                fsync=window_store_fsync,
+                wal_injector=self.chaos_injectors.get("wal"),
+                checkpoint_min_seconds=window_store_checkpoint_seconds,
+            )
+            self.delta_source.store = self.window_store
+            self._recovery_stats = self.window_store.recover(
+                self.delta_source)
+            log.info("window store recovered: %s", self._recovery_stats)
         self.cache_source = None
         if cache:
             source = CachingDataSource(source, max_entries=self.config.max_cache_size)
@@ -253,6 +288,13 @@ class Runtime:
         self.analyzer = Analyzer(
             self.config, self.source, self.store, exporter=self.exporter
         )
+        if self._recovery_stats is not None:
+            # the restart self-documents: an incident dump shortly after
+            # boot carries what the replica replayed from disk
+            from .engine.flightrec import EVENT_STORE_RECOVERY
+
+            self.analyzer.flight.record_event(
+                EVENT_STORE_RECOVERY, **self._recovery_stats)
         # health state machine wiring (engine/health.py): merge every live
         # breaker board (data source + archive) into the DEGRADED signal;
         # cycle cadence lands in start() where it is known
@@ -356,6 +398,7 @@ class Runtime:
                 exporter=self.exporter,
                 buffer_samples=ingest_buffer_samples,
                 forward=ingest_forward,
+                window_store=self.window_store,
             )
         # event-driven scheduler (engine/scheduler.py StreamScheduler):
         # constructed in start() where cadence + worker name are known
@@ -365,6 +408,7 @@ class Runtime:
             analyzer=self.analyzer, resilience=self.resilience,
             delta_source=self.delta_source, cache_source=self.cache_source,
             shard=self.shard, ingest=self.ingest,
+            window_store=self.window_store,
         )
         self.service.chaos_active = bool(self.chaos_injectors)
         self.wavefront_sink = wavefront_sink
@@ -504,7 +548,12 @@ class Runtime:
             full_cycle_fn=lambda: self._full_sweep(worker),
             cycle_seconds=cycle_seconds, worker=worker,
             debounce_seconds=self.ingest_debounce_seconds,
-            exporter=self.exporter)
+            exporter=self.exporter,
+            # push-dirtied window state folds into segments between
+            # sweeps too (rate-limited inside the store), bounding WAL
+            # growth under sustained push traffic with a long cadence
+            checkpoint_fn=(self._store_checkpoint
+                           if self.window_store is not None else None))
         self.scheduler = sched
         self.service.scheduler = sched
         if self.ingest is not None:
@@ -586,6 +635,18 @@ class Runtime:
             except Exception as e:  # noqa: BLE001
                 log.warning("lstm cache save failed: %s", e)
         self.store.gc(max_age_seconds=self.job_retention_seconds)
+        self._store_checkpoint()
+
+    def _store_checkpoint(self, force: bool = False):
+        """Fold dirty window state into the warm segments and rotate the
+        WAL (dataplane/winstore.py). Own try: a full disk must degrade
+        durability, never stop the scoring loop."""
+        if self.window_store is None:
+            return
+        try:
+            self.window_store.checkpoint(self.delta_source, force=force)
+        except Exception:  # noqa: BLE001 - durability is best-effort
+            log.exception("window-store checkpoint failed")
 
     def request_stop(self):
         """Signal-safe: ask run_forever to exit and shut down cleanly
@@ -664,6 +725,9 @@ class Runtime:
                 prev = n
                 self.store.flush()
                 time.sleep(0.05)
+        # final window-store checkpoint: the next boot recovers every
+        # window this process ever cached, not just the last sweep's
+        self._store_checkpoint(force=True)
         # incident flight recorder: a SIGTERM mid-incident must leave a
         # self-contained artifact (events + traces + provenance + knobs)
         # even when nobody was watching the pod. Best-effort by design.
@@ -765,6 +829,11 @@ def main():
         ingest_forward=knobs.read("INGEST_FORWARD"),
         ingest_advertise_addr=knobs.read("INGEST_ADVERTISE_ADDR"),
         ingest_debounce_ms=knobs.read("INGEST_DEBOUNCE_MS"),
+        window_store_dir=knobs.read("WINDOW_STORE_DIR"),
+        window_store_segment_max_mb=knobs.read("WINDOW_STORE_SEGMENT_MAX_MB"),
+        window_store_fsync=knobs.read("WINDOW_STORE_FSYNC"),
+        window_store_checkpoint_seconds=knobs.read(
+            "WINDOW_STORE_CHECKPOINT_S"),
     )
     proxy = knobs.read("WAVEFRONT_PROXY")
     if proxy:

@@ -295,7 +295,7 @@ class ForemastService:
     def __init__(self, store: JobStore, exporter: VerdictExporter | None = None,
                  query_endpoint: str = "", analyzer=None, resilience=None,
                  delta_source=None, cache_source=None, shard=None,
-                 ingest=None, scheduler=None):
+                 ingest=None, scheduler=None, window_store=None):
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.query_endpoint = query_endpoint  # metric-store base for the proxy
@@ -321,6 +321,10 @@ class ForemastService:
         # StreamScheduler, stamped by the runtime at start): /status gets
         # the partial-cycle counters and the pending-job depth
         self.scheduler = scheduler
+        # optional crash-durable window store (dataplane/winstore.py):
+        # /status gets segment/WAL/recovery stats, /metrics the
+        # window_store gauges (docs/operations.md "Surviving a restart")
+        self.window_store = window_store
         self.chaos_active = False  # stamped by the runtime when chaos is on
         # set by make_server: () -> the HTTP admission gate's shed counter
         self.http_shed_count = None
@@ -632,6 +636,51 @@ class ForemastService:
             lines.append(
                 "foremastbrain:ingest_served_windows_total "
                 f"{snap['ingest_hits']}")
+            if self.window_store is not None:
+                # warm-tier traffic lives on the delta source (one
+                # snapshot serves both families)
+                lines.append(
+                    "foremastbrain:window_store_warm_promotes_total "
+                    f"{snap['warm_promotes']}")
+                lines.append(
+                    "foremastbrain:window_store_warm_spills_total "
+                    f"{snap['warm_spills']}")
+        if self.window_store is not None:
+            # crash-durable tier health: on-disk footprint, WAL/spill
+            # traffic, and what the last boot replayed
+            ws = self.window_store.snapshot()
+            lines.append(
+                f"foremastbrain:window_store_segment_bytes "
+                f"{ws['segment_bytes']}")
+            lines.append(
+                "foremastbrain:window_store_segment_entries "
+                f"{ws['segment_entries']}")
+            lines.append(
+                f"foremastbrain:window_store_wal_bytes {ws['wal_bytes']}")
+            lines.append(
+                "foremastbrain:window_store_wal_appends_total "
+                f"{ws['wal_appends']}")
+            lines.append(
+                "foremastbrain:window_store_wal_errors_total "
+                f"{ws['wal_errors']}")
+            lines.append(
+                "foremastbrain:window_store_spill_errors_total "
+                f"{ws['spill_errors']}")
+            lines.append(
+                f"foremastbrain:window_store_spills_total {ws['spills']}")
+            lines.append(
+                "foremastbrain:window_store_checkpoints_total "
+                f"{ws['checkpoints']}")
+            lines.append(
+                "foremastbrain:window_store_compactions_total "
+                f"{ws['compactions']}")
+            rec = ws.get("recovery") or {}
+            lines.append(
+                "foremastbrain:window_store_recovery_seconds "
+                f"{rec.get('seconds', 0)}")
+            lines.append(
+                "foremastbrain:window_store_wal_replayed_total "
+                f"{rec.get('wal_records_replayed', 0)}")
         if self.http_shed_count is not None:
             lines.append(f"foremast_http_shed_total {self.http_shed_count()}")
         self_gauges = "\n".join(lines) + "\n"
@@ -679,6 +728,11 @@ class ForemastService:
             # event-driven scheduling: partial cycles vs sweeps, pending
             # pushed jobs awaiting their partial cycle
             out["scheduler"] = self.scheduler.snapshot()
+        if self.window_store is not None:
+            # crash-durable window tier: segment/WAL footprint, spill/
+            # promote traffic, and the last boot's replay stats
+            # (docs/operations.md "Surviving a restart")
+            out["window_store"] = self.window_store.snapshot()
         if self.shard is not None:
             # sharded-brain view: which slice of the fleet this replica
             # owns, membership health, rebalance/handoff history

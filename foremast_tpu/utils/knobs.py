@@ -230,6 +230,22 @@ register("INGEST_DEBOUNCE_MS", 150.0, float,
          "partial-cycle debounce: how long the event scheduler lets a "
          "push burst coalesce before scoring the advanced jobs")
 
+# -- crash-durable window store (dataplane/winstore.py; runtime.py) --
+register("WINDOW_STORE_DIR", "", str,
+         "directory for the crash-durable window tier (per-replica push "
+         "WAL + columnar warm segments); empty disables — window state "
+         "is RAM-only exactly as before")
+register("WINDOW_STORE_SEGMENT_MAX_MB", 256, int,
+         "warm-segment file size (MB) past which it compacts "
+         "newest-wins per query identity")
+register("WINDOW_STORE_FSYNC", False, parse_bool,
+         "fsync every WAL append: survives machine crashes, not just "
+         "process death (kill -9 needs no fsync), at a per-push cost")
+register("WINDOW_STORE_CHECKPOINT_S", 5.0, float,
+         "minimum seconds between window-store checkpoints (WAL "
+         "rotation + dirty-entry spill); the sweep and partial cycles "
+         "both try, this floors the disk churn")
+
 # -- multi-host world (parallel/distributed.py) --
 register("COORDINATOR_ADDRESS", "", str,
          "jax.distributed coordinator (multi-host deploys)")

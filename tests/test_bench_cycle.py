@@ -131,3 +131,19 @@ def test_cycle_bench_mixed_fleet_reports_family_decomposition():
     # train accounting fields to exist)
     assert costs["pair"] > 0 and costs["band"] > 0
     assert "lstm_train_s_per_cycle" in rec and "lstm_trains_per_cycle" in rec
+
+
+def test_restart_bench_leg_measures_the_storm():
+    """Miniature of the BENCH_CYCLE_RESTART leg: the warm restart must
+    re-download strictly less than the cold boot (the refetch storm the
+    window store exists to kill), with zero full refetches and a
+    bounded capped-tier RAM footprint."""
+    out = bench_cycle.run_restart(n_jobs=24, window_steps=32)
+    assert out["cold"]["full_fetches"] == out["cold"]["fetches"]
+    assert out["warm_restart"]["full_fetches"] == 0
+    assert out["warm_restart"]["delta_hits"] == out["warm_restart"]["fetches"]
+    assert out["refetch_bytes_avoided"] > 0
+    assert out["warm_restart"]["bytes_fetched"] \
+        < out["cold"]["bytes_fetched"]
+    assert out["resident_bytes_tier_on"] < out["resident_bytes_tier_off"]
+    json.dumps(out)  # the leg must stay JSON-serializable

@@ -904,3 +904,301 @@ def test_failed_invalidated_flight_does_not_poison_next_fetch():
     cache.fetch("u1")  # succeeds and MUST be cached
     cache.fetch("u1")  # served from cache
     assert state["calls"] == 2
+
+
+# --------------------------------------------- push-path chaos (ISSUE 13)
+def _cur_entry(delta):
+    """The cached entry for the job's CURRENT window."""
+    with delta._lock:
+        for key, entry in delta._cache.items():
+            if "cur0" in key:
+                return entry
+    return None
+
+
+def _push_chaos_world():
+    """World + the job's current-window URL + a fresh-sample generator
+    whose samples ALWAYS land in the backend (chaos is delivery-level:
+    the source of truth has the data whether or not a push arrives)."""
+    be, delta, store, an, rec, clock = _mk_world()
+    u = _url("cur0", T0, T0 + 86400)
+    state = {"k": 40}
+
+    def gen_batch(n=3):
+        samples = []
+        for _ in range(n):
+            ts = float(T0 + state["k"] * STEP)
+            v = round(10.0 + 0.01 * state["k"], 4)
+            be.series["cur0"].append((ts, v))
+            samples.append((ts, v))
+            state["k"] += 1
+        clock["now"] = ts + STEP
+        return ({"foremast_job": "j0", "foremast_metric": "latency"},
+                samples)
+
+    return be, delta, rec, clock, u, gen_batch
+
+
+def _deliver(rec, batch, now):
+    return _push(rec, [batch], now)
+
+
+def test_push_chaos_byte_identical_or_resync():
+    """The receiver property the new chaos shapes pin: under duplicated,
+    reordered and late pushes, the cached window is either byte-identical
+    to clean in-order delivery, or the entry is resync-latched — and one
+    poll always restores byte-identity. Deterministic per seed."""
+    from foremast_tpu.resilience.faults import (
+        FaultInjector,
+        FaultPlan,
+        FaultyPushStream,
+    )
+
+    plans = {
+        "duplicate": FaultPlan(duplicate_rate=0.5),
+        "reorder": FaultPlan(reorder_rate=0.7),
+        "late": FaultPlan(late_rate=0.4, late_hold=2),
+        "mixed": FaultPlan(duplicate_rate=0.3, reorder_rate=0.3,
+                           late_rate=0.3, late_hold=1),
+    }
+    for name, plan in plans.items():
+        for seed in (1, 2, 3):
+            # chaotic world A vs clean world B over identical streams
+            be_a, delta_a, rec_a, clock_a, u, gen = _push_chaos_world()
+            be_b, delta_b, rec_b, clock_b, u_b, _ = _push_chaos_world()
+            stream = FaultyPushStream(
+                FaultInjector(plan, seed=seed, target="push"))
+            for _ in range(12):
+                batch = gen()
+                # mirror the samples into B's backend + clean delivery
+                labels, samples = batch
+                be_b.series["cur0"] = list(be_a.series["cur0"])
+                clock_b["now"] = clock_a["now"]
+                for out in stream.mutate(batch):
+                    _deliver(rec_a, out, now=clock_a["now"])
+                _deliver(rec_b, batch, now=clock_b["now"])
+            for out in stream.flush():
+                _deliver(rec_a, out, now=clock_a["now"])
+            ea, eb = _cur_entry(delta_a), _cur_entry(delta_b)
+            assert eb is not None and not eb.push_blocked
+            ctx = f"{name} seed={seed}"
+            if not ea.push_blocked:
+                # no latch -> the chaotic stream must not have diverged
+                assert ea.win.start == eb.win.start, ctx
+                np.testing.assert_array_equal(ea.win.mask, eb.win.mask,
+                                              err_msg=ctx)
+                np.testing.assert_array_equal(ea.win.values, eb.win.values,
+                                              err_msg=ctx)
+            # and a poll ALWAYS restores byte-identity (the latch's heal
+            # path; a no-op refresh for the already-identical case)
+            wa = delta_a.fetch_window(u)
+            wb = delta_b.fetch_window(u)
+            assert wa.start == wb.start, ctx
+            np.testing.assert_array_equal(wa.mask, wb.mask, err_msg=ctx)
+            np.testing.assert_array_equal(wa.values, wb.values,
+                                          err_msg=ctx)
+            assert not _cur_entry(delta_a).push_blocked, ctx
+
+
+def test_late_push_latches_resync_not_silent_hole():
+    """Batch k arriving after k+1 was spliced must NOT leave a hole
+    inside the pushed horizon: the splice latches resync instead."""
+    be, delta, rec, clock, u, gen = _push_chaos_world()
+    b1, b2 = gen(), gen()
+    _deliver(rec, b2, now=clock["now"])  # k+1 first
+    entry = _cur_entry(delta)
+    assert entry is not None and entry.pushed_until > 0
+    status, payload = _deliver(rec, b1, now=clock["now"])  # k late
+    assert status == 200
+    assert payload["rejected"].get("late") == len(b1[1])
+    entry = _cur_entry(delta)
+    assert entry.push_blocked and entry.pushed_until == 0.0
+    # duplicate redelivery of ALREADY-CACHED samples is NOT late: after
+    # the poll heals, resending b2 is a clean stale drop
+    delta.fetch_window(u)
+    status, payload = _deliver(rec, b2, now=clock["now"])
+    assert status == 200
+    assert "late" not in payload["rejected"]
+    assert not _cur_entry(delta).push_blocked
+
+
+def test_receiver_wals_accepted_push_before_ack(tmp_path):
+    """/ingest 2xx means durable: the staged batch is WAL'd before the
+    splice (and before handle() returns)."""
+    from foremast_tpu.dataplane.winstore import WindowStore
+
+    be, delta, store, an, rec, clock = _mk_world()
+    ws = WindowStore(str(tmp_path))
+    delta.store = ws
+    rec.window_store = ws
+    batch = ({"foremast_job": "j0", "foremast_metric": "latency"},
+             [(float(T0 + 40 * STEP), 5.0), (float(T0 + 41 * STEP), 6.0)])
+    status, payload = _push(rec, [batch], now=float(T0 + 42 * STEP))
+    assert status == 200 and payload["accepted_samples"] == 2
+    assert ws.wal_appends == 1 and ws.wal_samples == 2
+    assert rec.snapshot()["durable"] is True
+    # the WAL record replays to the same splice
+    records, scan = WindowStore._wal_records(
+        open(ws.wal_path, "rb").read())
+    assert scan == "ok" and len(records) == 1
+    url, ts, vals = records[0]
+    assert list(ts) == [float(T0 + 40 * STEP), float(T0 + 41 * STEP)]
+    res = delta.ingest_append(url, ts, vals)
+    assert res["reason"] == "stale"  # already spliced: replay idempotent
+
+
+# ------------------------------------------------ wire fuzz (ISSUE 13)
+def _fuzz_receiver():
+    be, delta, store, an, rec, clock = _mk_world()
+    return rec, clock
+
+
+def _assert_clean_push_still_works(rec, now, k):
+    """The staging buffer must not be poisoned by whatever garbage the
+    last request carried."""
+    batch = ({"foremast_job": "j0", "foremast_metric": "latency"},
+             [(float(T0 + k * STEP), 1.0)])
+    status, payload = _push(rec, [batch], now=now)
+    assert status == 200, payload
+    assert payload["accepted_samples"] == 1
+
+
+def test_fuzz_malformed_snappy_blocks():
+    """Hand-built hostile snappy bodies + seeded mutations of a valid
+    one: always a typed 4xx (or a 200 that rejected per series), never
+    an exception out of the receiver, never a poisoned buffer."""
+    rng = np.random.default_rng(20260804)
+    rec, clock = _fuzz_receiver()
+    valid = snappy_compress(encode_remote_write(
+        [({"foremast_job": "j0", "foremast_metric": "latency"},
+          [(float(T0 + 100 * STEP), 1.0)])]))
+    hostile = [
+        b"",
+        b"\xff" * 64,
+        b"\xff\xff\xff\xff\x7f\x00",          # 4 GiB length claim
+        bytes([200]) + bytes([3 << 2]) + b"ab",  # length mismatch
+        bytes([8]) + bytes([(7 << 2) | 2]) + (60000).to_bytes(2, "little"),
+    ]
+    for i in range(150):
+        body = bytearray(valid)
+        for _ in range(rng.integers(1, 6)):
+            body[rng.integers(0, len(body))] = rng.integers(0, 256)
+        hostile.append(bytes(body[:rng.integers(0, len(body) + 1)]))
+    for i, body in enumerate(hostile):
+        status, payload = rec.handle(
+            "remote_write", body,
+            content_type="application/x-protobuf",
+            content_encoding="snappy")
+        assert status in (200, 400, 415, 429), (i, status, payload)
+        assert isinstance(payload, dict), i
+        if status != 200:
+            assert payload.get("reason") in ("decode_error",
+                                             "unsupported_media"), i
+    _assert_clean_push_still_works(rec, float(T0 + 200 * STEP), 120)
+
+
+def test_fuzz_truncated_protobuf():
+    """A valid WriteRequest truncated at EVERY offset: typed 400 or a
+    cleanly-parsed prefix, never a crash."""
+    rec, clock = _fuzz_receiver()
+    valid = encode_remote_write(
+        [({"foremast_job": "j0", "foremast_metric": "latency",
+           "extra": "label-value"},
+          [(float(T0 + 100 * STEP), 1.5), (float(T0 + 101 * STEP), 2.5)])])
+    for cut in range(len(valid)):
+        status, payload = rec.handle(
+            "remote_write", valid[:cut],
+            content_type="application/x-protobuf",
+            content_encoding="identity")
+        assert status in (200, 400, 429), (cut, status, payload)
+        assert isinstance(payload, dict), cut
+    _assert_clean_push_still_works(rec, float(T0 + 200 * STEP), 121)
+
+
+def test_fuzz_bad_otlp_json():
+    """Type-confused / truncated / hostile OTLP JSON: typed 400 (or a
+    200 whose bad points were skipped), never a crash."""
+    rng = np.random.default_rng(4)
+    rec, clock = _fuzz_receiver()
+    hostile = [
+        b"",
+        b"not json",
+        b"[]",
+        b"5",
+        b'{"resourceMetrics": 5}',
+        b'{"resourceMetrics": [5, {"scopeMetrics": "x"}]}',
+        b'{"resourceMetrics": [{"scopeMetrics": [{"metrics": '
+        b'[{"name": 3, "gauge": {"dataPoints": "zzz"}}]}]}]}',
+        b'{"resourceMetrics": [{"scopeMetrics": [{"metrics": '
+        b'[{"name": "m", "gauge": {"dataPoints": [{"timeUnixNano": '
+        b'{"a": 1}, "asDouble": 1}]}}]}]}]}',
+        b'{"resourceMetrics": [{"scopeMetrics": [{"metrics": '
+        b'[{"name": "m", "sum": {"dataPoints": [{"timeUnixNano": "1",'
+        b' "asInt": "not-an-int"}]}}]}]}]}',
+        json.dumps({"resourceMetrics": [{"resource": {"attributes": [
+            {"key": 7, "value": None}]}, "scopeMetrics": [{"metrics": [
+                {"name": "m", "gauge": {"dataPoints": [
+                    {"timeUnixNano": "9" * 40, "asDouble": 1e308}]}}
+            ]}]}]}).encode(),
+    ]
+    valid = json.dumps({"resourceMetrics": [{"scopeMetrics": [{
+        "metrics": [{"name": "m", "gauge": {"dataPoints": [
+            {"timeUnixNano": str((T0 + 100 * STEP) * 10**9),
+             "asDouble": 1.0}]}}]}]}]}).encode()
+    for i in range(100):
+        body = bytearray(valid)
+        for _ in range(rng.integers(1, 5)):
+            body[rng.integers(0, len(body))] = rng.integers(0, 256)
+        hostile.append(bytes(body[:rng.integers(0, len(body) + 1)]))
+    for i, body in enumerate(hostile):
+        status, payload = rec.handle(
+            "otlp", body, content_type="application/json")
+        assert status in (200, 400, 415, 429), (i, status, payload)
+        assert isinstance(payload, dict), i
+    _assert_clean_push_still_works(rec, float(T0 + 200 * STEP), 122)
+
+
+def test_receiver_wals_only_batches_that_spliced(tmp_path):
+    """Durability scope is exact: a push that did NOT advance durable
+    state (no_entry -> RAM staging buffer, stale duplicate) is never
+    WAL'd — the poll path is its source of truth — so recovery can
+    never ack-then-lose it, and the WAL holds only replayable splices."""
+    from foremast_tpu.dataplane.winstore import WindowStore
+
+    be, delta, store, an, rec, clock = _mk_world(warm=False)
+    ws = WindowStore(str(tmp_path))
+    delta.store = ws
+    rec.window_store = ws
+    batch = ({"foremast_job": "j0", "foremast_metric": "latency"},
+             [(float(T0 + 40 * STEP), 5.0)])
+    # nothing primed yet: accepted (buffered), NOT WAL'd
+    status, payload = _push(rec, [batch], now=float(T0 + 41 * STEP))
+    assert status == 200 and payload["accepted_samples"] == 1
+    assert ws.wal_appends == 0
+    # prime + splice: WAL'd exactly once
+    an.run_cycle(now=float(T0 + 41 * STEP))
+    batch2 = ({"foremast_job": "j0", "foremast_metric": "latency"},
+              [(float(T0 + 41 * STEP), 6.0)])
+    status, _ = _push(rec, [batch2], now=float(T0 + 42 * STEP))
+    assert status == 200 and ws.wal_appends == 1
+    # exact duplicate redelivery: accepted, dropped stale, NOT WAL'd
+    status, _ = _push(rec, [batch2], now=float(T0 + 42 * STEP))
+    assert status == 200 and ws.wal_appends == 1
+
+
+def test_below_span_duplicate_is_not_late():
+    """A retried sample whose timestamp sits BELOW the cached window's
+    retained span is indistinguishable from a clipped-out duplicate —
+    it must drop free (stale), never latch resync."""
+    be, delta, rec, clock, u, gen = _push_chaos_world()
+    _deliver(rec, gen(), now=clock["now"])
+    entry = _cur_entry(delta)
+    assert entry is not None and entry.pushed_until > 0
+    below = float(entry.win.start - STEP)
+    status, payload = _deliver(
+        rec, ({"foremast_job": "j0", "foremast_metric": "latency"},
+              [(below, 1.0)]), now=clock["now"])
+    assert status == 200
+    assert "late" not in payload["rejected"]
+    entry = _cur_entry(delta)
+    assert not entry.push_blocked and entry.pushed_until > 0

@@ -1,0 +1,459 @@
+"""Crash-durable window store (ISSUE 13): WAL framing, columnar warm
+segments, tiering (evict->spill, miss->promote), and restart recovery.
+
+The load-bearing contracts:
+
+  * recovery is BYTE-IDENTICAL: a recovered cache serves the same
+    windows a never-restarted one would, with zero backend calls for
+    covered windows;
+  * WAL replay is idempotent — replaying a record twice equals once
+    (the splice's stale rejection), which is what makes every crash
+    window inside a checkpoint safe;
+  * a torn WAL tail (crash mid-append: the push was never acked)
+    truncates cleanly; mid-file corruption (real disk damage) stops
+    replay and latches everything into resync so the poll path heals;
+  * tier-off (store=None) is byte-for-byte the previous RAM-only cache.
+"""
+import json
+import os
+
+import numpy as np
+
+from foremast_tpu.dataplane.delta import DeltaWindowSource, parse_range_params
+from foremast_tpu.dataplane.fetch import RawFixtureDataSource
+from foremast_tpu.dataplane import winstore
+from foremast_tpu.dataplane.winstore import WindowStore
+from foremast_tpu.resilience.faults import FaultInjector, FaultPlan
+
+STEP = 60
+T0 = 1_700_000_000 // STEP * STEP
+
+
+def _body(samples) -> bytes:
+    return json.dumps({
+        "status": "success",
+        "data": {"resultType": "matrix", "result": [
+            {"metric": {"__name__": "m"},
+             "values": [[t, str(v)] for t, v in samples]}
+        ]},
+    }).encode()
+
+
+class _Backend:
+    """Range-honoring synthetic Prometheus with a request counter."""
+
+    def __init__(self):
+        self.series: dict[str, list] = {}
+        self.calls = 0
+        self.calls_by_name: dict[str, int] = {}
+
+    def resolver(self, url: str) -> bytes:
+        self.calls += 1
+        name = url.split("?", 1)[0].rsplit("/", 1)[-1]
+        self.calls_by_name[name] = self.calls_by_name.get(name, 0) + 1
+        qs, qe, _ = parse_range_params(url)
+        return _body([(t, v) for t, v in self.series.get(name, [])
+                      if qs <= t <= qe])
+
+    def source(self):
+        return RawFixtureDataSource(resolver=self.resolver)
+
+
+def _url(name, s, e):
+    return f"http://prom/{name}?query=x&start={s:.0f}&end={e:.0f}&step=60"
+
+
+def _fill(be, name, n=40, t0=T0):
+    be.series[name] = [(t0 + k * STEP, round(10.0 + 0.1 * k, 3))
+                       for k in range(n)]
+
+
+def _assert_windows_equal(a, b, ctx=""):
+    assert a.start == b.start, f"{ctx}: start {a.start} != {b.start}"
+    assert a.step == b.step, ctx
+    np.testing.assert_array_equal(a.mask, b.mask, err_msg=ctx)
+    np.testing.assert_array_equal(a.values, b.values, err_msg=ctx)
+
+
+# ------------------------------------------------------------ frame scans
+def test_frame_scan_torn_tail_truncates():
+    payloads = [b"alpha", b"beta-beta", b"gamma" * 10]
+    buf = b"".join(winstore._frame(p) for p in payloads)
+    # clean
+    frames, status, _ = winstore._scan(buf)
+    assert status == winstore.SCAN_OK
+    assert [bytes(buf[o:o + n]) for o, n in frames] == payloads
+    # every truncation point inside the LAST frame is a clean torn tail:
+    # earlier frames survive, nothing is misread
+    last_start = len(buf) - len(winstore._frame(payloads[-1]))
+    for cut in range(last_start + 1, len(buf)):
+        frames, status, bad = winstore._scan(buf[:cut])
+        assert status == winstore.SCAN_TORN
+        assert len(frames) == 2
+        assert bad == last_start
+
+
+def test_frame_scan_mid_corruption_detected():
+    payloads = [b"alpha", b"beta-beta", b"gamma" * 10]
+    buf = bytearray(b"".join(winstore._frame(p) for p in payloads))
+    # flip one payload byte of the SECOND frame: CRC fails there, but a
+    # valid frame follows -> corruption, not a torn tail
+    second_payload_off = len(winstore._frame(payloads[0])) \
+        + winstore._FRAME_OVERHEAD
+    buf[second_payload_off] ^= 0xFF
+    frames, status, bad = winstore._scan(bytes(buf))
+    assert status == winstore.SCAN_CORRUPT
+    assert len(frames) == 1
+
+
+# ------------------------------------------------------- spill/load tier
+def test_spill_load_roundtrip(tmp_path):
+    store = WindowStore(str(tmp_path))
+    values = np.arange(20, dtype=np.float32)
+    mask = np.array([k % 3 != 0 for k in range(20)])
+    nan_ts = np.array([float(T0 + 7 * STEP)])
+    state = {"key": "k#span=5", "qstart": float(T0),
+             "qend": float(T0 + 19 * STEP), "url_step": 60.0,
+             "start": T0, "step": STEP, "values": values, "mask": mask,
+             "nan_ts": nan_ts, "full_bytes": 1234, "full_points": 14,
+             "pushed_until": float(T0 + 19 * STEP), "push_blocked": False}
+    store.spill(state)
+    out = store.load("k#span=5")
+    assert out is not None
+    np.testing.assert_array_equal(out["values"], values)
+    np.testing.assert_array_equal(out["mask"], mask)
+    np.testing.assert_array_equal(out["nan_ts"], nan_ts)
+    for field in ("qstart", "qend", "url_step", "start", "step",
+                  "full_bytes", "full_points", "pushed_until",
+                  "push_blocked"):
+        assert out[field] == state[field], field
+    assert store.load("unknown") is None
+
+
+def test_evict_spill_promote_byte_identity(tmp_path):
+    """A one-entry hot LRU over two live queries: every fetch round-trips
+    through evict->spill->promote, and every window stays byte-identical
+    to a storeless full-refetch source."""
+    be = _Backend()
+    _fill(be, "a", 40)
+    _fill(be, "b", 40)
+    store = WindowStore(str(tmp_path))
+    tiered = DeltaWindowSource(be.source(), max_entries=1, store=store)
+    plain = DeltaWindowSource(be.source())
+    for rounds in range(3):
+        for name in ("a", "b"):
+            be.series[name].append(
+                (T0 + (40 + rounds) * STEP, float(rounds)))
+            u = _url(name, T0, T0 + (40 + rounds) * STEP)
+            _assert_windows_equal(tiered.fetch_window(u),
+                                  plain.fetch_window(u),
+                                  f"{name} round {rounds}")
+    assert tiered.warm_spills > 0
+    assert tiered.warm_promotes > 0
+    snap = tiered.snapshot()
+    assert snap["warm_spills"] == tiered.warm_spills
+    assert store.snapshot()["segment_entries"] == 2
+
+
+def test_tier_off_is_previous_behavior(tmp_path):
+    """store=None: eviction drops (no spill machinery runs) and the
+    fetch stream is byte-identical to the tiered source's."""
+    be1, be2 = _Backend(), _Backend()
+    for be in (be1, be2):
+        _fill(be, "a", 40)
+        _fill(be, "b", 40)
+    off = DeltaWindowSource(be1.source(), max_entries=1)
+    on = DeltaWindowSource(be2.source(), max_entries=1,
+                           store=WindowStore(str(tmp_path)))
+    for name in ("a", "b", "a", "b"):
+        u = _url(name, T0, T0 + 39 * STEP)
+        _assert_windows_equal(off.fetch_window(u), on.fetch_window(u), name)
+    assert off.warm_spills == 0 and off.warm_promotes == 0
+    assert off._spill_pending == []
+    # the tier-off source pays a FULL refetch on each eviction-miss; the
+    # tiered one promotes from the segment and only re-queries the tail
+    assert off.full_fetches == 4 and off.delta_hits == 0
+    assert on.full_fetches == 2 and on.delta_hits == 2
+    assert on.warm_promotes == 2
+
+
+def test_compaction_newest_wins(tmp_path):
+    store = WindowStore(str(tmp_path), segment_max_bytes=2048)
+    base = {"qstart": float(T0), "qend": float(T0 + 9 * STEP),
+            "url_step": 60.0, "start": T0, "step": STEP,
+            "mask": np.ones(10, bool), "nan_ts": np.zeros(0),
+            "full_bytes": 0, "full_points": 10, "pushed_until": 0.0,
+            "push_blocked": False}
+    for gen in range(30):
+        for key in ("k1", "k2"):
+            store.spill(dict(base, key=key,
+                             values=np.full(10, gen, np.float32)))
+    assert store.compactions > 0
+    assert os.path.getsize(store.seg_path) <= 2048 + 1024
+    for key in ("k1", "k2"):
+        out = store.load(key)
+        np.testing.assert_array_equal(out["values"],
+                                      np.full(10, 29, np.float32))
+    # a fresh store over the same dir indexes the compacted file
+    # (newest-wins per key, whatever frame count the post-compaction
+    # appends left behind)
+    store2 = WindowStore(str(tmp_path))
+    with store2._seg_lock:
+        _, status = store2._build_index_locked()
+    assert status == winstore.SCAN_OK
+    assert store2.snapshot()["segment_entries"] == 2
+    np.testing.assert_array_equal(store2.load("k1")["values"],
+                                  np.full(10, 29, np.float32))
+
+
+# --------------------------------------------------------------- recovery
+def _primed_world(tmp_path, pushes=6, wal_injector=None):
+    """Backend + tiered source with one polled entry, a checkpoint, then
+    `pushes` WAL'd post-checkpoint pushes (the receiver's sequence)."""
+    be = _Backend()
+    _fill(be, "m", 40)
+    store = WindowStore(str(tmp_path), wal_injector=wal_injector)
+    src = DeltaWindowSource(be.source(), store=store)
+    u = _url("m", T0, T0 + 86400)
+    src.fetch_window(u)
+    store.checkpoint(src, force=True)
+    for k in range(40, 40 + pushes):
+        ts, v = float(T0 + k * STEP), round(0.5 * k, 3)
+        be.series["m"].append((ts, v))
+        # the receiver's sequence: splice, then WAL, then ack
+        src.ingest_append(u, [ts], [v])
+        store.wal_append(u, [ts], [v])
+    return be, store, src, u
+
+
+def _restarted(tmp_path, be):
+    """Fresh store+source over the same dir (the reboot), with a clock
+    pinned behind the pushed horizon so coverage proofs hold."""
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store,
+                            clock=lambda: float(T0))
+    stats = store.recover(src)
+    return store, src, stats
+
+
+def test_recovery_serves_covered_windows_with_zero_fetches(tmp_path):
+    be, store, src, u = _primed_world(tmp_path)
+    baseline = src.fetch_window(u)  # the never-restarted truth
+    be.calls = 0
+    store2, src2, stats = _restarted(tmp_path, be)
+    assert stats["wal_records_replayed"] == 6
+    assert stats["wal_samples_spliced"] == 6
+    assert stats["wal_scan"] == winstore.SCAN_OK
+    win = src2.fetch_window(u)
+    assert be.calls == 0, "covered window must not touch the backend"
+    _assert_windows_equal(win, baseline, "recovered vs never-restarted")
+    assert src2.ingest_hits == 1
+    # recovery folded the WAL into segments: the wal file restarts empty
+    assert store2.snapshot()["wal_bytes"] == 0
+
+
+def test_wal_replay_idempotent(tmp_path):
+    """Replay twice == once: a crash right after recovery's checkpoint
+    rotated-but-not-yet-dropped WAL (or a double-delivered record)
+    splices nothing new."""
+    be, store, src, u = _primed_world(tmp_path)
+    wal_copy = open(store.wal_path, "rb").read()
+    store2, src2, stats = _restarted(tmp_path, be)
+    assert stats["wal_samples_spliced"] == 6
+    before = src2._cache[next(iter(src2._cache))].win
+    # the same records land again (simulating wal.old surviving a crash
+    # mid-checkpoint): every one is a stale no-op
+    records, status = WindowStore._wal_records(wal_copy)
+    assert status == winstore.SCAN_OK and len(records) == 6
+    for url, ts, vals in records:
+        res = src2.ingest_append(url, ts, vals)
+        assert res["reason"] == "stale" and res["spliced"] == 0
+    after = src2._cache[next(iter(src2._cache))].win
+    _assert_windows_equal(before, after, "replay-twice")
+
+
+def test_checkpoint_crash_window_replays_wal_old(tmp_path):
+    """Crash between WAL rotation and the dirty spill: wal.old holds the
+    records, recovery replays it, nothing is lost."""
+    be, store, src, u = _primed_world(tmp_path)
+    baseline = src.fetch_window(u)
+    os.replace(store.wal_path, store.wal_old_path)  # rotation happened...
+    # ...and two more pushes landed in the fresh generation before the
+    # crash
+    for k in (46, 47):
+        ts, v = float(T0 + k * STEP), round(0.5 * k, 3)
+        be.series["m"].append((ts, v))
+        store.wal_append(u, [ts], [v])
+        src.ingest_append(u, [ts], [v])
+    baseline = src.fetch_window(u)
+    be.calls = 0
+    store2, src2, stats = _restarted(tmp_path, be)
+    assert stats["wal_records_replayed"] == 8
+    win = src2.fetch_window(u)
+    assert be.calls == 0
+    _assert_windows_equal(win, baseline, "wal.old + wal.log replay")
+
+
+def test_torn_wal_tail_truncates_cleanly(tmp_path):
+    """A torn final append (crash mid-write — that push was never acked)
+    loses exactly that record; everything before it recovers, and
+    nothing latches."""
+    inj = FaultInjector(FaultPlan(torn_rate=1.0), seed=7, target="wal")
+    be = _Backend()
+    _fill(be, "m", 40)
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store)
+    u = _url("m", T0, T0 + 86400)
+    src.fetch_window(u)
+    store.checkpoint(src, force=True)
+    for k in range(40, 45):
+        ts, v = float(T0 + k * STEP), float(k)
+        be.series["m"].append((ts, v))
+        store.wal_append(u, [ts], [v])
+        src.ingest_append(u, [ts], [v])
+    # the torn write: only half the frame reaches disk
+    store.wal_injector = inj
+    ts = float(T0 + 45 * STEP)
+    store.wal_append(u, [ts], [45.0])
+    assert store.wal_torn_writes == 1
+    store2, src2, stats = _restarted(tmp_path, be)
+    assert stats["wal_scan"] == winstore.SCAN_TORN
+    assert stats["wal_records_replayed"] == 5
+    assert not store2.force_block
+    entry = src2._cache[next(iter(src2._cache))]
+    assert not entry.push_blocked
+    assert entry.pushed_until == float(T0 + 44 * STEP)
+
+
+def test_wal_mid_corruption_latches_resync(tmp_path):
+    """Valid frames after a damaged one = disk corruption: replay stops,
+    every recovered entry latches into resync, and a poll heals it."""
+    be, store, src, u = _primed_world(tmp_path)
+    # damage the SECOND record's payload in place
+    buf = bytearray(open(store.wal_path, "rb").read())
+    first_len = len(winstore._frame(b""))  # overhead only
+    # find the second frame start: scan the intact file
+    frames, _, _ = winstore._scan(bytes(buf))
+    assert len(frames) == 6
+    second_payload_off = frames[1][0]
+    buf[second_payload_off] ^= 0xFF
+    with open(store.wal_path, "wb") as f:
+        f.write(bytes(buf))
+    store2, src2, stats = _restarted(tmp_path, be)
+    assert stats["wal_scan"] == winstore.SCAN_CORRUPT
+    assert stats["wal_records_replayed"] == 1  # stopped at the damage
+    assert store2.force_block
+    entry = src2._cache[next(iter(src2._cache))]
+    assert entry.push_blocked and entry.pushed_until == 0.0
+    # pushes are refused until a poll re-syncs...
+    res = src2.ingest_append(u, [float(T0 + 50 * STEP)], [1.0])
+    assert res["reason"] == "resync"
+    # ...and the poll heals: full/delta refresh clears the latch and the
+    # window comes back byte-identical to the never-restarted source
+    win = src2.fetch_window(u)
+    _assert_windows_equal(win, src.fetch_window(u), "post-heal")
+    entry = src2._cache[next(iter(src2._cache))]
+    assert not entry.push_blocked
+    assert first_len  # silence the unused-var lint
+
+
+def test_segment_promote_after_corruption_is_latched(tmp_path):
+    """Entries promoted LAZILY after a corrupt-WAL boot come up latched
+    too (store.force_block), not just the ones replay touched."""
+    be, store, src, u = _primed_world(tmp_path)
+    # a second polled-only entry that will stay in the warm tier
+    _fill(be, "w", 40)
+    u2 = _url("w", T0, T0 + 86400)
+    src.fetch_window(u2)
+    store.checkpoint(src, force=True)
+    store.wal_append(u, [float(T0 + 50 * STEP)], [1.0])
+    store.wal_append(u, [float(T0 + 51 * STEP)], [2.0])
+    buf = bytearray(open(store.wal_path, "rb").read())
+    frames, _, _ = winstore._scan(bytes(buf))
+    buf[frames[0][0]] ^= 0xFF
+    with open(store.wal_path, "wb") as f:
+        f.write(bytes(buf))
+    store2 = WindowStore(str(tmp_path))
+    src2 = DeltaWindowSource(be.source(), store=store2,
+                             clock=lambda: float(T0))
+    store2.recover(src2)
+    assert store2.force_block
+    res = src2.ingest_append(u2, [float(T0 + 40 * STEP)], [1.0])
+    assert res["reason"] == "resync"
+
+
+def test_recovery_stats_on_snapshot(tmp_path):
+    _, store, src, _ = _primed_world(tmp_path, pushes=2)
+    store2, src2, stats = _restarted(tmp_path, _Backend())
+    snap = store2.snapshot()
+    assert snap["recovery"]["wal_records_replayed"] == 2
+    assert snap["recovery"]["seconds"] >= 0
+    assert snap["checkpoints"] == 1  # recovery's own fold-in
+
+
+def test_healed_entry_not_relatched_after_corrupt_boot(tmp_path):
+    """The corruption latch lives in the RECORDS: once a poll heals an
+    entry and its healed state re-spills, later promotes come back
+    unlatched — a process-lifetime flag would force a full refetch on
+    every promote forever."""
+    be, store, src, u = _primed_world(tmp_path)
+    buf = bytearray(open(store.wal_path, "rb").read())
+    frames, _, _ = winstore._scan(bytes(buf))
+    buf[frames[0][0]] ^= 0xFF
+    with open(store.wal_path, "wb") as f:
+        f.write(bytes(buf))
+    store2 = WindowStore(str(tmp_path))
+    src2 = DeltaWindowSource(be.source(), store=store2,
+                             clock=lambda: float(T0))
+    store2.recover(src2)
+    assert store2.force_block  # the boot indicator
+    # the poll heals the entry, a checkpoint spills the healed state
+    src2.fetch_window(u)
+    store2.checkpoint(src2, force=True)
+    # evict everything hot: the next fetch must PROMOTE the healed
+    # state unlatched (and therefore delta-query, not full-refetch)
+    with src2._lock:
+        src2._cache.clear()
+    src2.fetch_window(u)
+    entry = src2._cache[next(iter(src2._cache))]
+    assert not entry.push_blocked
+    assert src2.warm_promotes >= 1
+
+
+def test_ingest_block_latches_warm_entries(tmp_path):
+    """The buffer-overflow latch must reach SPILLED entries too: a warm
+    state with a pushed horizon comes back latched, or a later promote
+    would serve around the dropped samples."""
+    be, store, src, u = _primed_world(tmp_path, pushes=3)
+    store.checkpoint(src, force=True)
+    entry = src._cache[next(iter(src._cache))]
+    assert entry.pushed_until > 0
+    with src._lock:
+        src._cache.clear()  # the entry now lives ONLY in the warm tier
+    src.ingest_block(u)
+    entry = src._cache[next(iter(src._cache))]  # promoted + latched
+    assert entry.push_blocked and entry.pushed_until == 0.0
+    res = src.ingest_append(u, [float(T0 + 60 * STEP)], [1.0])
+    assert res["reason"] == "resync"
+
+
+def test_checkpoint_drains_pending_evictee_spills(tmp_path):
+    """Evictees queued for an async spill belong to the checkpoint: the
+    WAL generation being dropped may hold their acked pushes, so
+    spill_dirty must write them before winstore unlinks wal.old."""
+    be = _Backend()
+    _fill(be, "m", 40)
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store)
+    u = _url("m", T0, T0 + 86400)
+    src.fetch_window(u)
+    key = next(iter(src._cache))
+    entry = src._cache[key]
+    # simulate the eviction race: the entry left the hot cache with its
+    # write still queued
+    with src._lock:
+        del src._cache[key]
+        src._spill_pending.append((key, entry))
+    store.checkpoint(src, force=True)
+    assert src._spill_pending == []
+    assert store.load(key) is not None
