@@ -39,6 +39,7 @@ with a self-checking seam.
 """
 from __future__ import annotations
 
+import logging
 import re
 import threading
 import time
@@ -56,6 +57,8 @@ from ..ops.windowing import (
 from .fetch import TS_SPAN_CAP, grid_from_series
 from ..utils import tracing
 from ..utils.locks import make_lock
+
+log = logging.getLogger("foremast_tpu.delta")
 
 __all__ = ["DeltaWindowSource", "strip_range_params", "parse_range_params"]
 
@@ -215,6 +218,12 @@ class DeltaWindowSource:
         # entries evicted under a lock, awaiting their spill write (file
         # I/O must not run under the cache/cpu locks)
         self._spill_pending: list = []
+        # keys whose queued evictee spill was DROPPED under sustained
+        # disk pressure (the requeue bound): their acked pushes may
+        # exist only in a WAL generation a later checkpoint retires, so
+        # any warm state promoted for these keys comes back latched into
+        # resync until a poll re-establishes the backend as truth
+        self._dropped_spill_keys: set[str] = set()
         self.overlap_steps = max(int(overlap_steps), 1)
         self.step = int(step)
         # wall clock for the ingest-serve coverage proof (_try_ingest_
@@ -246,9 +255,10 @@ class DeltaWindowSource:
         self.ingest_spliced_points = 0
         self.ingest_hits = 0
         self.ingest_rejects: dict[str, int] = {}
-        # warm-tier traffic (store is None => both stay 0)
+        # warm-tier traffic (store is None => all stay 0)
         self.warm_spills = 0
         self.warm_promotes = 0
+        self.warm_spill_drops = 0  # evictee spills lost to the requeue bound
 
     # ------------------------------------------------------------ plumbing
     def fetch(self, url: str):
@@ -279,6 +289,7 @@ class DeltaWindowSource:
             "ingest_rejects": dict(self.ingest_rejects),
             "warm_spills": self.warm_spills,
             "warm_promotes": self.warm_promotes,
+            "warm_spill_drops": self.warm_spill_drops,
         }
 
     def _series(self, url: str):
@@ -337,9 +348,31 @@ class DeltaWindowSource:
 
     def _requeue_spills(self, items) -> None:
         """Put unwritten evictee spills back for a later retry, bounded:
-        a permanently-full disk must degrade durability, not grow RAM."""
+        a permanently-full disk must degrade durability, not grow RAM.
+        The overflow is NOT silent — a dropped state may hold acked
+        pushes whose WAL records a later checkpoint retires, so its key
+        latches (counted, logged): whatever warm state later promotes
+        for it comes back in resync mode, and the poll path re-
+        establishes the backend as truth before any push is trusted."""
         with self._lock:
-            self._spill_pending = (items + self._spill_pending)[:4096]
+            queue = items + self._spill_pending
+            self._spill_pending, dropped = queue[:4096], queue[4096:]
+            for k, _e in dropped:
+                self._dropped_spill_keys.add(k)
+            self.warm_spill_drops += len(dropped)
+        if dropped:
+            log.warning("spill queue overflow: %d evictee state(s) "
+                        "dropped under disk pressure; their keys are "
+                        "latched into resync", len(dropped))
+
+    def spill_debt(self) -> int:
+        """Keys whose evictee spill was dropped at the requeue bound and
+        has not yet healed. While non-zero, ``winstore.checkpoint`` must
+        not retire WAL generations: their records are these keys' acked
+        pushes' ONLY durable copy (replay is idempotent, so keeping them
+        is free of double-splice risk)."""
+        with self._lock:
+            return len(self._dropped_spill_keys)
 
     def _flush_spills(self) -> None:
         """Write queued evictee spills (no cache lock held). A failed
@@ -366,14 +399,36 @@ class DeltaWindowSource:
                 return
             with self._lock:
                 self.warm_spills += 1
+                # a successfully spilled queued state is at least as new
+                # as whatever drop latched this key: debt settled
+                self._dropped_spill_keys.discard(pending[i][0])
 
     def _promote(self, key: str) -> _Entry | None:
-        """Load ``key`` from the warm segment into the hot LRU (cache
-        miss path). Returns the hot entry, or None when the warm tier
-        has nothing either. The segment read happens before the cache
-        lock; a racing prime wins and the load is discarded."""
+        """Load ``key`` back into the hot LRU (cache miss path): the
+        pending-spill queue first, then the warm segment. Returns the
+        hot entry, or None when neither tier has it. The segment read
+        happens before the cache lock; a racing prime wins and the load
+        is discarded."""
         if self.store is None:
             return None
+        with self._lock:
+            cur = self._cache.get(key)
+            if cur is not None:
+                return cur
+            # an evicted-but-unwritten state in the queue is NEWER than
+            # any warm record (disk pressure kept it from landing);
+            # promoting the stale record instead would let fresh pushes
+            # advance the horizon over the queued samples — a hole the
+            # serve path would then vouch for. Latest queued wins.
+            for i in range(len(self._spill_pending) - 1, -1, -1):
+                k, e = self._spill_pending[i]
+                if k == key:
+                    del self._spill_pending[i]
+                    self._cache[key] = e
+                    self._cache.move_to_end(key)
+                    self.warm_promotes += 1
+                    self._evict_overflow_locked()
+                    return e
         state = self.store.load(key)
         if state is None:
             return None
@@ -390,6 +445,15 @@ class DeltaWindowSource:
             cur = self._cache.get(key)
             if cur is not None:
                 return cur
+            if key in self._dropped_spill_keys:
+                # a NEWER state for this key was dropped on the way to
+                # the segment: the warm record's pushed horizon may miss
+                # acked samples, so it comes back latched until a poll
+                # heals it (the latch consumes the drop marker)
+                self._dropped_spill_keys.discard(key)
+                entry.pushed_until = 0.0
+                entry.push_blocked = True
+                entry.dirty = True
             self._cache[key] = entry
             self._cache.move_to_end(key)
             self.warm_promotes += 1
@@ -419,19 +483,42 @@ class DeltaWindowSource:
                 self._requeue_spills(pending[i:])
                 raise
             spilled += 1
+            with self._lock:
+                self._dropped_spill_keys.discard(pending[i][0])
         with self._lock:
             batch = [(k, e) for k, e in self._cache.items() if e.dirty]
             states = []
             for k, e in batch:
                 states.append(self._entry_state(k, e))
                 e.dirty = False
-        for (k, e), st in zip(batch, states):
+        for i, ((k, e), st) in enumerate(zip(batch, states)):
             try:
                 self.store.spill(st)
             except OSError:
-                e.dirty = True
+                # the WHOLE batch was marked clean at snapshot time: re-
+                # dirty every entry whose spill never ran, or the next
+                # (successful) checkpoint would retire the WAL generation
+                # holding their acked pushes with no durable effect. An
+                # entry EVICTED while clean mid-checkpoint re-dirties an
+                # orphan the dirty sweep can never see again — those go
+                # back through the pending queue instead.
+                requeue = []
+                with self._lock:
+                    queued = {id(e2) for _k2, e2 in self._spill_pending}
+                    for k2, e2 in batch[i:]:
+                        if self._cache.get(k2) is e2:
+                            e2.dirty = True
+                        elif id(e2) not in queued:
+                            # (re-dirtied-then-evicted entries already
+                            # queued themselves — don't double-book the
+                            # bounded queue's slots)
+                            requeue.append((k2, e2))
+                if requeue:
+                    self._requeue_spills(requeue)
                 raise
             spilled += 1
+            with self._lock:
+                self._dropped_spill_keys.discard(k)
         with self._lock:
             self.warm_spills += spilled
         return spilled
@@ -736,6 +823,9 @@ class DeltaWindowSource:
                 self._count_fallback("off_grid")
             return win
         with self._lock:
+            # a fresh poll prime starts push-clean (pushed_until=0), so a
+            # pending dropped-spill latch for the key is now satisfied
+            self._dropped_spill_keys.discard(key)
             self._cache[key] = _Entry(win, qstart, qend, url_step,
                                       nan_ts, nbytes, int(ts_f.size))
             self._cache.move_to_end(key)

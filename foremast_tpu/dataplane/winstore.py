@@ -75,8 +75,9 @@ log = logging.getLogger("foremast_tpu.winstore")
 __all__ = ["WindowStore"]
 
 # frame: MAGIC | u32 payload_len | u32 crc32(payload) | payload.
-# One os.write per frame on an O_APPEND fd, so concurrent appends never
-# interleave and a crash can only ever tear the LAST frame.
+# Appends to a given file are serialized by its lock (_wal_lock /
+# _seg_lock) — frames never interleave — and a failed short write rolls
+# the file back (_append), so a crash can only ever tear the LAST frame.
 _MAGIC = b"FWS1"
 _HEAD = struct.Struct("<II")
 _FRAME_OVERHEAD = len(_MAGIC) + _HEAD.size
@@ -91,14 +92,33 @@ def _frame(payload: bytes) -> bytes:
     return _MAGIC + _HEAD.pack(len(payload), zlib.crc32(payload)) + payload
 
 
-def _scan(buf) -> tuple[list[tuple[int, int]], str, int]:
-    """Walk ``buf`` frame by frame -> ([(payload_off, payload_len)],
-    status, bad_off). A bad frame ends the scan; status distinguishes a
-    torn tail (nothing parseable after it — the crash-mid-append shape,
-    safe to truncate) from mid-file corruption (a valid MAGIC exists
-    later — disk damage; the caller must assume records were lost)."""
+def _next_valid_frame(buf, start: int) -> int:
+    """Offset of the first CRC-valid frame at/after ``start``, or -1.
+    A bare 4-byte MAGIC match is NOT enough — it can occur by chance
+    inside raw f32/f64 column payloads."""
+    n = len(buf)
+    j = buf.find(_MAGIC, start)
+    while j != -1:
+        end = j + _FRAME_OVERHEAD
+        if end <= n:
+            plen, crc = _HEAD.unpack(buf[j + len(_MAGIC):end])
+            if end + plen <= n and zlib.crc32(buf[end:end + plen]) == crc:
+                return j
+        j = buf.find(_MAGIC, j + 1)
+    return -1
+
+
+def _scan(buf, start: int = 0) -> tuple[list[tuple[int, int]], str, int]:
+    """Walk ``buf`` frame by frame from ``start`` ->
+    ([(payload_off, payload_len)], status, bad_off). A bad frame ends
+    the scan; status distinguishes a torn tail (nothing parseable after
+    it — the crash-mid-append shape, safe to truncate) from mid-file
+    corruption (a CRC-valid frame exists later — disk damage; whether
+    the caller may resume past it depends on whether record ORDER
+    matters: the WAL replays in order and must stop, segment records
+    are independent newest-wins states and may continue)."""
     frames: list[tuple[int, int]] = []
-    i, n = 0, len(buf)
+    i, n = start, len(buf)
     while i < n:
         end = i + _FRAME_OVERHEAD
         if (buf[i:i + len(_MAGIC)] != _MAGIC or end > n):
@@ -110,8 +130,12 @@ def _scan(buf) -> tuple[list[tuple[int, int]], str, int]:
         i = end + plen
     if i >= n:
         return frames, SCAN_OK, n
-    # classify: any later frame boundary means the middle is damaged
-    status = SCAN_CORRUPT if buf.find(_MAGIC, i + 1) != -1 else SCAN_TORN
+    # classify: only a later CRC-valid frame proves the middle is
+    # damaged — misreading a benign crash-mid-append as corruption
+    # would latch a store-wide resync (the refetch storm this module
+    # exists to avoid).
+    status = SCAN_CORRUPT if _next_valid_frame(buf, i + 1) != -1 \
+        else SCAN_TORN
     return frames, status, i
 
 
@@ -233,7 +257,25 @@ class WindowStore:
             frame = frame[:max(len(frame) // 2, 1)]
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
         try:
-            os.write(fd, frame)
+            base = os.fstat(fd).st_size
+            done = 0
+            try:
+                while done < len(frame):
+                    n = os.write(fd, memoryview(frame)[done:])
+                    if n <= 0:
+                        raise OSError("zero-byte write")
+                    done += n
+            except OSError:
+                # a short write leaves a torn frame MID-file once later
+                # appends land after it, stranding everything behind the
+                # tear on the next scan — roll back to the pre-append
+                # size so the failure degrades cleanly instead
+                if done:
+                    try:
+                        os.ftruncate(fd, base)
+                    except OSError:
+                        pass
+                raise
             if self.fsync:
                 os.fsync(fd)
         finally:
@@ -410,23 +452,45 @@ class WindowStore:
 
     def _build_index_locked(self) -> tuple[int, str]:
         """Rebuild the index from the segment file. Returns (#frames
-        indexed, scan status) — a torn segment tail just loses the one
-        frame the crash was writing (its entry re-primes from the
-        backend)."""
+        indexed, scan status). Segment records are independent newest-
+        wins states — unlike the WAL, ORDER carries no meaning — so the
+        walk RESUMES at the next CRC-valid frame past any damaged
+        region: a torn tail loses only the frame the crash was writing,
+        and mid-file damage loses only the frames it overwrote. A
+        non-OK scan then compacts (from the full index, post-damage
+        frames included) before any new append: appending after
+        unparseable bytes would leave valid frames the NEXT restart
+        could not reach without this same salvage walk."""
         self._index = {}
         self._seg_mm = None
         self._seg_mm_size = 0
         buf = self._seg_buffer()
         if buf is None:
             return 0, SCAN_OK
-        frames, status, _ = _scan(buf)
-        for off, plen in frames:
+        total, status, pos = 0, SCAN_OK, 0
+        while True:
+            frames, st, bad = _scan(buf, pos)
+            total += len(frames)
+            for off, plen in frames:
+                try:
+                    header, _ = _unpack_header(buf, off)
+                except (ValueError, KeyError, json.JSONDecodeError):
+                    continue
+                self._index[header["key"]] = (off, plen)
+            if st == SCAN_OK:
+                break
+            status = st if status != SCAN_CORRUPT else SCAN_CORRUPT
+            pos = _next_valid_frame(buf, bad + 1)
+            if pos == -1:  # torn tail: nothing parseable after
+                break
+        if status != SCAN_OK:
             try:
-                header, _ = _unpack_header(buf, off)
-            except (ValueError, KeyError, json.JSONDecodeError):
-                continue
-            self._index[header["key"]] = (off, plen)
-        return len(frames), status
+                self._compact_locked()
+            except OSError as e:
+                # can't rewrite (disk full): index what parsed and keep
+                # going — strictly no worse than the damage we found
+                log.warning("segment rewrite after bad scan failed: %s", e)
+        return total, status
 
     # ------------------------------------------------------------ recovery
     def recover(self, delta) -> dict:
@@ -515,7 +579,16 @@ class WindowStore:
                 os.replace(self.wal_path, self.wal_old_path)
         spilled = delta.spill_dirty()
         # only drop the rotated generation once the spill committed its
-        # contents (or proved there was nothing dirty to commit)
+        # contents (or proved there was nothing dirty to commit). States
+        # dropped at the requeue bound have neither spilled effect nor
+        # retirable record — the WAL generations are their acked pushes'
+        # ONLY durable copy, so keep them (replay is idempotent) until
+        # the keys heal via promote-latch / poll re-prime / late spill.
+        debt_fn = getattr(delta, "spill_debt", None)
+        if debt_fn is not None and debt_fn():
+            self.checkpoints += 1
+            return {"spilled": spilled, "wal_bytes_rotated": wal_bytes,
+                    "wal_retained_for_drops": True}
         with self._wal_lock:
             try:
                 os.unlink(self.wal_old_path)

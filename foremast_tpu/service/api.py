@@ -645,6 +645,11 @@ class ForemastService:
                 lines.append(
                     "foremastbrain:window_store_warm_spills_total "
                     f"{snap['warm_spills']}")
+                # evictee spills lost to the requeue bound under disk
+                # pressure: each one is a key latched into resync
+                lines.append(
+                    "foremastbrain:window_store_warm_spill_drops_total "
+                    f"{snap['warm_spill_drops']}")
         if self.window_store is not None:
             # crash-durable tier health: on-disk footprint, WAL/spill
             # traffic, and what the last boot replayed

@@ -18,6 +18,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from foremast_tpu.dataplane.delta import DeltaWindowSource, parse_range_params
 from foremast_tpu.dataplane.fetch import RawFixtureDataSource
@@ -457,3 +458,315 @@ def test_checkpoint_drains_pending_evictee_spills(tmp_path):
     store.checkpoint(src, force=True)
     assert src._spill_pending == []
     assert store.load(key) is not None
+
+
+# ------------------------------------------- durability-invariant edges
+_SEG_BASE = {"qstart": float(T0), "qend": float(T0 + 9 * STEP),
+             "url_step": 60.0, "start": T0, "step": STEP,
+             "mask": np.ones(10, bool), "nan_ts": np.zeros(0),
+             "full_bytes": 0, "full_points": 10, "pushed_until": 0.0,
+             "push_blocked": False}
+
+
+def _seg_state(key, fill=1.0):
+    return dict(_SEG_BASE, key=key, values=np.full(10, fill, np.float32))
+
+
+def test_scan_magic_in_payload_is_torn_not_corrupt():
+    """Garbage after the last good frame that happens to CONTAIN the
+    4-byte MAGIC (raw f32/f64 columns hit it by chance) is still a torn
+    tail: only a later CRC-valid frame is evidence of mid-file
+    corruption. Misclassifying would latch a store-wide resync — the
+    refetch storm the store exists to avoid."""
+    good = winstore._frame(b"alpha")
+    torn = winstore._frame(b"xx" + winstore._MAGIC + b"yy" * 8)[:-3]
+    frames, status, bad = winstore._scan(good + torn)
+    assert status == winstore.SCAN_TORN
+    assert len(frames) == 1
+    assert bad == len(good)
+
+
+def test_spill_dirty_failure_redirties_whole_batch(tmp_path):
+    """A mid-batch spill failure must leave EVERY unspilled entry dirty:
+    the batch was marked clean at snapshot time, and a clean-but-
+    unspilled entry would let the next (successful) checkpoint retire
+    the WAL generation holding its acked pushes with no durable
+    effect."""
+    be = _Backend()
+    for name in ("a", "b", "c"):
+        _fill(be, name, 40)
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store)
+    for name in ("a", "b", "c"):
+        src.fetch_window(_url(name, T0, T0 + 39 * STEP))
+    real_spill, calls = store.spill, {"n": 0}
+
+    def failing_spill(state):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise OSError(28, "No space left on device")
+        real_spill(state)
+
+    store.spill = failing_spill
+    with pytest.raises(OSError):
+        src.spill_dirty()
+    with src._lock:
+        dirty = [e.dirty for e in src._cache.values()]
+    assert dirty.count(True) == 2, \
+        "failing entry AND everything after it must stay dirty"
+    # the disk recovers: the retried checkpoint spills exactly the rest
+    store.spill = real_spill
+    assert src.spill_dirty() == 2
+
+
+def test_spill_dirty_failure_requeues_evicted_entries(tmp_path):
+    """An entry evicted (clean) while the checkpoint batch is mid-write
+    can't be re-dirtied — the flag would land on an orphan the dirty
+    sweep never sees again. Its spill goes back through the pending
+    queue, so the next checkpoint still writes it before any WAL
+    generation drops."""
+    be = _Backend()
+    for name in ("a", "b", "c"):
+        _fill(be, name, 40)
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store)
+    for name in ("a", "b", "c"):
+        src.fetch_window(_url(name, T0, T0 + 39 * STEP))
+    evicted_key = list(src._cache)[2]
+    evicted_entry = src._cache[evicted_key]
+    real_spill, calls = store.spill, {"n": 0}
+
+    def racing_spill(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # a concurrent prime evicts the (now clean) third entry
+            # while the batch is being written
+            with src._lock:
+                src._cache.pop(evicted_key)
+            real_spill(state)
+            return
+        raise OSError(28, "No space left on device")
+
+    store.spill = racing_spill
+    with pytest.raises(OSError):
+        src.spill_dirty()
+    store.spill = real_spill
+    assert (evicted_key, evicted_entry) in src._spill_pending
+    # the recovered disk's next checkpoint writes BOTH the re-dirtied
+    # in-cache entry and the requeued evictee
+    assert src.spill_dirty() == 2
+    assert store.load(evicted_key) is not None
+
+
+def test_spill_dirty_failure_no_duplicate_requeue(tmp_path):
+    """An entry re-dirtied and evicted mid-checkpoint already queued
+    itself for a spill; the failure handler must not book it a second
+    slot of the bounded queue."""
+    be = _Backend()
+    for name in ("a", "b", "c"):
+        _fill(be, name, 40)
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store)
+    for name in ("a", "b", "c"):
+        src.fetch_window(_url(name, T0, T0 + 39 * STEP))
+    evicted_key = list(src._cache)[2]
+    evicted_entry = src._cache[evicted_key]
+    real_spill, calls = store.spill, {"n": 0}
+
+    def racing_spill(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # dirty re-evict mid-batch: _evict_overflow_locked pops the
+            # entry AND queues its spill
+            with src._lock:
+                src._cache.pop(evicted_key)
+                src._spill_pending.append((evicted_key, evicted_entry))
+            real_spill(state)
+            return
+        raise OSError(28, "No space left on device")
+
+    store.spill = racing_spill
+    with pytest.raises(OSError):
+        src.spill_dirty()
+    store.spill = real_spill
+    queued = [k for k, _e in src._spill_pending if k == evicted_key]
+    assert len(queued) == 1, "already-queued evictee must not double-book"
+
+
+def test_promote_prefers_queued_unspilled_state(tmp_path):
+    """A cache miss while the key's evicted state is still QUEUED for
+    its spill must promote THAT state — it is newer than any warm
+    record; promoting the stale record unlatched would let fresh pushes
+    advance the horizon over the queued samples (a hole the serve path
+    would vouch for)."""
+    be = _Backend()
+    _fill(be, "m", 40)
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store)
+    u = _url("m", T0, T0 + 86400)
+    src.fetch_window(u)
+    key = next(iter(src._cache))
+    src.spill_dirty()  # stale warm record: no pushed horizon
+    assert src.ingest_append(u, [float(T0 + 40 * STEP)], [1.0])["advanced"]
+    entry = src._cache[key]
+    with src._lock:  # evicted dirty, spill still queued (disk pressure)
+        del src._cache[key]
+        src._spill_pending.append((key, entry))
+    promoted = src._promote(key)
+    assert promoted is entry, "the queued state, not the warm record"
+    assert promoted.pushed_until > 0 and promoted.dirty
+    assert src._spill_pending == []
+
+
+def test_checkpoint_keeps_wal_while_drop_debt_outstanding(tmp_path):
+    """A state dropped at the requeue bound has neither spilled effect
+    nor retirable record: its WAL generation is the acked pushes' only
+    durable copy, so checkpoint must retain it (replay is idempotent)
+    until the key heals."""
+    be = _Backend()
+    _fill(be, "m", 40)
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store)
+    u = _url("m", T0, T0 + 86400)
+    src.fetch_window(u)
+    key = next(iter(src._cache))
+    entry = src._cache[key]
+    src.spill_dirty()  # warm record WITHOUT the push below
+    assert src.ingest_append(u, [float(T0 + 40 * STEP)], [1.0])["advanced"]
+    store.wal_append(u, [float(T0 + 40 * STEP)], [1.0])
+    with src._lock:  # evicted, then its queued spill dropped at the bound
+        del src._cache[key]
+    src._requeue_spills([(f"pad{i}", entry) for i in range(4096)]
+                        + [(key, entry)])
+    with src._lock:
+        src._spill_pending = []
+    out = store.checkpoint(src, force=True)
+    assert out.get("wal_retained_for_drops") is True
+    assert os.path.exists(store.wal_old_path), \
+        "the dropped pushes' only durable copy must survive the checkpoint"
+    # healing the key (promote comes back latched, consuming the marker)
+    # releases the debt; the next checkpoint retires the generation
+    src.fetch_window(u)
+    assert src.spill_debt() == 0
+    store.checkpoint(src, force=True)
+    assert not os.path.exists(store.wal_old_path)
+
+
+def test_append_short_write_rolls_back(tmp_path, monkeypatch):
+    """A short write (ENOSPC mid-frame) must not leave a torn prefix
+    that later appends bury mid-file: _append rolls the file back to its
+    pre-write size and raises, so callers take their degrade paths and
+    the file stays parseable end to end."""
+    store = WindowStore(str(tmp_path))
+    store.spill(_seg_state("k1"))
+    size_before = os.path.getsize(store.seg_path)
+    real_write, left = os.write, {"n": 1}
+
+    def short_write(fd, data):
+        if left["n"]:
+            left["n"] -= 1
+            return real_write(fd, bytes(data)[:5])
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(os, "write", short_write)
+    with pytest.raises(OSError):
+        store.spill(_seg_state("k2"))
+    monkeypatch.undo()
+    assert os.path.getsize(store.seg_path) == size_before
+    frames, status, _ = winstore._scan(store._read_file(store.seg_path))
+    assert status == winstore.SCAN_OK and len(frames) == 1
+    # the retry lands clean
+    store.spill(_seg_state("k2", 2.0))
+    assert store.load("k2") is not None
+
+
+def test_segment_torn_tail_compacted_before_new_appends(tmp_path):
+    """A torn segment tail is rewritten away at index-build time:
+    without that, post-recovery spills land AFTER the garbage, and the
+    next restart's scan stops at the tear — stranding every frame
+    written since, acked-push state included."""
+    store = WindowStore(str(tmp_path))
+    store.spill(_seg_state("k1"))
+    with store._seg_lock:  # crash mid-append: half a frame at the tail
+        store._append(store.seg_path, b"never-finished", tear=True)
+    store2 = WindowStore(str(tmp_path))
+    with store2._seg_lock:
+        n, status = store2._build_index_locked()
+    assert status == winstore.SCAN_TORN and n == 1
+    store2.spill(_seg_state("k2", 2.0))
+    # the NEXT restart reaches everything — tear gone, both keys indexed
+    store3 = WindowStore(str(tmp_path))
+    with store3._seg_lock:
+        _, status3 = store3._build_index_locked()
+    assert status3 == winstore.SCAN_OK
+    np.testing.assert_array_equal(store3.load("k1")["values"],
+                                  np.full(10, 1.0, np.float32))
+    np.testing.assert_array_equal(store3.load("k2")["values"],
+                                  np.full(10, 2.0, np.float32))
+
+
+def test_segment_mid_corruption_salvages_post_damage_frames(tmp_path):
+    """Mid-file segment damage loses only the frames it overwrote:
+    segment records are order-independent newest-wins states, so the
+    index walk resumes at the next CRC-valid frame and states spilled
+    AFTER the damage survive (compacting only the pre-damage index
+    would invert newest-wins and destroy them)."""
+    store = WindowStore(str(tmp_path))
+    store.spill(_seg_state("k1", 1.0))
+    store.spill(_seg_state("k2", 2.0))
+    store.spill(_seg_state("k1", 3.0))  # newest k1 lives PAST the damage
+    flen = os.path.getsize(store.seg_path) // 3  # identical frame sizes
+    with open(store.seg_path, "r+b") as f:  # zap the middle (k2) frame
+        f.seek(flen + flen // 2)
+        f.write(b"\xff" * 8)
+    store2 = WindowStore(str(tmp_path))
+    with store2._seg_lock:
+        n, status = store2._build_index_locked()
+    assert status == winstore.SCAN_CORRUPT
+    assert n == 2  # k1-old + k1-new; only the damaged k2 frame is lost
+    np.testing.assert_array_equal(store2.load("k1")["values"],
+                                  np.full(10, 3.0, np.float32))
+    assert store2.load("k2") is None  # re-primes from the backend
+    # the salvage compaction left a clean file for the NEXT restart
+    store3 = WindowStore(str(tmp_path))
+    with store3._seg_lock:
+        _, status3 = store3._build_index_locked()
+    assert status3 == winstore.SCAN_OK
+    np.testing.assert_array_equal(store3.load("k1")["values"],
+                                  np.full(10, 3.0, np.float32))
+
+
+def test_requeue_overflow_latches_dropped_keys(tmp_path):
+    """Evictee spills dropped at the requeue bound are counted, and the
+    key latches: the stale warm state left in the segment comes back
+    push-blocked instead of serving around the lost acked pushes."""
+    be = _Backend()
+    _fill(be, "m", 40)
+    store = WindowStore(str(tmp_path))
+    src = DeltaWindowSource(be.source(), store=store)
+    u = _url("m", T0, T0 + 86400)
+    src.fetch_window(u)
+    key = next(iter(src._cache))
+    entry = src._cache[key]
+    # arm a pushed horizon and spill THAT state to the warm tier...
+    assert src.ingest_append(u, [float(T0 + 40 * STEP)], [1.0])["advanced"]
+    src.spill_dirty()
+    # ...then newer pushes land and the entry is evicted while the disk
+    # is too full to write — the queue overflows and ITS state is lost
+    assert src.ingest_append(u, [float(T0 + 41 * STEP)], [2.0])["advanced"]
+    with src._lock:
+        del src._cache[key]
+    src._requeue_spills([(f"pad{i}", entry) for i in range(4096)]
+                        + [(key, entry)])
+    assert src.warm_spill_drops == 1
+    assert src.snapshot()["warm_spill_drops"] == 1
+    with src._lock:
+        src._spill_pending = []
+    # the warm tier still holds the OLDER horizon: it must come back
+    # latched, and the poll path heals it (the usual resync contract)
+    promoted = src._promote(key)
+    assert promoted is not None
+    assert promoted.push_blocked and promoted.pushed_until == 0.0
+    assert key not in src._dropped_spill_keys
+    res = src.ingest_append(u, [float(T0 + 42 * STEP)], [3.0])
+    assert res["reason"] == "resync"
