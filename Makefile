@@ -54,7 +54,7 @@ soak:            ## live-runtime chaos soak (<120s): spike+hang faults against a
 soak-sharded:    ## multi-replica kill -9 chaos soak (<120s): 3 replicas over one archive, one hard-killed mid-cycle; zero lost / zero double-scored jobs, verdicts == single-replica baseline
 	$(CPU_ENV) $(PY) -m pytest tests/test_shard_soak.py -q
 
-soak-stream:     ## streaming-ingest soak (<120s): push + poll interleaved under chaos latency and a store-shard brownout; pushed jobs keep stream-scoring through the blackout, health DEGRADED->OK
+soak-stream:     ## streaming-ingest soaks (<120s): push+poll under chaos latency and a store-shard brownout (stream-scoring through the blackout, DEGRADED->OK), plus the two-replica push-to-verdict trace soak (one trace across the ring forward, explain carries its trace_id)
 	$(CPU_ENV) $(PY) -m pytest tests/test_stream_soak.py -q
 
 soak-restart:    ## crash-durability soak (<60s): kill -9 a replica mid-push-stream, restart over the same WINDOW_STORE_DIR; WAL+segment replay, zero refetch storm, verdicts == never-restarted baseline (torn-WAL chaos leg included)

@@ -326,6 +326,15 @@ def _render_explain(payload: dict) -> str:
         lines.append(
             f"  detection latency: {rec['detection_latency_s']:.3f}s "
             "(window advance -> verdict)")
+    stages = rec.get("detection_stages") or {}
+    if stages:
+        # the waterfall arrives already in stage order (engine/slo.py
+        # STAGE_ORDER — the recorder builds it ordered)
+        lines.append("  waterfall: " + _fmt_waterfall(stages))
+    if rec.get("trace_id"):
+        lines.append(f"  trace: {rec['trace_id']} "
+                     "(foremast-tpu trace <job>, or GET "
+                     f"/debug/traces?trace_id={rec['trace_id']})")
     for h in rec.get("hops", []):
         # cross-replica history: each hop is one lease handoff the job
         # survived — the chain names the releasing replica AND its cycle
@@ -407,6 +416,100 @@ def cmd_explain(args) -> int:
         print(json.dumps(payload, indent=2))
     else:
         print(_render_explain(payload))
+    return 0
+
+
+def _fmt_waterfall(stages: dict) -> str:
+    """One rendering for detection-stage waterfalls everywhere the CLI
+    shows them (explain, trace tree, trace summary)."""
+    return " -> ".join(f"{k} {float(v) * 1000:.1f}ms"
+                       for k, v in stages.items())
+
+
+def _render_trace_tree(span: dict, depth: int, lines: list):
+    attrs = span.get("attrs") or {}
+    extra = []
+    for key in ("replica", "origin_replica", "job_id", "transport",
+                "target", "worker", "status"):
+        if key in attrs:
+            extra.append(f"{key}={attrs[key]}")
+    lines.append(f"  {'  ' * depth}{span.get('name', '?')} "
+                 f"{span.get('duration_ms', 0):.1f}ms"
+                 + (f"  [{', '.join(extra)}]" if extra else ""))
+    wf = attrs.get("waterfall")
+    if isinstance(wf, dict) and wf:
+        lines.append(f"  {'  ' * (depth + 1)}waterfall: "
+                     + _fmt_waterfall(wf))
+    for child in span.get("children") or ():
+        _render_trace_tree(child, depth + 1, lines)
+
+
+def _render_trace(trace_id: str, trees: list, job_id: str) -> str:
+    """Human-readable distributed trace: each locally-finished span tree
+    of the trace (receive/forward on one replica, partial cycle +
+    verdict on the scoring one), resource-stamped, with the closing
+    verdict span's waterfall inline."""
+    lines = [f"trace {trace_id} for job {job_id} — "
+             f"{len(trees)} span tree(s) on this replica"]
+    for tree in trees:
+        res = tree.get("resource") or {}
+        head = f"[{res.get('replica', 'local')}]" if res else "[local]"
+        lines.append(head)
+        _render_trace_tree(tree, 0, lines)
+    if not trees:
+        lines.append("  (no spans in this replica's ring — the trace "
+                     "may live on the replica that scored the job, or "
+                     "was evicted/unsampled; try the other replicas or "
+                     "the TRACE_EXPORT_URL collector)")
+    return "\n".join(lines)
+
+
+def cmd_trace(args) -> int:
+    """Fetch one job's push-to-verdict distributed trace: resolve the
+    job's trace_id via /jobs/<id>/explain, then render every span tree
+    of that trace from /debug/traces?trace_id= (docs/operations.md
+    "Following one push to its verdict")."""
+    base = _resolve_base(args.endpoint)
+    explain, rec = {}, {}
+    if args.trace_id:
+        # explicit id: the explain hop is OPTIONAL enrichment (the job
+        # may be unknown to this replica — e.g. the id came from an
+        # /ingest response on the non-owner); its failure must not block
+        # the /debug/traces fetch
+        try:
+            explain = _get_json(base, f"/jobs/{args.job}/explain")
+            rec = explain.get("provenance") or {}
+        except Exception:  # noqa: BLE001 - enrichment only
+            pass
+    else:
+        try:
+            explain = _get_json(base, f"/jobs/{args.job}/explain")
+        except Exception as e:  # noqa: BLE001 - CLI boundary: diagnose
+            print(f"cannot reach {base}: {e}", file=sys.stderr)
+            return 1
+        rec = explain.get("provenance") or {}
+    trace_id = args.trace_id or rec.get("trace_id", "")
+    if not trace_id:
+        print(f"job {args.job} has no recorded trace_id "
+              "(not judged since this runtime started, or provenance "
+              "is off)", file=sys.stderr)
+        return 1
+    try:
+        payload = _get_json(
+            base, f"/debug/traces?trace_id={trace_id}&limit=100")
+    except Exception as e:  # noqa: BLE001 - CLI boundary: diagnose
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({"trace_id": trace_id, "explain": explain,
+                          "traces": payload.get("traces", [])}, indent=2))
+        return 0
+    print(_render_trace(trace_id, payload.get("traces", []), args.job))
+    stages = rec.get("detection_stages") or {}
+    if stages:
+        print("verdict waterfall: " + _fmt_waterfall(stages))
+    if rec.get("detection_latency_s") is not None:
+        print(f"detection latency: {rec['detection_latency_s']:.3f}s")
     return 0
 
 
@@ -600,6 +703,22 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--json", action="store_true",
                     help="print the raw /jobs/<id>/explain payload")
     ex.set_defaults(func=cmd_explain)
+    trc = sub.add_parser(
+        "trace",
+        help="render a job's push-to-verdict distributed trace (explain's "
+             "trace_id resolved against /debug/traces) with its "
+             "detection-latency waterfall",
+    )
+    trc.add_argument("job", help="job id (/v1/healthcheck/create's jobId)")
+    trc.add_argument("--trace-id", default="",
+                     help="explicit trace id (skip the explain lookup — "
+                          "e.g. the trace_id an /ingest response returned)")
+    trc.add_argument("--endpoint", default="",
+                     help="runtime base URL (env ANALYST_ENDPOINT; "
+                          "default http://localhost:8099)")
+    trc.add_argument("--json", action="store_true",
+                     help="print the raw explain + trace payloads")
+    trc.set_defaults(func=cmd_trace)
     for name, fn, help_ in (
         ("watch", cmd_watch, "enable continuous monitoring for an app"),
         ("unwatch", cmd_unwatch, "disable continuous monitoring for an app"),

@@ -17,8 +17,10 @@ the same registry via `samples()`.
 from __future__ import annotations
 
 import bisect
+import logging
 import threading
 import time
+import urllib.request
 
 from ..utils.locks import make_lock
 from ..utils.promtext import escape_label_value as _esc
@@ -265,3 +267,142 @@ class VerdictExporter:
                 lines.append(f"{name}_sum {round(total, 6)}")
                 lines.append(f"{name}_count {n}")
         return "\n".join(lines) + "\n"
+
+
+class OtlpTraceExporter:
+    """Bounded background OTLP/JSON trace exporter (TRACE_EXPORT_URL).
+
+    Registers as a tracer sink (utils/tracing.py ``Tracer.add_sink``):
+    finished SAMPLED root spans land in a bounded queue, a single daemon
+    thread batches and POSTs them to the collector's ``/v1/traces``
+    endpoint as OTLP JSON (``ingest/wire.py encode_otlp_traces`` — the
+    ingest side already speaks OTLP; this is the same dialect outbound).
+    Everything degrades, nothing blocks: queue overflow drops the OLDEST
+    trace (counted), a dead collector costs one counted failure per
+    batch with the batch dropped (traces are observability, not data —
+    the /debug/traces ring and `foremast-tpu trace` keep working with no
+    collector at all)."""
+
+    def __init__(self, url: str, exporter: "VerdictExporter | None" = None,
+                 resource: dict | None = None, timeout: float = 2.0,
+                 max_queue: int = 512, flush_interval: float = 1.0,
+                 max_batch: int = 64):
+        self.url = url
+        self.exporter = exporter
+        self.resource = dict(resource or {})
+        self.timeout = float(timeout)
+        self.max_queue = int(max_queue)
+        self.flush_interval = max(float(flush_interval), 0.05)
+        self.max_batch = max(int(max_batch), 1)
+        self._lock = make_lock("dataplane.trace_export")
+        self._queue: list[dict] = []
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # observability (/status trace_export section + counters)
+        self.exported_spans = 0
+        self.exported_batches = 0
+        self.failures = 0
+        self.dropped = 0
+
+    # ------------------------------------------------------------- intake
+    def sink(self, root: dict):
+        """Tracer sink: enqueue one finished sampled root (never blocks;
+        oldest-first drop at the bound)."""
+        with self._lock:
+            self._queue.append(root)
+            if len(self._queue) > self.max_queue:
+                del self._queue[0]
+                self.dropped += 1
+        self._wake.set()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "OtlpTraceExporter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="trace-export", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, flush: bool = True, timeout: float = 5.0):
+        """Stop the loop; by default flush what is queued first (a
+        SIGTERM mid-incident should not drop the incident's traces)."""
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        if flush:
+            self._flush()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval)
+            self._wake.clear()
+            try:
+                self._flush()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logging.getLogger(__name__).exception(
+                    "trace export flush failed")
+
+    # -------------------------------------------------------------- flush
+    @staticmethod
+    def _count_spans(root: dict) -> int:
+        return 1 + sum(OtlpTraceExporter._count_spans(c)
+                       for c in root.get("children") or ())
+
+    def _flush(self):
+        from ..ingest.wire import encode_otlp_traces
+
+        while True:
+            with self._lock:
+                batch = self._queue[:self.max_batch]
+                del self._queue[:self.max_batch]
+            if not batch:
+                return
+            body = encode_otlp_traces(batch, resource=self.resource)
+            req = urllib.request.Request(
+                self.url, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            n_spans = sum(self._count_spans(r) for r in batch)
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as r:
+                    ok = 200 <= r.status < 300
+            except Exception as e:  # noqa: BLE001 - network boundary
+                logging.getLogger(__name__).warning(
+                    "trace export to %s failed: %s", self.url, e)
+                ok = False
+            with self._lock:
+                if ok:
+                    self.exported_spans += n_spans
+                    self.exported_batches += 1
+                else:
+                    self.failures += 1
+            if self.exporter is not None:
+                if ok:
+                    self.exporter.record_counter(
+                        "foremastbrain:trace_export_spans_total", {},
+                        n_spans,
+                        help="spans exported to TRACE_EXPORT_URL as "
+                             "OTLP/JSON")
+                else:
+                    self.exporter.record_counter(
+                        "foremastbrain:trace_export_failures_total", {},
+                        help="trace export batches the collector "
+                             "rejected or never received (batch dropped)")
+            if not ok:
+                return  # dead collector: drain on the next interval
+
+    # ------------------------------------------------------- observability
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "url": self.url,
+                "queued": len(self._queue),
+                "exported_spans": self.exported_spans,
+                "exported_batches": self.exported_batches,
+                "failures": self.failures,
+                "dropped": self.dropped,
+            }

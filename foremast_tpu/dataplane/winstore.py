@@ -202,7 +202,12 @@ class WindowStore:
 
     def __init__(self, dir_path: str, segment_max_bytes: int = 256 << 20,
                  fsync: bool = False, wal_injector=None,
-                 checkpoint_min_seconds: float = 5.0):
+                 checkpoint_min_seconds: float = 5.0, exporter=None):
+        # metrics registry (dataplane/exporter.py VerdictExporter) for the
+        # latency histograms the disk-pressure runbook reads:
+        # window_store_wal_append_seconds + window_store_checkpoint_seconds
+        # {kind=checkpoint|recovery}; None = counters only, as before
+        self.exporter = exporter
         self.dir = dir_path
         os.makedirs(dir_path, exist_ok=True)
         self.seg_path = os.path.join(dir_path, "segments.dat")
@@ -327,6 +332,7 @@ class WindowStore:
             from ..resilience.faults import OK as _OK
 
             tear = self.wal_injector.decide() != _OK
+        t0 = time.monotonic()
         try:
             with self._wal_lock:
                 self._append(self.wal_path, payload, tear=tear)
@@ -339,6 +345,16 @@ class WindowStore:
             log.warning("WAL append failed (push stays RAM-only until "
                         "the next poll): %s", e)
             return False
+        if self.exporter is not None:
+            # the same clock the ingest receiver's WAL span reads: one
+            # append's wall latency, the runbook's disk-pressure signal
+            # (a rising p99 here precedes wal_errors)
+            self.exporter.record_histogram(
+                "foremastbrain:window_store_wal_append_seconds", {},
+                time.monotonic() - t0,
+                help="One push-batch WAL append (write + optional fsync) "
+                     "in seconds; rising tails signal disk pressure "
+                     "before wal_errors do.")
         return True
 
     @staticmethod
@@ -557,6 +573,7 @@ class WindowStore:
             "wal_scan": wal_status,
             "seconds": round(time.monotonic() - t0, 4),
         }
+        self._observe_duration("recovery", time.monotonic() - t0)
         return dict(self.recovery)
 
     # ---------------------------------------------------------- checkpoint
@@ -571,6 +588,7 @@ class WindowStore:
                 < self.checkpoint_min_seconds:
             return {}
         self._last_checkpoint = now
+        t0 = now
         with self._wal_lock:
             wal_bytes = os.path.getsize(self.wal_path) \
                 if os.path.exists(self.wal_path) else 0
@@ -587,6 +605,7 @@ class WindowStore:
         debt_fn = getattr(delta, "spill_debt", None)
         if debt_fn is not None and debt_fn():
             self.checkpoints += 1
+            self._observe_duration("checkpoint", time.monotonic() - t0)
             return {"spilled": spilled, "wal_bytes_rotated": wal_bytes,
                     "wal_retained_for_drops": True}
         with self._wal_lock:
@@ -595,7 +614,20 @@ class WindowStore:
             except FileNotFoundError:
                 pass
         self.checkpoints += 1
+        self._observe_duration("checkpoint", time.monotonic() - t0)
         return {"spilled": spilled, "wal_bytes_rotated": wal_bytes}
+
+    def _observe_duration(self, kind: str, seconds: float):
+        """Checkpoint/recovery duration histogram ({kind=} label): the
+        runbook's disk-pressure latency signals next to the existing
+        count/byte counters."""
+        if self.exporter is not None:
+            self.exporter.record_histogram(
+                "foremastbrain:window_store_checkpoint_seconds",
+                {"kind": kind}, max(float(seconds), 0.0),
+                help="Window-store checkpoint (WAL rotate + dirty spill "
+                     "+ retire) and boot recovery durations in seconds, "
+                     "by kind.")
 
     # ------------------------------------------------------- observability
     def snapshot(self) -> dict:

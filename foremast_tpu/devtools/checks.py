@@ -608,6 +608,9 @@ class JitHygiene(Checker):
 _SPAN_REGISTRY_FILE = "foremast_tpu/utils/tracing.py"
 _EVENT_REGISTRY_FILE = "foremast_tpu/engine/flightrec.py"
 _PATH_REGISTRY_FILE = "foremast_tpu/engine/provenance.py"
+# detection-waterfall stage names (engine/slo.py STAGE_ORDER): the
+# DetectionWaterfall.add_stage() vocabulary, enforced like span names
+_STAGE_REGISTRY_FILE = "foremast_tpu/engine/slo.py"
 
 # instrumentation-free zones: bench/demo/devtools scripts may improvise
 _TRACE_EXEMPT_PREFIXES = (
@@ -670,6 +673,7 @@ class TraceNameRegistry(Checker):
         self._spans: set[str] = set()
         self._events: set[str] = set()
         self._paths: set[str] = set()
+        self._stages: set[str] = set()
         # deferred literal usages: (kind, literal, path, line)
         self._literals: list[tuple[str, str, str, int]] = []
 
@@ -702,6 +706,9 @@ class TraceNameRegistry(Checker):
         if module.relpath == _PATH_REGISTRY_FILE:
             self._paths |= _collect_caps_strings(module.tree)
             return []
+        if module.relpath == _STAGE_REGISTRY_FILE:
+            self._stages |= _collect_caps_strings(module.tree)
+            return []
         if module.relpath.startswith(_TRACE_EXEMPT_PREFIXES):
             return []
         findings: list[Finding] = []
@@ -728,15 +735,23 @@ class TraceNameRegistry(Checker):
             elif fname.endswith("provenance.record") and len(node.args) >= 2:
                 self._check_name_arg("provenance-path", node.args[1],
                                      module, node.lineno, findings)
+            elif last == "add_stage" and len(node.args) >= 2:
+                # DetectionWaterfall.add_stage(job_id, STAGE, seconds):
+                # waterfall stage names are registered constants like
+                # span names — dashboards/runbooks enumerate the set
+                self._check_name_arg("stage", node.args[1], module,
+                                     node.lineno, findings)
         return findings
 
     def finish(self) -> list[Finding]:
         registries = {"span": self._spans, "event": self._events,
-                      "provenance-path": self._paths}
+                      "provenance-path": self._paths,
+                      "stage": self._stages}
         hints = {
             "span": "utils/tracing.py SPAN_NAMES",
             "event": "engine/flightrec.py EVENT_TYPES",
             "provenance-path": "engine/provenance.py PATHS",
+            "stage": "engine/slo.py STAGE_ORDER",
         }
         findings: list[Finding] = []
         for kind, literal, path, line in self._literals:

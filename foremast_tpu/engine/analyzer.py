@@ -373,6 +373,18 @@ class Analyzer:
             },
             objective=config.slo_objective)
         self.health.configure(slo_fn=self.slo.burn_summary)
+        # detection-latency waterfall (engine/slo.py DetectionWaterfall):
+        # the per-stage decomposition of each SLO observation. The ingest
+        # receiver opens records at push accept (with the push's W3C
+        # trace context + origin timestamp), the stream scheduler stamps
+        # the debounce/schedule waits, and _observe_latency closes each
+        # record at verdict fold — exporting
+        # foremastbrain:detection_stage_seconds{stage=} histograms and
+        # the verdict span that ends the push's distributed trace.
+        self.waterfall = slo_mod.DetectionWaterfall(exporter=self.exporter)
+        # monotonic stamp of the current cycle's fold start: splits the
+        # in-cycle tail into the waterfall's score and fold stages
+        self._cycle_fold_mono = 0.0
         # once-per-window-advance SLO dedupe: job_id -> newest judged
         # sample ts already observed (_observe_latency). Entries die with
         # the job (_prune_degraded_state).
@@ -765,25 +777,61 @@ class Analyzer:
         if not st.ingest_at:
             return
         tail0 = self._cycle_mono0 or st.ingest_at
-        lat = max(time.monotonic() - tail0, 0.0)
+        mono_now = time.monotonic()
+        lat = max(mono_now - tail0, 0.0)
         if st.newest_ts > 0:
             if self._slo_seen.get(st.doc.id, 0.0) >= st.newest_ts:
                 st.ingest_at = 0.0
+                # a re-confirmation consumes nothing: drop any waterfall
+                # record a redundant push opened (its watermark is
+                # independent of the SLO dedupe), or its stages would
+                # leak into the job's NEXT genuine observation
+                self.waterfall.discard(st.doc.id)
                 return  # this advance was already observed
             self._slo_seen[st.doc.id] = st.newest_ts
             lat += max(now - st.newest_ts, 0.0)
         st.ingest_at = 0.0  # at most one observation per cycle
         self.slo.observe(slo_mod.classify(st.doc.strategy), lat)
-        self.provenance.annotate(st.doc.id,
-                                 detection_latency_s=round(lat, 6))
+        # waterfall: split the in-cycle tail at the fold boundary and
+        # close this job's stage record (push stages came from the
+        # receiver/scheduler; polled jobs synthesize the poll wait)
+        fold0 = self._cycle_fold_mono or mono_now
+        wf = self.waterfall.observe(
+            st.doc.id, now=now, newest_ts=st.newest_ts,
+            score_s=max(fold0 - tail0, 0.0),
+            fold_s=max(mono_now - fold0, 0.0))
+        ann = {"detection_latency_s": round(lat, 6)}
+        if wf["stages"]:
+            ann["detection_stages"] = {
+                k: round(v, 6) for k, v in wf["stages"].items()}
+        if wf["trace_id"]:
+            # the push's trace beats the cycle's own: `explain` must
+            # link the verdict to the distributed trace that carried it
+            ann["trace_id"] = wf["trace_id"]
+        self.provenance.annotate(st.doc.id, **ann)
+        ctx = wf["ctx"]
+        if ctx is not None and ctx.sampled:
+            # close the push's distributed trace AT the verdict: a
+            # remote-parented span under the receive/forward chain
+            # carrying the waterfall, so one trace runs push -> verdict
+            # across every replica it touched
+            with tracing.tracer.span(
+                    tracing.SPAN_ENGINE_VERDICT, _remote=ctx,
+                    job_id=st.doc.id, status=st.doc.status,
+                    detection_latency_s=round(lat, 4),
+                    waterfall={k: round(v, 6)
+                               for k, v in wf["stages"].items()}):
+                pass
 
     def reset_slo(self):
         """Clear SLO observations AND the once-per-advance dedupe map
         (bench legs isolate measured cycles from warm-up; resetting the
         histograms without the map would mute the first post-reset
-        observation per job)."""
+        observation per job). The waterfall follows — stage
+        distributions must cover exactly the observations the SLO does."""
         self._slo_seen.clear()
         self.slo.reset()
+        self.waterfall.reset()
 
     def _prov_content(self, job_id: str) -> str | None:
         """Compact provenance JSON for a terminal Document's
@@ -1930,7 +1978,16 @@ class Analyzer:
         self.current_cycle_id = cycle_id
         t_cycle0 = time.perf_counter()
         self._cycle_mono0 = time.monotonic()
+        self._cycle_fold_mono = 0.0
+        # a partial cycle triggered by ONE push adopts that push's W3C
+        # context: its engine.cycle span (and every child) continues the
+        # push's distributed trace instead of minting its own. Bursts
+        # spanning several traces keep their own root — each job's
+        # verdict span still closes its own push trace.
+        remote_ctx = (self.waterfall.single_context(job_ids)
+                      if partial and job_ids else None)
         with tracing.tracer.bind(cycle_id=cycle_id), \
+                tracing.tracer.adopt_remote(remote_ctx), \
                 tracing.span(tracing.SPAN_ENGINE_CYCLE, worker=worker):
             now = time.time() if now is None else now
             self.provenance.begin_cycle(cycle_id, worker=worker)
@@ -2265,6 +2322,9 @@ class Analyzer:
             self.lstm_budget_skips += len(self._lstm_budget_skipped_ids)
 
         t_fold = time.perf_counter()
+        # waterfall boundary: everything before this instant is the
+        # `score` stage, everything after is `fold` (_observe_latency)
+        self._cycle_fold_mono = time.monotonic()
         # -- provenance collection (zero work when recording is off) --
         # per-family score-vs-threshold entries and judged-result counts
         # per job; counts vs the pipeline's memo-hit map classify each

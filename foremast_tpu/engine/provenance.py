@@ -30,6 +30,7 @@ import time
 from collections import OrderedDict, deque
 
 from .jobs import TERMINAL_STATUSES
+from ..utils import tracing
 from ..utils.locks import make_lock
 
 __all__ = [
@@ -91,6 +92,14 @@ class ProvenanceRecorder:
         # onto every later record so `explain` on the adopter shows the
         # full cross-replica decision chain)
         self._hops: OrderedDict[str, list] = OrderedDict()
+        # job -> sticky latest-DETECTION annotations (trace_id,
+        # detection_latency_s, detection_stages — annotate() refreshes
+        # them at each observed window advance). Re-confirming sweeps
+        # re-record a job every cycle; without the carry-forward the
+        # push's trace linkage would survive exactly one cadence before
+        # the next memo-hit record overwrote it (found live-driving the
+        # runtime). Terminal records close the entry like hops.
+        self._detections: OrderedDict[str, dict] = OrderedDict()
         self._cycle: dict = {}        # shared per-cycle block (stamped late)
         self._cycle_records: int = 0  # records written this cycle
         self.records_total = 0
@@ -118,6 +127,14 @@ class ProvenanceRecorder:
             "status": status,
             "cycle": self._cycle,  # shared ref; finish_cycle fills it in
         }
+        # trace linkage: the current thread's open trace (the engine
+        # cycle span) — `explain` answers with the trace_id a
+        # /debug/traces?trace_id= fetch (or `foremast-tpu trace`)
+        # resolves. For pushed jobs the analyzer's later annotate()
+        # overrides this with the push's own distributed trace id.
+        tid = tracing.tracer.current_trace_id()
+        if tid:
+            rec["trace_id"] = tid
         if detail:
             rec["detail"] = detail
         if reason:
@@ -130,6 +147,15 @@ class ProvenanceRecorder:
         if fetch:
             rec["fetch"] = fetch
         with self._lock:
+            det = self._detections.get(job_id)
+            if det:
+                # the latest DETECTION's linkage (trace_id, latency,
+                # waterfall) rides every later record until a newer
+                # advance refreshes it — a re-confirming sweep must not
+                # sever explain's verdict -> trace link. annotate()
+                # (running after record() in the observing cycle)
+                # overwrites these with the fresh detection's values.
+                rec.update(det)
             hops = self._hops.get(job_id)
             if hops:
                 # the inherited chain survives every later record: the
@@ -141,6 +167,8 @@ class ProvenanceRecorder:
                 rec["hops"] = list(hops)
                 if status in TERMINAL_STATUSES:
                     self._hops.pop(job_id, None)
+            if status in TERMINAL_STATUSES:
+                self._detections.pop(job_id, None)
             self._latest[job_id] = rec
             self._latest.move_to_end(job_id)
             while len(self._latest) > self.max_jobs:
@@ -166,17 +194,29 @@ class ProvenanceRecorder:
             if jobs is not None:
                 self._cycle["jobs"] = int(jobs)
 
+    _DETECTION_KEYS = ("trace_id", "detection_latency_s",
+                       "detection_stages")
+
     def annotate(self, job_id: str, **kv):
         """Fold late-arriving fields (detection latency, measured after
         the record was written) into a job's LATEST record. The record
         dict is shared with the ring, so both views update; a no-op when
-        the job has no record."""
+        the job has no record. Detection fields additionally stick to
+        the job (LRU-bounded), so later re-confirming records keep the
+        last detection's trace/waterfall linkage."""
         if not self.enabled or not kv:
             return
+        det = {k: kv[k] for k in self._DETECTION_KEYS if k in kv}
         with self._lock:
             rec = self._latest.get(job_id)
             if rec is not None:
                 rec.update(kv)
+            if det:
+                self._detections[job_id] = {
+                    **self._detections.get(job_id, {}), **det}
+                self._detections.move_to_end(job_id)
+                while len(self._detections) > self.max_jobs:
+                    self._detections.popitem(last=False)
 
     # --------------------------------------------- cross-replica handoffs
     def handoff_json(self, job_id: str, replica: str = "", worker: str = "",

@@ -127,6 +127,11 @@ class StreamScheduler:
             added = len(self._pending) - before
             self.notifications_total += 1
             self._cond.notify()
+        # waterfall: the debounce/schedule wait clock starts at notify
+        # (engine/slo.py DetectionWaterfall; no-op for unpushed jobs)
+        wf = getattr(self.analyzer, "waterfall", None)
+        if wf is not None:
+            wf.notify(ids)
         return added
 
     # --------------------------------------------------------------- loop
@@ -194,6 +199,11 @@ class StreamScheduler:
                 return False
             ids = frozenset(self._pending)
             self._pending.clear()
+        # waterfall: the partial cycle starts NOW — split each job's
+        # measured notify->start wait into debounce vs schedule stages
+        wf = getattr(self.analyzer, "waterfall", None)
+        if wf is not None:
+            wf.claim(ids, self.debounce_seconds)
         try:
             self.analyzer.run_cycle(worker=self.worker, job_ids=ids,
                                     partial=True)

@@ -48,13 +48,58 @@ bench_cycle.run_stream_ab (`make perf`).
 from __future__ import annotations
 
 import bisect
+import time
+from collections import OrderedDict
 
 from ..dataplane.exporter import DEFAULT_TIME_BUCKETS
 from ..utils.locks import make_lock
 
-__all__ = ["DetectionSLO", "classify", "SLO_CLASSES"]
+__all__ = [
+    "DetectionSLO", "DetectionWaterfall", "classify", "SLO_CLASSES",
+    "STAGES", "STAGE_ORDER",
+]
 
 SLO_CLASSES = ("canary", "continuous", "hpa")
+
+# ---------------------------------------------------------------------------
+# Detection-latency waterfall stages (PR 14): the decomposition of ONE
+# detection_latency_seconds observation into where the time actually
+# went, exported as foremastbrain:detection_stage_seconds{stage=}.
+# Stage names are REGISTERED constants — the devtools trace-registry
+# rule rejects unregistered literals in add_stage() calls, exactly like
+# span names — so dashboards and the runbook can enumerate them.
+#
+#   ingest_receive  sample existed -> receiver accepted it (push
+#                   transport lag + decode/route/buffer time)
+#   forward_hop     origin replica's first contact -> the owning
+#                   replica's receipt (one ring hop; absent unforwarded)
+#   wal_append      the durability write before the /ingest ack
+#   splice          the delta-cache splice of the pushed batch
+#   debounce_wait   scheduler notify -> debounce window elapsed
+#                   (bounded by INGEST_DEBOUNCE_MS)
+#   schedule_wait   debounce end -> the partial cycle actually started
+#                   (waiting behind a running sweep); for POLLED jobs
+#                   this is the whole poll/scrape wait (cycle `now`
+#                   minus the newest judged sample — push stages absent)
+#   score           cycle start -> verdict fold began (fetch + dispatch
+#                   + collect for this job's cycle)
+#   fold            fold began -> this job's verdict was written
+# ---------------------------------------------------------------------------
+STAGE_INGEST_RECEIVE = "ingest_receive"
+STAGE_FORWARD_HOP = "forward_hop"
+STAGE_WAL_APPEND = "wal_append"
+STAGE_SPLICE = "splice"
+STAGE_DEBOUNCE_WAIT = "debounce_wait"
+STAGE_SCHEDULE_WAIT = "schedule_wait"
+STAGE_SCORE = "score"
+STAGE_FOLD = "fold"
+
+STAGE_ORDER = (
+    STAGE_INGEST_RECEIVE, STAGE_FORWARD_HOP, STAGE_WAL_APPEND,
+    STAGE_SPLICE, STAGE_DEBOUNCE_WAIT, STAGE_SCHEDULE_WAIT,
+    STAGE_SCORE, STAGE_FOLD,
+)
+STAGES = frozenset(STAGE_ORDER)
 
 
 def classify(strategy: str) -> str:
@@ -245,3 +290,259 @@ class DetectionSLO:
             self._sums.clear()
             self._totals.clear()
             self._violations.clear()
+
+
+class DetectionWaterfall:
+    """Per-job detection-latency stage attribution (STAGE_ORDER above).
+
+    The push half of the pipeline (ingest receiver, event scheduler)
+    accumulates stage seconds into a bounded in-flight book keyed by
+    job id; the analyzer closes each record at verdict fold (`observe`),
+    exporting one histogram sample per stage
+    (``foremastbrain:detection_stage_seconds{stage=}``) so PR 10's SLO
+    burn decomposes into actionable stages. Polled jobs get the same
+    waterfall minus the push stages: their whole wait is
+    ``schedule_wait`` (cycle ``now`` − newest judged sample). The book
+    also carries each push's adopted W3C trace context + first-contact
+    timestamp (stamped ONCE at the origin replica, propagated through
+    ring forwards), which is how the verdict span and the provenance
+    ``trace_id`` link back to the push's distributed trace.
+
+    Pure observation, allocation-bounded (LRU book + fixed bucket
+    grids); HTTP threads write, the engine thread closes — everything
+    under one short lock, nothing blocking held."""
+
+    def __init__(self, exporter=None, max_jobs: int = 4096,
+                 buckets: tuple = DEFAULT_TIME_BUCKETS):
+        self.exporter = exporter
+        self.max_jobs = int(max_jobs)
+        self._edges = tuple(buckets)
+        self._lock = make_lock("engine.slo.waterfall")
+        # job_id -> {"origin": wall ts of first contact, "accepted": wall
+        # ts the owning replica accepted, "notify_mono": scheduler stamp,
+        # "stages": {stage: seconds}, "ctx": W3CContext | None}
+        self._inflight: OrderedDict[str, dict] = OrderedDict()
+        # stage -> [bucket counts (+Inf implicit), sum, count]; "total"
+        # pseudo-row tracks the per-observation stage sum so the bench
+        # can compare it against detection_latency_seconds directly
+        self._hist: dict[str, list] = {}
+        self.observed_total = 0
+        self.streamed_total = 0
+        self.last: dict = {}
+
+    # ------------------------------------------------------------- writing
+    def begin_push(self, job_id: str, origin_wall: float,
+                   accepted_wall: float, ctx=None):
+        """Open (or refresh) a job's in-flight record at push accept.
+        The ORIGIN timestamp is kept from the earliest unobserved push
+        (detection latency is measured from first contact, never reset
+        by forwarding or a second push); the accepted stamp and trace
+        context follow the newest push."""
+        with self._lock:
+            rec = self._inflight.get(job_id)
+            if rec is None:
+                rec = self._inflight[job_id] = {
+                    "origin": float(origin_wall), "stages": {},
+                    "notify_mono": 0.0, "ctx": None,
+                }
+                while len(self._inflight) > self.max_jobs:
+                    self._inflight.popitem(last=False)
+            else:
+                rec["origin"] = min(rec["origin"], float(origin_wall))
+                self._inflight.move_to_end(job_id)
+            rec["accepted"] = float(accepted_wall)
+            if ctx is not None:
+                rec["ctx"] = ctx
+
+    def add_stage(self, job_id: str, stage: str, seconds: float):
+        """Accumulate stage seconds onto a job's in-flight record (no-op
+        when the job has none — stage timings without a push accept have
+        nothing to attribute to)."""
+        with self._lock:
+            rec = self._inflight.get(job_id)
+            if rec is not None:
+                rec["stages"][stage] = \
+                    rec["stages"].get(stage, 0.0) + max(float(seconds), 0.0)
+
+    def notify(self, job_ids):
+        """Scheduler tap: stamp when each pushed job entered the pending
+        set (the debounce/schedule wait clock starts here)."""
+        now = time.monotonic()
+        with self._lock:
+            for jid in job_ids:
+                rec = self._inflight.get(jid)
+                if rec is not None and not rec["notify_mono"]:
+                    rec["notify_mono"] = now
+
+    def claim(self, job_ids, debounce_seconds: float):
+        """Scheduler tap: the partial cycle is starting NOW for these
+        jobs — split the measured notify->start wait into the debounce
+        window (bounded by the knob) and the scheduling excess (waiting
+        behind a running sweep)."""
+        now = time.monotonic()
+        db = max(float(debounce_seconds), 0.0)
+        with self._lock:
+            for jid in job_ids:
+                rec = self._inflight.get(jid)
+                if rec is None or not rec["notify_mono"]:
+                    continue
+                wait = max(now - rec["notify_mono"], 0.0)
+                rec["notify_mono"] = 0.0
+                d = min(wait, db)
+                st = rec["stages"]
+                st[STAGE_DEBOUNCE_WAIT] = st.get(STAGE_DEBOUNCE_WAIT,
+                                                 0.0) + d
+                st[STAGE_SCHEDULE_WAIT] = st.get(STAGE_SCHEDULE_WAIT,
+                                                 0.0) + (wait - d)
+                rec["scheduled"] = True
+
+    def discard(self, job_id: str):
+        """Drop a job's in-flight record WITHOUT observing it — the
+        SLO-dedupe path: a cycle that re-confirms an already-observed
+        advance consumes nothing, and the stale record's stages must not
+        leak into (and inflate) the job's NEXT genuine observation."""
+        with self._lock:
+            self._inflight.pop(job_id, None)
+
+    def single_context(self, job_ids):
+        """The one W3C context shared by every in-flight record among
+        `job_ids` (None when there are zero, several, or mixed traces) —
+        lets a partial cycle triggered by a single push adopt that
+        push's trace for its whole engine.cycle span."""
+        ctx = None
+        with self._lock:
+            for jid in job_ids:
+                rec = self._inflight.get(jid)
+                c = rec.get("ctx") if rec is not None else None
+                if c is None:
+                    continue
+                if ctx is None:
+                    ctx = c
+                elif ctx.trace_id != c.trace_id:
+                    return None
+        return ctx
+
+    # ------------------------------------------------------------- closing
+    def observe(self, job_id: str, now: float, newest_ts: float,
+                score_s: float, fold_s: float) -> dict:
+        """Close a job's waterfall at verdict fold. Pushed jobs consume
+        their in-flight record (push stages + measured waits, with a
+        wall-clock fallback for the accept->cycle wait when no scheduler
+        ran, e.g. bench partial cycles); polled jobs synthesize the
+        poll-wait-only shape. Returns {"stages", "ctx", "trace_id",
+        "streamed", "total_s"}."""
+        with self._lock:
+            rec = self._inflight.pop(job_id, None)
+        stages: dict[str, float] = {}
+        ctx = None
+        streamed = rec is not None
+        if rec is not None:
+            ctx = rec.get("ctx")
+            for stage in STAGE_ORDER:
+                v = rec["stages"].get(stage)
+                if v is not None:
+                    stages[stage] = v
+            if not rec.get("scheduled") and STAGE_SCHEDULE_WAIT not in \
+                    stages and rec.get("accepted"):
+                # no scheduler stamped the wait (direct run_cycle): the
+                # accept->cycle gap in the same clock domain as `now`
+                stages[STAGE_SCHEDULE_WAIT] = \
+                    max(float(now) - rec["accepted"], 0.0)
+        elif newest_ts > 0:
+            stages[STAGE_SCHEDULE_WAIT] = max(float(now) - newest_ts, 0.0)
+        stages[STAGE_SCORE] = max(float(score_s), 0.0)
+        stages[STAGE_FOLD] = max(float(fold_s), 0.0)
+        total = sum(stages.values())
+        with self._lock:
+            for stage, v in stages.items():
+                self._observe_hist(stage, v)
+            self._observe_hist("total", total)
+            self.observed_total += 1
+            if streamed:
+                self.streamed_total += 1
+            self.last = {
+                "job_id": job_id,
+                "streamed": streamed,
+                "stages": {k: round(v, 6) for k, v in stages.items()},
+                "total_s": round(total, 6),
+                "trace_id": ctx.trace_id if ctx is not None else "",
+            }
+        if self.exporter is not None:
+            for stage, v in stages.items():
+                self.exporter.record_histogram(
+                    "foremastbrain:detection_stage_seconds",
+                    {"stage": stage}, v,
+                    help="Detection-latency waterfall: seconds spent per "
+                         "stage between a sample existing and its "
+                         "verdict (docs/operations.md \"Following one "
+                         "push to its verdict\").",
+                    buckets=self._edges)
+        return {
+            "stages": stages,
+            "ctx": ctx,
+            "trace_id": ctx.trace_id if ctx is not None else "",
+            "streamed": streamed,
+            "total_s": total,
+        }
+
+    def _observe_hist(self, stage: str, v: float):
+        h = self._hist.get(stage)
+        if h is None:
+            h = self._hist[stage] = [[0] * (len(self._edges) + 1), 0.0, 0]
+        h[0][bisect.bisect_left(self._edges, v)] += 1
+        h[1] += v
+        h[2] += 1
+
+    # ------------------------------------------------------------- reading
+    def quantile(self, stage: str, q: float) -> float:
+        """Bucket-resolution quantile of one stage's distribution (the
+        same floor-honest estimate DetectionSLO.quantile makes)."""
+        with self._lock:
+            h = self._hist.get(stage)
+            counts = list(h[0]) if h is not None else None
+        if not counts or sum(counts) == 0:
+            return 0.0
+        rank = q * sum(counts)
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                return float(self._edges[min(i, len(self._edges) - 1)])
+        return float(self._edges[-1])
+
+    def snapshot(self) -> dict:
+        """/status section: per-stage distribution summary + the last
+        closed waterfall (ordered; absent stages omitted)."""
+        with self._lock:
+            rows = {s: (list(h[0]), h[1], h[2])
+                    for s, h in self._hist.items()}
+            out = {
+                "observed": self.observed_total,
+                "streamed": self.streamed_total,
+                "inflight": len(self._inflight),
+                "last": dict(self.last),
+            }
+        stages = {}
+        for stage in (*STAGE_ORDER, "total"):
+            row = rows.get(stage)
+            if row is None:
+                continue
+            _counts, total, n = row
+            stages[stage] = {
+                "count": n,
+                "mean_s": round(total / n, 6) if n else 0.0,
+                "p50_s": round(self.quantile(stage, 0.5), 4),
+                "p99_s": round(self.quantile(stage, 0.99), 4),
+            }
+        out["stages"] = stages
+        return out
+
+    def reset(self):
+        """Clear distributions AND the in-flight book (bench warm-up
+        isolation, mirroring DetectionSLO.reset)."""
+        with self._lock:
+            self._hist.clear()
+            self._inflight.clear()
+            self.observed_total = 0
+            self.streamed_total = 0
+            self.last = {}

@@ -11,12 +11,19 @@ Three layers (each module's docstring carries the contract):
     (``StreamScheduler``): pushed jobs score IMMEDIATELY as partial
     cycles, the periodic full sweep stays the reconciliation fallback.
 """
-from .receiver import FORWARDED_HEADER, IngestReceiver, selector_matches
+from .receiver import (
+    FORWARDED_HEADER,
+    ORIGIN_REPLICA_HEADER,
+    ORIGIN_TS_HEADER,
+    IngestReceiver,
+    selector_matches,
+)
 from .wire import (
     IngestDecodeError,
     UnsupportedMedia,
     decode_otlp_json,
     decode_remote_write,
+    encode_otlp_traces,
     encode_remote_write,
     snappy_available,
     snappy_compress,
@@ -24,8 +31,10 @@ from .wire import (
 )
 
 __all__ = [
-    "IngestReceiver", "FORWARDED_HEADER", "selector_matches",
+    "IngestReceiver", "FORWARDED_HEADER", "ORIGIN_TS_HEADER",
+    "ORIGIN_REPLICA_HEADER", "selector_matches",
     "IngestDecodeError", "UnsupportedMedia",
     "decode_remote_write", "encode_remote_write", "decode_otlp_json",
+    "encode_otlp_traces",
     "snappy_available", "snappy_compress", "snappy_decompress",
 ]

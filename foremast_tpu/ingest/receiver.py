@@ -36,6 +36,15 @@ The subsystem between the HTTP receivers (``service/api.py`` mounts
   * **Notify** — jobs whose window advanced past a step boundary are
     handed to the event scheduler (``engine/scheduler.py``) for an
     immediate partial cycle instead of waiting for the global tick.
+  * **Trace** — every request opens a receive span that either adopts
+    the sender's W3C ``traceparent`` or mints a fresh (TRACE_SAMPLE'd)
+    root; splice/WAL/forward are child spans, forwards re-inject the
+    context plus the ORIGIN's first-contact timestamp and replica name,
+    and accepted pushes open detection-waterfall records
+    (``engine/slo.py DetectionWaterfall``) the engine closes at verdict
+    fold — so one trace runs push -> forward -> splice -> score ->
+    verdict across replicas (docs/operations.md "Following one push to
+    its verdict").
 """
 from __future__ import annotations
 
@@ -57,16 +66,33 @@ from .wire import (
 )
 from ..dataplane.promql import materialize_placeholders
 from ..engine import jobs as J
+from ..engine import slo as slo_mod
+from ..utils import tracing
 from ..utils.locks import make_lock
 
 log = logging.getLogger("foremast_tpu.ingest")
 
-__all__ = ["IngestReceiver", "selector_matches", "FORWARDED_HEADER"]
+__all__ = [
+    "IngestReceiver", "selector_matches", "FORWARDED_HEADER",
+    "ORIGIN_TS_HEADER", "ORIGIN_REPLICA_HEADER",
+]
 
 # one-hop forwarding marker: a body carrying it that still lands on a
 # non-owner is rejected instead of forwarded again (rebalance races must
 # not loop pushes around the ring)
 FORWARDED_HEADER = "X-Foremast-Forwarded"
+# first-contact stamp a ring forward carries: the ORIGIN replica's
+# receive timestamp, so detection latency and the waterfall measure from
+# first contact and are never reset by the hop; the origin's name rides
+# along so the target's spans name both replicas
+ORIGIN_TS_HEADER = "X-Foremast-Origin-Ts"
+ORIGIN_REPLICA_HEADER = "X-Foremast-Origin-Replica"
+
+# sanity window on the origin stamp: a one-hop ring forward arrives
+# within forward_timeout; anything claiming to be older than this is a
+# hostile/garbage header or a badly skewed peer clock and is ignored
+# (first contact falls back to local receipt, no forward_hop sample)
+_MAX_ORIGIN_AGE_S = 3600.0
 
 TRANSPORT_REMOTE_WRITE = "remote_write"
 TRANSPORT_OTLP = "otlp"
@@ -185,12 +211,22 @@ class IngestReceiver:
                  shard=None, exporter=None, notify_fn=None,
                  buffer_samples: int = 4096, buffer_jobs: int = 8192,
                  forward: bool = True, forward_timeout: float = 2.0,
-                 index_ttl: float = 2.0, window_store=None):
+                 index_ttl: float = 2.0, window_store=None,
+                 waterfall=None, replica: str = ""):
         self.store = store
         self.delta = delta_source
         self.cache = cache_source
         self.shard = shard
         self.exporter = exporter
+        # detection-latency waterfall book (engine/slo.py
+        # DetectionWaterfall, normally the analyzer's): push accepts open
+        # per-job stage records here — first contact, receive/wal/splice
+        # seconds, and the push's W3C trace context — which the engine
+        # closes at verdict fold. None = stage attribution off.
+        self.waterfall = waterfall
+        # this replica's name, stamped on receive spans and propagated on
+        # ring forwards so a cross-replica trace names both ends
+        self.replica = replica
         # crash-durability seam (dataplane/winstore.py): every push
         # batch that ADVANCES the cached window is WAL'd before this
         # receiver returns — the HTTP ack only leaves the process after
@@ -227,13 +263,71 @@ class IngestReceiver:
     # --------------------------------------------------------------- http
     def handle(self, transport: str, raw: bytes, content_type: str = "",
                content_encoding: str = "", forwarded: bool = False,
-               now: float | None = None) -> tuple[int, dict]:
+               now: float | None = None, traceparent: str = "",
+               origin_ts=None, origin_replica: str = "") -> tuple[int, dict]:
         """One push request -> (HTTP status, JSON payload). 415/400 carry
         a machine-readable ``reason``; per-series rejections ride the
         ``rejected`` map of a 200 so one bad series never fails a batch;
         429 means every routable sample hit buffer backpressure (the
-        retry signal remote-write honors)."""
+        retry signal remote-write honors).
+
+        ``traceparent`` (W3C) makes the push part of the SENDER's trace:
+        a valid header is adopted as the remote parent of this request's
+        receive span (and re-injected on ring forwards, so the hop is a
+        child on the origin replica's trace); a malformed one is counted
+        (``bad_traceparent``) and a fresh root trace minted — hostile
+        headers can never 5xx the endpoint or poison the buffer. The
+        response always carries the resulting ``trace_id``.
+        ``origin_ts``/``origin_replica`` arrive on forwarded hops only
+        (ORIGIN_TS_HEADER / ORIGIN_REPLICA_HEADER): first contact is the
+        ORIGIN's receipt, so the waterfall's clock survives the hop."""
         now = time.time() if now is None else now
+        t_mono0 = time.monotonic()
+        ctx = tracing.parse_traceparent(traceparent) if traceparent \
+            else None
+        bad_traceparent = bool(traceparent) and ctx is None
+        if bad_traceparent:
+            # typed degrade, never an error: a hostile header costs a
+            # counter and a fresh root trace, not the push
+            self._reject("bad_traceparent", 1)
+        first_contact = now
+        fwd_hop = 0.0
+        if forwarded and origin_ts not in (None, ""):
+            try:
+                o = float(origin_ts)
+            except (TypeError, ValueError):
+                o = 0.0
+            # bounded both ways, like the traceparent hardening: a
+            # future stamp floors at now, and a stamp older than the
+            # sanity window (garbage header, badly skewed peer clock) is
+            # ignored entirely — one hostile request must not inject an
+            # ~1e9 s forward_hop sample that poisons the stage
+            # histograms' sums forever
+            if o > 0 and now - o <= _MAX_ORIGIN_AGE_S:
+                first_contact = min(o, now)
+                fwd_hop = max(now - o, 0.0)
+        attrs = {"transport": transport}
+        if forwarded:
+            attrs["forwarded"] = True
+        if origin_replica:
+            attrs["origin_replica"] = origin_replica
+        if self.replica:
+            attrs["replica"] = self.replica
+        with tracing.tracer.adopt_remote(ctx), \
+                tracing.span(tracing.SPAN_INGEST_RECEIVE, **attrs) as sp:
+            status, payload = self._handle(
+                transport, raw, content_type, content_encoding,
+                forwarded, now, first_contact, fwd_hop, sp, t_mono0)
+        payload["trace_id"] = sp.trace_id
+        if bad_traceparent:
+            rej = payload.setdefault("rejected", {})
+            rej["bad_traceparent"] = rej.get("bad_traceparent", 0) + 1
+        return status, payload
+
+    def _handle(self, transport: str, raw: bytes, content_type: str,
+                content_encoding: str, forwarded: bool, now: float,
+                first_contact: float, fwd_hop: float, recv_span,
+                t_mono0: float) -> tuple[int, dict]:
         with self._lock:
             self.requests_total += 1
         try:
@@ -249,6 +343,12 @@ class IngestReceiver:
         rejected: dict[str, int] = {}
         advanced: set[str] = set()
         to_forward: dict[str, list] = {}  # owner addr -> [series]
+        # jobs whose PER-REQUEST waterfall stages (receive lag, forward
+        # hop) were already recorded this request: a batch fanning k
+        # series into one job must count the request-level quantities
+        # once, not k times (per-series work — splice, WAL — still
+        # accumulates per series)
+        wf_stamped: set[str] = set()
 
         def rej(reason: str, n: int):
             rejected[reason] = rejected.get(reason, 0) + n
@@ -281,7 +381,10 @@ class IngestReceiver:
                     else:
                         rej("not_owner", len(samples))
                     continue
-                ok, reason, adv = self._accept(doc, labels, samples, now)
+                ok, reason, adv = self._accept(
+                    doc, labels, samples, now, first_contact=first_contact,
+                    fwd_hop=fwd_hop, recv_span=recv_span, t_mono0=t_mono0,
+                    wf_stamped=wf_stamped)
                 if ok:
                     accepted_any = True
                 else:
@@ -299,9 +402,11 @@ class IngestReceiver:
             except Exception:  # noqa: BLE001 - scheduling is best-effort
                 log.exception("ingest notify failed")
         # forwards dispatch OUTSIDE any lock (network I/O)
+        forwarded_ok = 0
         for addr, fwd in to_forward.items():
             n = sum(len(s) for _, s in fwd)
-            if self._forward(addr, fwd):
+            if self._forward(addr, fwd, first_contact):
+                forwarded_ok += n
                 with self._lock:
                     self.forwarded_total += n
                 if self.exporter is not None:
@@ -324,6 +429,7 @@ class IngestReceiver:
             status = 429
         return status, {
             "accepted_samples": accepted,
+            "forwarded_samples": forwarded_ok,
             "rejected": rejected,
             "jobs_advanced": len(advanced),
             "transport": transport,
@@ -399,8 +505,10 @@ class IngestReceiver:
             return list(index.get(key, ()))
 
     # ----------------------------------------------------------- accept
-    def _accept(self, doc, labels: dict, samples: list,
-                now: float) -> tuple[bool, str, bool]:
+    def _accept(self, doc, labels: dict, samples: list, now: float,
+                first_contact: float | None = None, fwd_hop: float = 0.0,
+                recv_span=None, t_mono0: float = 0.0,
+                wf_stamped: set | None = None) -> tuple[bool, str, bool]:
         """Buffer + splice one series for one owned job. Returns
         (accepted, reject_reason, window_advanced)."""
         metric, mq, provable = self._match_metric(doc, labels)
@@ -413,6 +521,31 @@ class IngestReceiver:
                 self._watermarks.move_to_end(doc.id)
             while len(self._watermarks) > self._buffer.max_jobs:
                 self._watermarks.popitem(last=False)
+        # open/refresh the job's waterfall record at accept: first
+        # contact (the origin's, when forwarded), this request's trace
+        # context, the sample->receipt lag plus in-process handle time,
+        # and the forward hop if this push rode one. The engine closes
+        # the record at verdict fold (engine/slo.py DetectionWaterfall).
+        wf = self.waterfall if advanced else None
+        if wf is not None:
+            fc = now if first_contact is None else first_contact
+            wf.begin_push(
+                doc.id, fc, now,
+                ctx=recv_span.context() if recv_span is not None else None)
+            # PER-REQUEST stages stamp once per job per request: a batch
+            # fanning k advancing series into one job must not count the
+            # forward hop (a request quantity) k times, nor re-count the
+            # handle time already attributed by an earlier series
+            if wf_stamped is None or doc.id not in wf_stamped:
+                if wf_stamped is not None:
+                    wf_stamped.add(doc.id)
+                proc = max(time.monotonic() - t_mono0, 0.0) \
+                    if t_mono0 else 0.0
+                wf.add_stage(doc.id, slo_mod.STAGE_INGEST_RECEIVE,
+                             max(fc - newest, 0.0) + proc)
+                if fwd_hop > 0:
+                    wf.add_stage(doc.id, slo_mod.STAGE_FORWARD_HOP,
+                                 fwd_hop)
         if metric is None or self.delta is None or not provable \
                 or not mq.current:
             # wakeup-only: the partial cycle's windows come through the
@@ -435,8 +568,12 @@ class IngestReceiver:
             # path heals the entry and lifts the latch)
             self.delta.ingest_block(url)
             return False, "buffer_full", False
-        res = self.delta.ingest_append(
-            url, [ts for ts, _ in staged], [v for _, v in staged])
+        with tracing.span(tracing.SPAN_INGEST_SPLICE,
+                          job_id=doc.id) as sp_splice:
+            res = self.delta.ingest_append(
+                url, [ts for ts, _ in staged], [v for _, v in staged])
+        if wf is not None:
+            wf.add_stage(doc.id, slo_mod.STAGE_SPLICE, sp_splice.duration)
         reason = res.get("reason")
         if reason == "no_entry":
             # nothing cached yet (no poll has primed this query):
@@ -469,9 +606,17 @@ class IngestReceiver:
                 # source of truth, stale is already durable, off_grid/
                 # late were rejected and latched. Replay stays idempotent
                 # either way (stale rejection).
-                self.window_store.wal_append(
-                    url, [ts for ts, _ in staged],
-                    [v for _, v in staged])
+                # the WAL span and the waterfall's wal_append stage time
+                # the SAME call on the same clock the winstore's
+                # wal_append_seconds histogram measures
+                with tracing.span(tracing.SPAN_INGEST_WAL,
+                                  job_id=doc.id) as sp_wal:
+                    self.window_store.wal_append(
+                        url, [ts for ts, _ in staged],
+                        [v for _, v in staged])
+                if wf is not None:
+                    wf.add_stage(doc.id, slo_mod.STAGE_WAL_APPEND,
+                                 sp_wal.duration)
             with self._lock:
                 self.spliced_points_total += int(res["spliced"])
             if self.exporter is not None:
@@ -509,27 +654,41 @@ class IngestReceiver:
         return None, None, False
 
     # ---------------------------------------------------------- forward
-    def _forward(self, addr: str, series: list) -> bool:
+    def _forward(self, addr: str, series: list,
+                 first_contact: float) -> bool:
         """Re-encode + POST one owner's series to its /ingest endpoint.
         Best-effort with a short timeout: a dead owner costs one counted
         failure, never a hung HTTP thread; the data still reaches the
-        owner through its own poll path."""
+        owner through its own poll path.
+
+        The hop is a child span on THIS replica's trace, and its context
+        is re-injected as the forwarded request's `traceparent` — the
+        target's receive/WAL/splice/score spans parent under it, so one
+        trace covers push -> forward -> verdict across both replicas.
+        The origin's first-contact timestamp and name travel as headers
+        (the hop must never reset the detection clock)."""
         body = encode_remote_write(series)
         headers = {"Content-Type": "application/x-protobuf",
-                   FORWARDED_HEADER: "1"}
+                   FORWARDED_HEADER: "1",
+                   ORIGIN_TS_HEADER: f"{first_contact:.6f}"}
+        if self.replica:
+            headers[ORIGIN_REPLICA_HEADER] = self.replica
         if snappy_available():
             body = snappy_compress(body)
             headers["Content-Encoding"] = "snappy"
         url = addr.rstrip("/") + "/ingest/remote-write"
-        req = urllib.request.Request(url, data=body, headers=headers,
-                                     method="POST")
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=self.forward_timeout) as r:
-                return 200 <= r.status < 300
-        except Exception as e:  # noqa: BLE001 - network boundary
-            log.warning("ingest forward to %s failed: %s", addr, e)
-            return False
+        with tracing.span(tracing.SPAN_INGEST_FORWARD, target=addr) as sp:
+            headers[tracing.TRACEPARENT_HEADER] = \
+                sp.context().traceparent()
+            req = urllib.request.Request(url, data=body, headers=headers,
+                                         method="POST")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.forward_timeout) as r:
+                    return 200 <= r.status < 300
+            except Exception as e:  # noqa: BLE001 - network boundary
+                log.warning("ingest forward to %s failed: %s", addr, e)
+                return False
 
     # ---------------------------------------------------- observability
     def _reject(self, reason: str, n: int):

@@ -42,6 +42,7 @@ __all__ = [
     "IngestDecodeError", "UnsupportedMedia",
     "snappy_available", "snappy_compress", "snappy_decompress",
     "decode_remote_write", "encode_remote_write", "decode_otlp_json",
+    "encode_otlp_traces",
 ]
 
 # decompressed-body ceiling: a 4-byte snappy header can claim a 4 GiB
@@ -375,3 +376,77 @@ def decode_otlp_json(raw: bytes) -> list[tuple[dict, list]]:
                         continue
                     series.append((labels, [(ts, val)]))
     return series
+
+
+# --------------------------------------------------------------- OTLP traces
+# The EXPORT half: finished tracer root-span dicts (utils/tracing.py
+# Tracer ring shape) -> the JSON encoding of
+# ``ExportTraceServiceRequest`` (OTLP/HTTP ``/v1/traces``). Mirrors this
+# module's metrics-decoder conventions: one flat normalization, 64-bit
+# nanosecond timestamps as STRINGS (the OTLP JSON mapping — float64
+# cannot round-trip them), attributes as the keyed AnyValue list.
+def _otlp_nanos(epoch_seconds: float) -> str:
+    return str(int(round(float(epoch_seconds) * 1e9)))
+
+
+def _otlp_attr_list(attrs: dict) -> list:
+    out = []
+    for key, value in (attrs or {}).items():
+        if isinstance(value, bool):
+            av = {"boolValue": value}
+        elif isinstance(value, int):
+            av = {"intValue": str(value)}
+        elif isinstance(value, float):
+            av = {"doubleValue": value}
+        elif isinstance(value, str):
+            av = {"stringValue": value}
+        else:
+            av = {"stringValue": json.dumps(value, default=str)}
+        out.append({"key": str(key), "value": av})
+    return out
+
+
+def encode_otlp_traces(roots: list, resource: dict | None = None) -> bytes:
+    """[finished root-span dicts] -> OTLP/HTTP JSON trace body. Each
+    tree flattens to spans carrying traceId/spanId/parentSpanId, so a
+    trace that spans replicas (remote-parented roots) re-assembles in
+    any OTLP backend."""
+    spans: list[dict] = []
+
+    def flatten(node: dict, parent_id: str):
+        start = float(node.get("start", 0.0))
+        end = start + float(node.get("duration_ms", 0.0)) / 1000.0
+        span = {
+            "traceId": node.get("trace_id", ""),
+            "spanId": node.get("span_id", ""),
+            "name": node.get("name", ""),
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": _otlp_nanos(start),
+            "endTimeUnixNano": _otlp_nanos(end),
+        }
+        pid = node.get("parent_span_id", "") or parent_id
+        if pid:
+            span["parentSpanId"] = pid
+        attrs = _otlp_attr_list(node.get("attrs") or {})
+        if node.get("children_dropped"):
+            attrs.append({"key": "children_dropped",
+                          "value": {"intValue":
+                                    str(node["children_dropped"])}})
+        if attrs:
+            span["attributes"] = attrs
+        spans.append(span)
+        for child in node.get("children") or ():
+            flatten(child, span["spanId"])
+
+    for root in roots:
+        flatten(root, "")
+    body = {
+        "resourceSpans": [{
+            "resource": {"attributes": _otlp_attr_list(resource or {})},
+            "scopeSpans": [{
+                "scope": {"name": "foremast-tpu"},
+                "spans": spans,
+            }],
+        }],
+    }
+    return json.dumps(body, separators=(",", ":")).encode()

@@ -53,6 +53,14 @@ Env surface (union of the reference services'):
   SLO_CONTINUOUS_S /     the attainment objective the error budget
   SLO_HPA_S /            derives from (engine/slo.py; histograms + burn
   SLO_OBJECTIVE          gauges on /metrics, slo section on /status)
+  TRACE_SAMPLE /         push-to-verdict distributed tracing: head-
+  TRACE_EXPORT_URL       sampling for minted root traces (adopted
+                         traceparent headers keep the sender's flag) and
+                         the OTLP/HTTP collector finished traces POST to
+                         as OTLP JSON; /debug/traces + `foremast-tpu
+                         trace <job>` serve export-less deployments
+                         (docs/operations.md "Following one push to its
+                         verdict")
   JOB_RETENTION_SECONDS  prune archived terminal jobs from RAM after this
   PORT                   HTTP port (reference :8099)
   GRPC_PORT              gRPC dispatch port (0/unset disables; 8100 in the
@@ -153,8 +161,16 @@ class Runtime:
         window_store_segment_max_mb: int = 256,
         window_store_fsync: bool = False,
         window_store_checkpoint_seconds: float = 5.0,
+        trace_sample: float = 1.0,
+        trace_export_url: str = "",
     ):
         self.config = config or from_env()
+        # -- distributed tracing (utils/tracing.py): head-sampling for
+        # minted roots (TRACE_SAMPLE; adopted traceparent headers keep
+        # the sender's flag) — set before anything opens spans --
+        from .utils import tracing as tracing_mod
+
+        tracing_mod.tracer.set_sample_rate(trace_sample)
         # persistent XLA compile cache (COMPILE_CACHE_PATH): point the
         # backend at the shared cache dir BEFORE anything jits, so a
         # restarted pod replays compiled programs instead of re-paying the
@@ -265,6 +281,7 @@ class Runtime:
                 fsync=window_store_fsync,
                 wal_injector=self.chaos_injectors.get("wal"),
                 checkpoint_min_seconds=window_store_checkpoint_seconds,
+                exporter=self.exporter,
             )
             self.delta_source.store = self.window_store
             self._recovery_stats = self.window_store.recover(
@@ -321,6 +338,10 @@ class Runtime:
         # sole-member ring owns every shard, so a single-replica
         # deployment behaves exactly as before.
         self.replica_id = replica_id or f"{socket.gethostname()}-{os.getpid()}"
+        # trace resource identity: every finished root (and every OTLP
+        # export) names the replica it happened on — a cross-replica
+        # push trace must name both ends
+        tracing_mod.tracer.resource = {"replica": self.replica_id}
         self.shard = None
         if sharding is None:
             sharding = True
@@ -399,7 +420,24 @@ class Runtime:
                 buffer_samples=ingest_buffer_samples,
                 forward=ingest_forward,
                 window_store=self.window_store,
+                # push-to-verdict tracing: accepts open waterfall records
+                # (with the push's W3C context) the engine closes at fold;
+                # receive spans + ring forwards name this replica
+                waterfall=self.analyzer.waterfall,
+                replica=self.replica_id,
             )
+        # -- OTLP trace export (TRACE_EXPORT_URL; dataplane/exporter.py
+        # OtlpTraceExporter): finished sampled traces POST to the
+        # collector in the background; empty URL = /debug/traces only --
+        self.trace_exporter = None
+        if trace_export_url:
+            from .dataplane.exporter import OtlpTraceExporter
+
+            self.trace_exporter = OtlpTraceExporter(
+                trace_export_url, exporter=self.exporter,
+                resource={"replica": self.replica_id})
+            tracing_mod.tracer.add_sink(self.trace_exporter.sink)
+            self.trace_exporter.start()
         # event-driven scheduler (engine/scheduler.py StreamScheduler):
         # constructed in start() where cadence + worker name are known
         self.scheduler = None
@@ -409,6 +447,7 @@ class Runtime:
             delta_source=self.delta_source, cache_source=self.cache_source,
             shard=self.shard, ingest=self.ingest,
             window_store=self.window_store,
+            trace_exporter=self.trace_exporter,
         )
         self.service.chaos_active = bool(self.chaos_injectors)
         self.wavefront_sink = wavefront_sink
@@ -728,6 +767,13 @@ class Runtime:
         # final window-store checkpoint: the next boot recovers every
         # window this process ever cached, not just the last sweep's
         self._store_checkpoint(force=True)
+        if self.trace_exporter is not None:
+            # flush queued traces to the collector before exit (a
+            # SIGTERM mid-incident must not drop the incident's traces)
+            from .utils import tracing as tracing_mod
+
+            tracing_mod.tracer.remove_sink(self.trace_exporter.sink)
+            self.trace_exporter.stop(flush=True)
         # incident flight recorder: a SIGTERM mid-incident must leave a
         # self-contained artifact (events + traces + provenance + knobs)
         # even when nobody was watching the pod. Best-effort by design.
@@ -834,6 +880,8 @@ def main():
         window_store_fsync=knobs.read("WINDOW_STORE_FSYNC"),
         window_store_checkpoint_seconds=knobs.read(
             "WINDOW_STORE_CHECKPOINT_S"),
+        trace_sample=knobs.read("TRACE_SAMPLE"),
+        trace_export_url=knobs.read("TRACE_EXPORT_URL"),
     )
     proxy = knobs.read("WAVEFRONT_PROXY")
     if proxy:

@@ -295,7 +295,8 @@ class ForemastService:
     def __init__(self, store: JobStore, exporter: VerdictExporter | None = None,
                  query_endpoint: str = "", analyzer=None, resilience=None,
                  delta_source=None, cache_source=None, shard=None,
-                 ingest=None, scheduler=None, window_store=None):
+                 ingest=None, scheduler=None, window_store=None,
+                 trace_exporter=None):
         self.store = store
         self.exporter = exporter or VerdictExporter()
         self.query_endpoint = query_endpoint  # metric-store base for the proxy
@@ -325,6 +326,9 @@ class ForemastService:
         # /status gets segment/WAL/recovery stats, /metrics the
         # window_store gauges (docs/operations.md "Surviving a restart")
         self.window_store = window_store
+        # optional OTLP trace exporter (dataplane/exporter.py
+        # OtlpTraceExporter): /status gets a trace_export section
+        self.trace_exporter = trace_exporter
         self.chaos_active = False  # stamped by the runtime when chaos is on
         # set by make_server: () -> the HTTP admission gate's shed counter
         self.http_shed_count = None
@@ -720,6 +724,17 @@ class ForemastService:
             # attainment vs target, and error-budget burn (engine/slo.py;
             # docs/operations.md "Watching the whole fleet")
             out["slo"] = slo.snapshot()
+        waterfall = getattr(self.analyzer, "waterfall", None)
+        if waterfall is not None:
+            wf = waterfall.snapshot()
+            if wf.get("observed"):
+                # detection-latency waterfall: where each verdict's
+                # latency went, stage by stage (docs/operations.md
+                # "Following one push to its verdict")
+                out["waterfall"] = wf
+        if self.trace_exporter is not None:
+            # OTLP trace export health: queued/exported/failed batches
+            out["trace_export"] = self.trace_exporter.snapshot()
         if self.delta_source is not None:
             # steady-state incremental fetch health: hit ratio, bytes not
             # re-downloaded, and why any full refetches happened
@@ -793,9 +808,16 @@ class ForemastService:
         code = 200 if state in ("ok", "degraded") else 503
         return code, {"state": state, "detail": detail}
 
-    def debug_traces(self, limit: int = 50):
+    def debug_traces(self, limit: int = 50, trace_id: str = ""):
+        """GET /debug/traces[?trace_id=] — the tracer's finished-trace
+        ring (and per-span stats). `trace_id=` narrows to one
+        distributed trace's local span trees — the fetch `foremast-tpu
+        trace <job>` runs after resolving the id via explain."""
         from ..utils.tracing import tracer
 
+        if trace_id:
+            return 200, {"trace_id": trace_id,
+                         "traces": tracer.snapshot(limit, trace_id)}
         return 200, {"traces": tracer.snapshot(limit), "stats": tracer.stats()}
 
     def explain(self, job_id: str):
@@ -959,7 +981,11 @@ class ForemastService:
         if self.ingest is None:
             return 503, {"error": "push ingestion disabled (INGEST=0)",
                          "reason": "ingest_disabled"}
-        from ..ingest import FORWARDED_HEADER
+        from ..ingest import (
+            FORWARDED_HEADER,
+            ORIGIN_REPLICA_HEADER,
+            ORIGIN_TS_HEADER,
+        )
 
         transport = self._INGEST_TRANSPORTS[path]
         return self.ingest.handle(
@@ -967,6 +993,12 @@ class ForemastService:
             content_type=headers.get("Content-Type", ""),
             content_encoding=headers.get("Content-Encoding", ""),
             forwarded=bool(headers.get(FORWARDED_HEADER)),
+            # W3C context propagation: the sender's trace continues
+            # through this replica's receive/splice/score spans; the
+            # origin stamps keep the detection clock across ring hops
+            traceparent=headers.get("traceparent", "") or "",
+            origin_ts=headers.get(ORIGIN_TS_HEADER),
+            origin_replica=headers.get(ORIGIN_REPLICA_HEADER, "") or "",
         )
 
     def dashboard(self):
@@ -1041,7 +1073,8 @@ def make_server(service: ForemastService, host: str = "0.0.0.0",
                         limit = int(q.get("limit", ["50"])[0])
                     except ValueError:
                         limit = 50
-                    self._send(*service.debug_traces(limit))
+                    self._send(*service.debug_traces(
+                        limit, q.get("trace_id", [""])[0]))
                 elif parsed.path == "/debug/flight":
                     q = parse_qs(parsed.query)
                     try:

@@ -246,6 +246,17 @@ register("WINDOW_STORE_CHECKPOINT_S", 5.0, float,
          "rotation + dirty-entry spill); the sweep and partial cycles "
          "both try, this floors the disk churn")
 
+# -- distributed tracing (utils/tracing.py; runtime.py) --
+register("TRACE_SAMPLE", 1.0, float,
+         "head-sampling probability for freshly minted root traces "
+         "(0..1); adopted `traceparent` headers keep the sender's "
+         "sampled flag. Unsampled spans are measured (stats) but never "
+         "ringed at /debug/traces or exported")
+register("TRACE_EXPORT_URL", "", str,
+         "OTLP/HTTP collector endpoint (e.g. http://otel:4318/v1/traces) "
+         "finished traces are POSTed to as OTLP JSON; empty disables "
+         "export — /debug/traces and `foremast-tpu trace` still work")
+
 # -- multi-host world (parallel/distributed.py) --
 register("COORDINATOR_ADDRESS", "", str,
          "jax.distributed coordinator (multi-host deploys)")

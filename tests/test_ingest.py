@@ -1186,6 +1186,255 @@ def test_receiver_wals_only_batches_that_spliced(tmp_path):
     assert status == 200 and ws.wal_appends == 1
 
 
+# --------------------------------------- traceparent propagation (ISSUE 14)
+def test_fuzz_hostile_traceparent_headers():
+    """Malformed/hostile `traceparent` headers (bad version, short ids,
+    non-hex, all-zero, oversized, binary junk): ALWAYS a typed outcome —
+    the push is processed under a fresh root trace with a
+    `bad_traceparent` rejection counted — never a 5xx out of the
+    receiver, never a poisoned staging buffer (the body-fuzz contract
+    from PR 13, applied to the header)."""
+    rng = np.random.default_rng(20260814)
+    rec, clock = _fuzz_receiver()
+    tid, sid = "a" * 32, "b" * 16
+    hostile = [
+        "00",
+        f"ff-{tid}-{sid}-01",
+        f"00-{'0' * 32}-{sid}-01",
+        f"00-{tid}-{'0' * 16}-01",
+        f"00-{tid[:-1]}-{sid}-01",
+        f"00-{tid}-{sid[:-1]}-01",
+        f"00-{tid.upper()}-{sid}-01",
+        f"00-{tid}-{sid}-zz",
+        f"00-{tid}-{sid}-01-junk",
+        "00-" + "g" * 32 + "-" + sid + "-01",
+        "x" * 8192,
+        "00-\x00\x01\x02-\x03-\x04",
+        "traceparent: 00-aa-bb-01",
+        "00 " + tid + " " + sid + " 01",
+    ]
+    valid_header = f"00-{tid}-{sid}-01"
+    for _ in range(100):
+        body = bytearray(valid_header.encode())
+        for _ in range(rng.integers(1, 4)):
+            body[rng.integers(0, len(body))] = rng.integers(0, 256)
+        hostile.append(bytes(body[:rng.integers(1, len(body) + 1)])
+                       .decode("latin-1"))
+    k = 100
+    for i, header in enumerate(hostile):
+        if ingest_wire.snappy_available():
+            pass  # keep the push bodies valid: the HEADER is under test
+        k += 1
+        batch = ({"foremast_job": "j0", "foremast_metric": "latency"},
+                 [(float(T0 + k * STEP), 1.0)])
+        status, payload = rec.handle(
+            "remote_write", snappy_compress(encode_remote_write([batch])),
+            content_type="application/x-protobuf",
+            content_encoding="snappy", now=float(T0 + (k + 1) * STEP),
+            traceparent=header)
+        assert status == 200, (i, status, payload)
+        # the push itself was accepted under a FRESH root trace
+        assert payload["accepted_samples"] == 1, (i, payload)
+        assert payload["rejected"].get("bad_traceparent") == 1, (i, payload)
+        assert len(payload["trace_id"]) == 32
+        assert payload["trace_id"] != tid
+    assert rec.rejected_total["bad_traceparent"] == len(hostile)
+    _assert_clean_push_still_works(rec, float(T0 + 400 * STEP), 301)
+
+
+def test_valid_traceparent_adopted_and_answered():
+    """A valid header continues the SENDER's trace: the receive span
+    parents under it, the response names the trace, and /debug/traces
+    can fetch it by id."""
+    from foremast_tpu.utils import tracing
+
+    rec, clock = _fuzz_receiver()
+    tid = "c" * 32
+    batch = ({"foremast_job": "j0", "foremast_metric": "latency"},
+             [(float(T0 + 40 * STEP), 2.0)])
+    status, payload = rec.handle(
+        "remote_write", snappy_compress(encode_remote_write([batch])),
+        content_type="application/x-protobuf", content_encoding="snappy",
+        now=float(T0 + 41 * STEP), traceparent=f"00-{tid}-{'d' * 16}-01")
+    assert status == 200
+    assert payload["trace_id"] == tid
+    assert "bad_traceparent" not in payload["rejected"]
+    trees = tracing.tracer.snapshot(trace_id=tid)
+    recv = [t for t in trees if t["name"] == "ingest.receive"]
+    assert recv and recv[-1]["parent_span_id"] == "d" * 16
+    # splice span nested under the receive span, same trace
+    children = {c["name"] for c in recv[-1].get("children", ())}
+    assert "ingest.splice" in children
+
+
+def test_forward_reinjects_context_and_origin_stamp():
+    """One-hop forward: the forwarded request carries a `traceparent`
+    naming the origin's FORWARD span (the hop is a child on the origin's
+    trace; the target parents under it), the origin's first-contact
+    timestamp, and the origin replica's name — so detection latency is
+    measured from first contact and the target's spans name both
+    replicas."""
+    import http.server
+
+    from foremast_tpu.ingest import (
+        FORWARDED_HEADER,
+        ORIGIN_REPLICA_HEADER,
+        ORIGIN_TS_HEADER,
+    )
+    from foremast_tpu.utils import tracing
+
+    seen = {}
+
+    class _Capture(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            seen["headers"] = dict(self.headers)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Capture)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        _, _, _, _, rec, clock = _mk_world()
+        rec.replica = "origin-A"
+        rec.shard = _FakeShard(
+            owns=False,
+            addr=f"http://127.0.0.1:{server.server_address[1]}")
+        tnew = float(T0 + 40 * STEP)
+        sender = "00-" + "e" * 32 + "-" + "f" * 16 + "-01"
+        status, payload = _push(
+            rec, [({"foremast_job": "j0"}, [(tnew, 1.0)])],
+            now=tnew + 0.25)
+        # re-send with an upstream trace to pin adoption across the hop
+        status, payload = rec.handle(
+            "remote_write",
+            snappy_compress(encode_remote_write(
+                [({"foremast_job": "j0"}, [(tnew + STEP, 1.0)])])),
+            content_type="application/x-protobuf",
+            content_encoding="snappy", now=tnew + STEP + 0.25,
+            traceparent=sender)
+        assert status == 200
+        assert payload["forwarded_samples"] == 1
+        headers = {k.lower(): v for k, v in seen["headers"].items()}
+        assert headers[FORWARDED_HEADER.lower()] == "1"
+        assert float(headers[ORIGIN_TS_HEADER.lower()]) == \
+            pytest.approx(tnew + STEP + 0.25)
+        assert headers[ORIGIN_REPLICA_HEADER.lower()] == "origin-A"
+        fwd_tp = tracing.parse_traceparent(headers["traceparent"])
+        assert fwd_tp is not None and fwd_tp.trace_id == "e" * 32
+        # the injected parent is the origin's ingest.forward span
+        trees = tracing.tracer.snapshot(trace_id="e" * 32)
+        recv = [t for t in trees if t["name"] == "ingest.receive"][-1]
+        fwd = [c for c in recv.get("children", ())
+               if c["name"] == "ingest.forward"]
+        assert fwd and fwd[0]["span_id"] == fwd_tp.span_id
+    finally:
+        server.shutdown()
+
+
+def test_forwarded_push_measures_from_origin_receipt():
+    """Satellite fix: the forward target's waterfall starts at the
+    ORIGIN's first contact — the hop shows as a forward_hop stage, and
+    the origin timestamp is kept through the book (not reset to the
+    target's receipt)."""
+    from foremast_tpu.engine import slo as slo_mod
+
+    _, _, _, an, rec, clock = _mk_world()
+    rec.waterfall = an.waterfall
+    tnew = float(T0 + 40 * STEP)
+    origin_ts = tnew + 0.2
+    target_now = tnew + 1.7
+    status, payload = rec.handle(
+        "remote_write",
+        snappy_compress(encode_remote_write(
+            [({"foremast_job": "j0", "foremast_metric": "latency"},
+              [(tnew, 3.0)])])),
+        content_type="application/x-protobuf", content_encoding="snappy",
+        now=target_now, forwarded=True, origin_ts=f"{origin_ts:.6f}",
+        origin_replica="origin-A")
+    assert status == 200 and payload["accepted_samples"] == 1
+    rec_book = an.waterfall._inflight["j0"]
+    assert rec_book["origin"] == pytest.approx(origin_ts)
+    stages = rec_book["stages"]
+    assert stages[slo_mod.STAGE_FORWARD_HOP] == \
+        pytest.approx(target_now - origin_ts)
+    # ingest_receive covers sample-ts -> ORIGIN receipt (+ proc time),
+    # not the reset-to-target wait
+    assert stages[slo_mod.STAGE_INGEST_RECEIVE] >= origin_ts - tnew - 1e-6
+    assert stages[slo_mod.STAGE_INGEST_RECEIVE] < 1.0
+
+
+def test_multi_series_batch_stamps_request_stages_once():
+    """A batch fanning k advancing series into one job records the
+    PER-REQUEST stages (receive lag, forward hop) once — not k times
+    (forward_hop is a request quantity; handle time re-counted per
+    series would grow O(k^2)). Per-series splice work still
+    accumulates."""
+    from foremast_tpu.engine import slo as slo_mod
+
+    _, _, _, an, rec, clock = _mk_world()
+    rec.waterfall = an.waterfall
+    tnew = float(T0 + 40 * STEP)
+    origin_ts = tnew + 0.2
+    target_now = tnew + 1.7
+    series = [
+        ({"foremast_job": "j0", "foremast_metric": "latency"},
+         [(tnew, 3.0)]),
+        ({"foremast_job": "j0", "foremast_metric": "latency"},
+         [(tnew + STEP, 3.1)]),  # advances the watermark again
+        ({"foremast_job": "j0", "foremast_metric": "latency"},
+         [(tnew + 2 * STEP, 3.2)]),
+    ]
+    status, payload = rec.handle(
+        "remote_write", snappy_compress(encode_remote_write(series)),
+        content_type="application/x-protobuf", content_encoding="snappy",
+        now=target_now, forwarded=True, origin_ts=f"{origin_ts:.6f}",
+        origin_replica="origin-A")
+    assert status == 200 and payload["accepted_samples"] == 3
+    stages = an.waterfall._inflight["j0"]["stages"]
+    # exactly ONE hop's latency, not three
+    assert stages[slo_mod.STAGE_FORWARD_HOP] == \
+        pytest.approx(target_now - origin_ts)
+    # receive = one (lag + proc) stamp, bounded well under 2x
+    assert stages[slo_mod.STAGE_INGEST_RECEIVE] < \
+        2 * (origin_ts - tnew)
+
+
+def test_hostile_origin_ts_never_poisons_the_histograms():
+    """An origin stamp older than the sanity window (garbage header /
+    badly skewed peer clock) is IGNORED: first contact falls back to the
+    local receipt and no ~1e9 s forward_hop sample ever lands in the
+    stage histograms."""
+    from foremast_tpu.engine import slo as slo_mod
+
+    _, _, _, an, rec, clock = _mk_world()
+    rec.waterfall = an.waterfall
+    tnew = float(T0 + 40 * STEP)
+    target_now = tnew + 1.0
+    status, payload = rec.handle(
+        "remote_write",
+        snappy_compress(encode_remote_write(
+            [({"foremast_job": "j0", "foremast_metric": "latency"},
+              [(tnew, 3.0)])])),
+        content_type="application/x-protobuf", content_encoding="snappy",
+        now=target_now, forwarded=True, origin_ts="1",
+        origin_replica="evil")
+    assert status == 200 and payload["accepted_samples"] == 1
+    book = an.waterfall._inflight["j0"]
+    assert book["origin"] == pytest.approx(target_now)
+    assert slo_mod.STAGE_FORWARD_HOP not in book["stages"]
+    # receive = (local now - sample ts) + proc — NOT now - 1970
+    assert book["stages"][slo_mod.STAGE_INGEST_RECEIVE] == pytest.approx(
+        target_now - tnew, abs=0.2)
+
+
 def test_below_span_duplicate_is_not_late():
     """A retried sample whose timestamp sits BELOW the cached window's
     retained span is indistinguishable from a clipped-out duplicate —

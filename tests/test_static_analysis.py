@@ -424,7 +424,8 @@ def _trace_registry_with_registries():
     checker = TraceNameRegistry()
     for rel in ("foremast_tpu/utils/tracing.py",
                 "foremast_tpu/engine/flightrec.py",
-                "foremast_tpu/engine/provenance.py"):
+                "foremast_tpu/engine/provenance.py",
+                "foremast_tpu/engine/slo.py"):
         checker.check(load_module(os.path.join(REPO_ROOT, rel), rel))
     return checker
 
@@ -522,12 +523,42 @@ def test_trace_registry_span_constants_match_runtime_sets():
     constants so the two views cannot drift."""
     from foremast_tpu.engine import flightrec
     from foremast_tpu.engine import provenance
+    from foremast_tpu.engine import slo
     from foremast_tpu.utils import tracing
 
     checker = _trace_registry_with_registries()
     assert set(tracing.SPAN_NAMES) <= checker._spans
     assert set(flightrec.EVENT_TYPES) <= checker._events
     assert set(provenance.PATHS) <= checker._paths
+    assert set(slo.STAGES) <= checker._stages
+
+
+def test_trace_registry_flags_unregistered_waterfall_stage():
+    """DetectionWaterfall.add_stage() names are registered constants
+    (engine/slo.py STAGE_ORDER) like span names — a typo'd stage string
+    would otherwise mint a phantom histogram label the runbook cannot
+    enumerate."""
+    checker = _trace_registry_with_registries()
+    mod = ModuleInfo("<fixture>", "foremast_tpu/ingest/fixture.py",
+                     textwrap.dedent("""
+        def f(wf, jid):
+            wf.add_stage(jid, "splcie", 0.01)
+    """))
+    run = run_lint([checker], [mod], Baseline())
+    assert any("'splcie' is not registered" in f.message
+               for f in run.findings)
+    # registered literals and constant refs stay quiet
+    checker2 = _trace_registry_with_registries()
+    ok = ModuleInfo("<fixture>", "foremast_tpu/ingest/fixture.py",
+                    textwrap.dedent("""
+        from foremast_tpu.engine import slo as slo_mod
+
+        def f(wf, jid):
+            wf.add_stage(jid, slo_mod.STAGE_SPLICE, 0.01)
+            wf.add_stage(jid, "splice", 0.01)
+    """))
+    run2 = run_lint([checker2], [ok], Baseline())
+    assert not run2.findings, [f.render() for f in run2.findings]
 
 
 def test_inline_and_file_wide_suppressions():

@@ -1,19 +1,33 @@
-"""Streaming-ingest soak (`make soak-stream`, ISSUE 12): push + poll
-interleaved against a LIVE runtime under chaos latency and a hard
-blackout. The claim under test: a job whose samples arrive as pushes
-keeps scoring through the blackout — its windows come from the push-fed
-delta cache, zero backend round-trips — while poll-only jobs ride the
-degraded-mode machinery (stale serving) and the health state machine
-walks DEGRADED -> OK end to end over the wire. Flight-dump artifacts are
-written by the runtime's own recorder on failure (CI uploads them).
+"""Streaming-ingest soaks (`make soak-stream`, ISSUEs 12 + 14):
+
+  * push + poll interleaved against a LIVE runtime under chaos latency
+    and a hard blackout — pushed jobs keep stream-scoring while polled
+    jobs ride the degraded-mode machinery, DEGRADED -> OK over the wire;
+  * the two-replica distributed-trace acceptance (ISSUE 14): a push
+    sent to the NON-owner replica produces ONE trace whose spans name
+    both replicas — the forward hop a child on the origin's trace, the
+    scoring replica's receive/verdict spans parented under it — with
+    `explain` on the scoring replica carrying the same trace_id.
+
+Flight-dump artifacts are written by the runtime's own recorder on
+failure; these soaks additionally dump each replica's /debug/traces ring
+and detection-stage histogram lines to /tmp/foremast-traces-*.json (the
+CI soak job uploads both families).
 
 Marked slow+chaos so tier-1 (-m 'not slow') stays fast.
 """
 import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
 import threading
 import time
 import urllib.error
 import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 import pytest
@@ -52,10 +66,40 @@ def _get(url, timeout=5.0):
 def _wait_for(predicate, budget_s, interval=0.1):
     deadline = time.monotonic() + budget_s
     while time.monotonic() < deadline:
-        if predicate():
-            return True
+        try:
+            if predicate():
+                return True
+        except Exception:  # noqa: BLE001 - booting replicas refuse/404
+            pass
         time.sleep(interval)
     return False
+
+
+def _dump_trace_artifacts(name: str, bases):
+    """On soak failure: persist each replica's /debug/traces ring and
+    its detection-latency/stage histogram lines next to the flight
+    dumps, so the CI soak job uploads the trace evidence an operator
+    would want for the incident (satellite: ISSUE 14)."""
+    out = {}
+    for base in bases:
+        entry = {}
+        try:
+            code, traces = _get(f"{base}/debug/traces?limit=100")
+            entry["traces"] = json.loads(traces) if code == 200 else None
+            code, metrics = _get(f"{base}/metrics")
+            entry["stage_histograms"] = [
+                ln for ln in metrics.decode().splitlines()
+                if "detection_stage_seconds" in ln
+                or "detection_latency_seconds" in ln
+            ] if code == 200 else []
+        except Exception as e:  # noqa: BLE001 - dead replica: note it
+            entry["error"] = repr(e)
+        out[base] = entry
+    try:
+        with open(f"/tmp/foremast-traces-{name}.json", "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
 
 
 def test_stream_soak_push_scores_through_blackout(tmp_path):
@@ -131,9 +175,11 @@ def test_stream_soak_push_scores_through_blackout(tmp_path):
     rt.start(host="127.0.0.1", port=0, cycle_seconds=0.3)
     pusher_stop = threading.Event()
     push_errors: list = []
+    bases: list = []
     try:
         port = rt._server.server_address[1]
         base = f"http://127.0.0.1:{port}"
+        bases.append(base)
 
         def readyz_state():
             _, payload = _get(f"{base}/readyz")
@@ -207,8 +253,298 @@ def test_stream_soak_push_scores_through_blackout(tmp_path):
         body = metrics.decode()
         assert "foremastbrain:ingest_samples_total" in body
         assert "foremastbrain:partial_cycles_total" in body
+    except BaseException:
+        _dump_trace_artifacts("stream-blackout", bases)
+        raise
     finally:
         pusher_stop.set()
         rt.stop()
     # graceful stop released the leases for peer adoption
     assert rt.store.lease_releases_total >= 0
+
+# ===================================================================
+# Two-replica push-to-verdict trace (ISSUE 14 acceptance): REAL runtime
+# subprocesses over one shared archive, so each replica has its own
+# tracer ring, its own /debug/traces, and its own resource identity.
+# ===================================================================
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _TraceBackend:
+    """Threaded HTTP Prometheus stand-in shared by both replicas;
+    serves /<job>/<cur|hist>?start=&end= from mutable series."""
+
+    def __init__(self):
+        self.series: dict[str, list] = {}
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 - stdlib API
+                pass
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                parts = self.path.split("?", 1)[0].strip("/").split("/")
+                name = "/".join(parts[-2:])
+                rng = parse_range_params(self.path)
+                with outer.lock:
+                    samples = [
+                        (t, v) for t, v in outer.series.get(name, [])
+                        if rng is None or rng[0] <= t <= rng[1]]
+                body = json.dumps({
+                    "status": "success",
+                    "data": {"resultType": "matrix", "result": [
+                        {"metric": {"__name__": "m"},
+                         "values": [[t, str(v)] for t, v in samples]}]},
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+
+
+_TRACE_CHILD = textwrap.dedent("""
+    import signal, sys
+    from foremast_tpu.engine import EngineConfig
+    from foremast_tpu.engine.archive import FileArchive
+    from foremast_tpu.runtime import Runtime
+
+    replica, port, archive_path = (
+        sys.argv[1], int(sys.argv[2]), sys.argv[3])
+    rt = Runtime(
+        config=EngineConfig(
+            fetch_concurrency=2, max_stuck_seconds=1e9,
+            retry_max_attempts=2, retry_base_delay=0.01,
+            retry_max_delay=0.05, fetch_cycle_deadline_seconds=4.0),
+        archive=FileArchive(archive_path),
+        replica_id=replica,
+        heartbeat_seconds=0.5,
+        member_ttl_seconds=3.0,
+        adopt_interval_seconds=1.0,
+        ingest_advertise_addr=f"http://127.0.0.1:{port}",
+        ingest_debounce_ms=20.0,
+    )
+    signal.signal(signal.SIGTERM, lambda *_: rt.request_stop())
+    rt.run_forever(host="127.0.0.1", port=port, cycle_seconds=0.4)
+""")
+
+
+def _spawn_replica(tmp_path, replica, port, archive_path):
+    script = tmp_path / "trace_replica.py"
+    if not script.exists():
+        script.write_text(_TRACE_CHILD)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FLIGHT_DUMP_DIR=str(tmp_path / "dumps"),
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (repo_root, os.environ.get("PYTHONPATH"))
+                   if p))
+    return subprocess.Popen(
+        [sys.executable, str(script), replica, str(port), archive_path],
+        env=env, stdout=open(tmp_path / f"{replica}.log", "ab"),
+        stderr=subprocess.STDOUT)
+
+
+def _post_json(url, body, timeout=5.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_two_replica_push_to_verdict_single_trace(tmp_path):
+    """A push sent to the NON-owner replica produces ONE trace: the
+    origin's receive span with the forward hop as its child, the
+    scoring replica's receive span remote-parented under that hop
+    (naming the origin replica), a verdict span closing the trace at
+    fold with a waterfall carrying forward_hop — and `explain` on the
+    scoring replica reports the same trace_id the push response
+    returned."""
+    be = _TraceBackend()
+    now0 = int(time.time()) // STEP * STEP
+    t0 = now0 - 60 * STEP
+    n_jobs = 10
+    for i in range(n_jobs):
+        be.series[f"j{i}/cur"] = [
+            (t0 + k * STEP, round(5.0 + 0.01 * k, 4)) for k in range(60)]
+        be.series[f"j{i}/hist"] = [
+            (t0 - 500 * STEP + k * STEP, round(5.0 + 0.01 * (k % 60), 4))
+            for k in range(560)]
+
+    def url(name, s, e):
+        return (f"http://127.0.0.1:{be.port}/{name}"
+                f"?query=x&start={s:.0f}&end={e:.0f}&step={STEP}")
+
+    def create_body(i):
+        return {
+            "appName": f"app-{i}", "namespace": "soak",
+            "strategy": "canary",
+            "startTime": to_rfc3339(t0),
+            "endTime": to_rfc3339(now0 + 86400),
+            "metricsInfo": {
+                "current": {"error5xx": {
+                    "url": url(f"j{i}/cur", t0, now0 + 86400)}},
+                "historical": {"error5xx": {
+                    "url": url(f"j{i}/hist", t0 - 500 * STEP, t0)}},
+            },
+        }
+
+    archive_path = str(tmp_path / "archive.jsonl")
+    pa, pb = _free_port(), _free_port()
+    base_a, base_b = f"http://127.0.0.1:{pa}", f"http://127.0.0.1:{pb}"
+    proc_a = _spawn_replica(tmp_path, "rep-a", pa, archive_path)
+    proc_b = _spawn_replica(tmp_path, "rep-b", pb, archive_path)
+    k_push = [0]
+
+    def explain(base, jid):
+        code, payload = _get(f"{base}/jobs/{jid}/explain")
+        if code != 200:
+            return {}
+        return json.loads(payload).get("provenance") or {}
+
+    def push_to_a(jid, i):
+        """One fresh on-grid sample for job `jid`, addressed, pushed to
+        replica A (backend updated first — it stays source of truth)."""
+        k_push[0] += 1
+        ts = float(now0 + k_push[0] * STEP)
+        v = round(5.0 + 0.01 * k_push[0], 4)
+        with be.lock:
+            be.series[f"j{i}/cur"].append((ts, v))
+        raw = snappy_compress(encode_remote_write([(
+            {"foremast_job": jid, "foremast_metric": "error5xx"},
+            [(ts, v)])]))
+        req = urllib.request.Request(
+            f"{base_a}/ingest/remote-write", data=raw,
+            headers={"Content-Type": "application/x-protobuf",
+                     "Content-Encoding": "snappy"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return json.loads(r.read())
+
+    try:
+        # both replicas up + mutual membership (2 fresh rows on /fleet)
+        def fleet_fresh():
+            _, payload = _get(f"{base_a}/fleet")
+            doc = json.loads(payload)
+            return doc.get("aggregate", {}).get("replicas_fresh") == 2
+
+        assert _wait_for(fleet_fresh, 60.0), "membership never converged"
+
+        job_ids = {}
+        for i in range(n_jobs):
+            _, resp = _post_json(f"{base_a}/v1/healthcheck/create",
+                                 create_body(i))
+            job_ids[i] = resp["jobId"]
+
+        # wait until some job is owned AND scored by B (live provenance
+        # record whose cycle worker is rep-b)
+        def b_owned_job():
+            for i, jid in job_ids.items():
+                rec = explain(base_b, jid)
+                worker = (rec.get("cycle") or {}).get("worker", "")
+                if rec.get("path") and worker == "rep-b":
+                    return (i, jid)
+            return None
+
+        candidate = _wait_for(lambda: b_owned_job(), 90.0)
+        assert candidate, "no job landed on replica B"
+        i, jid = b_owned_job()
+
+        # push to the NON-owner (A). A may have pruned its handed-off
+        # copy — re-creating the job (deterministic id) restores the
+        # routing metadata without changing ownership, then the push
+        # forwards one hop to B.
+        trace_id = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and trace_id is None:
+            _post_json(f"{base_a}/v1/healthcheck/create", create_body(i))
+            payload = push_to_a(jid, i)
+            if payload.get("forwarded_samples", 0) >= 1:
+                trace_id = payload["trace_id"]
+                break
+            time.sleep(0.5)
+        assert trace_id, "push to the non-owner never forwarded"
+
+        # ONE trace on the SCORING replica: receive span remote-parented
+        # under the origin's forward hop, naming rep-a
+        def b_trace():
+            _, payload = _get(
+                f"{base_b}/debug/traces?trace_id={trace_id}&limit=100")
+            trees = json.loads(payload).get("traces", [])
+            recv = [t for t in trees if t["name"] == "ingest.receive"]
+            return recv or None
+
+        assert _wait_for(lambda: bool(b_trace()), 30.0), \
+            "forwarded push never traced on B"
+        b_recv = b_trace()[-1]
+        assert b_recv["trace_id"] == trace_id
+        assert b_recv["attrs"]["origin_replica"] == "rep-a"
+        assert b_recv["attrs"]["replica"] == "rep-b"
+        assert (b_recv.get("resource") or {}).get("replica") == "rep-b"
+        assert b_recv.get("parent_span_id"), "receive span not parented"
+
+        # ... whose parent is the FORWARD hop on the origin's trace
+        _, payload = _get(
+            f"{base_a}/debug/traces?trace_id={trace_id}&limit=100")
+        a_trees = json.loads(payload)["traces"]
+        a_recv = [t for t in a_trees if t["name"] == "ingest.receive"][-1]
+        assert (a_recv.get("resource") or {}).get("replica") == "rep-a"
+        fwd = [c for c in a_recv.get("children", ())
+               if c["name"] == "ingest.forward"]
+        assert fwd, "origin trace has no forward hop"
+        assert b_recv["parent_span_id"] == fwd[0]["span_id"]
+
+        # the verdict closes the SAME trace on B, waterfall included
+        def b_verdict():
+            _, payload = _get(
+                f"{base_b}/debug/traces?trace_id={trace_id}&limit=100")
+            trees = json.loads(payload).get("traces", [])
+            return [t for t in trees
+                    if t["name"] == "engine.verdict"] or None
+
+        assert _wait_for(lambda: bool(b_verdict()), 45.0), \
+            "verdict span never closed the trace on B"
+        verdict = b_verdict()[-1]
+        assert verdict["attrs"]["job_id"] == jid
+        wf = verdict["attrs"]["waterfall"]
+        assert "forward_hop" in wf and "score" in wf, wf
+
+        # explain on the scoring replica carries the same trace_id
+        def b_explained():
+            rec = explain(base_b, jid)
+            return rec.get("trace_id") == trace_id
+
+        assert _wait_for(b_explained, 30.0), explain(base_b, jid)
+        rec = explain(base_b, jid)
+        assert "forward_hop" in rec.get("detection_stages", {})
+
+        # stage histograms are live on the scoring replica's /metrics
+        _, metrics = _get(f"{base_b}/metrics")
+        assert b"foremastbrain:detection_stage_seconds_bucket" in metrics
+    except BaseException:
+        _dump_trace_artifacts("two-replica", [base_a, base_b])
+        raise
+    finally:
+        for proc in (proc_a, proc_b):
+            try:
+                os.kill(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for proc in (proc_a, proc_b):
+            try:
+                proc.wait(20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        be.close()

@@ -10,7 +10,11 @@ import threading
 import numpy as np
 import pytest
 
-from foremast_tpu.utils.tracing import Tracer
+from foremast_tpu.utils.tracing import (
+    Tracer,
+    W3CContext,
+    parse_traceparent,
+)
 
 
 def test_span_nesting_builds_one_trace_tree():
@@ -234,6 +238,139 @@ def test_notes_accumulate_per_thread_unit_of_work():
     tr.add_note("fetch_seconds", 0.25)
     assert tr.take_notes() == {"fetches": 2, "fetch_seconds": 0.25}
     assert tr.take_notes() == {}  # closed
+
+
+# --------------------------------------------------- W3C trace context
+def test_parse_traceparent_valid_and_flags():
+    tid, sid = "a" * 32, "b" * 16
+    ctx = parse_traceparent(f"00-{tid}-{sid}-01")
+    assert ctx is not None
+    assert (ctx.trace_id, ctx.span_id, ctx.sampled) == (tid, sid, True)
+    assert parse_traceparent(f"00-{tid}-{sid}-00").sampled is False
+    # round trip through the header formatter
+    assert parse_traceparent(ctx.traceparent()).trace_id == tid
+    # future versions may carry extra fields; version 00 may not
+    assert parse_traceparent(f"cc-{tid}-{sid}-01-extra") is not None
+    assert parse_traceparent(f"00-{tid}-{sid}-01-extra") is None
+    # surrounding whitespace tolerated (header transport artifacts)
+    assert parse_traceparent(f"  00-{tid}-{sid}-01 ") is not None
+
+
+@pytest.mark.parametrize("header", [
+    "",                                   # empty
+    "00",                                 # truncated
+    "00-" + "a" * 32,                     # missing span id
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",   # forbidden version
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",   # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+    "00-" + "A" * 32 + "-" + "b" * 16 + "-01",   # uppercase hex
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",   # non-hex
+    "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # short trace id
+    "00-" + "a" * 32 + "-" + "b" * 15 + "-01",   # short span id
+    "0-" + "a" * 32 + "-" + "b" * 16 + "-01",    # short version
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-1",    # short flags
+    "00_" + "a" * 32 + "_" + "b" * 16 + "_01",   # wrong separators
+    "x" * 10_000,                         # oversized
+    None,                                 # not a string at all
+    42,
+])
+def test_parse_traceparent_rejects_malformed(header):
+    assert parse_traceparent(header) is None
+
+
+def test_span_ids_mint_and_inherit():
+    tr = Tracer()
+    with tr.span("cycle") as root:
+        assert len(root.trace_id) == 32 and len(root.span_id) == 16
+        with tr.span("claim") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_span_id == root.span_id
+            assert child.span_id != root.span_id
+    trace = tr.snapshot()[-1]
+    assert trace["trace_id"] == root.trace_id
+    assert trace["children"][0]["parent_span_id"] == root.span_id
+
+
+def test_adopt_remote_continues_the_senders_trace():
+    tr = Tracer()
+    remote = W3CContext("c" * 32, "d" * 16, sampled=True)
+    with tr.adopt_remote(remote):
+        with tr.span("ingest.receive") as sp:
+            assert sp.trace_id == remote.trace_id
+            assert sp.parent_span_id == remote.span_id
+            # header injection for the next hop names THIS span
+            assert tr.current_traceparent() == \
+                f"00-{'c' * 32}-{sp.span_id}-01"
+    # adoption is scoped: outside the block fresh roots mint their own
+    with tr.span("next") as sp2:
+        assert sp2.trace_id != remote.trace_id
+    trace = tr.snapshot(trace_id=remote.trace_id)
+    assert len(trace) == 1 and trace[0]["name"] == "ingest.receive"
+
+
+def test_remote_forced_root_span_inside_open_stack():
+    """`_remote=` closes a distributed trace from INSIDE another open
+    span (the engine's verdict span inside the cycle span): it parents
+    under the remote context, finishes as its own root tree, and never
+    lands as a child of the enclosing local span."""
+    tr = Tracer()
+    remote = W3CContext("e" * 32, "f" * 16)
+    with tr.span("engine.cycle") as cyc:
+        with tr.span("engine.verdict", _remote=remote, job_id="j1") as v:
+            assert v.trace_id == remote.trace_id
+            assert v.parent_span_id == remote.span_id
+    assert not cyc.children  # not attached locally
+    roots = {t["name"]: t for t in tr.snapshot()}
+    assert roots["engine.verdict"]["trace_id"] == remote.trace_id
+    assert roots["engine.cycle"]["trace_id"] == cyc.trace_id
+
+
+def test_unsampled_roots_measured_but_not_ringed_or_exported():
+    tr = Tracer()
+    exported = []
+    tr.add_sink(exported.append)
+    tr.set_sample_rate(0.0)
+    with tr.span("quiet"):
+        pass
+    # an adopted sampled=False context is honored the same way
+    with tr.adopt_remote(W3CContext("a" * 32, "b" * 16, sampled=False)):
+        with tr.span("quiet-remote") as sp:
+            assert sp.sampled is False
+    tr.set_sample_rate(1.0)
+    with tr.span("loud"):
+        pass
+    names = [t["name"] for t in tr.snapshot()]
+    assert names == ["loud"]
+    assert [t["name"] for t in exported] == ["loud"]
+    # stats saw everything — sampling bounds storage, not measurement
+    assert tr.stats()["quiet"]["count"] == 1
+    assert tr.stats()["quiet-remote"]["count"] == 1
+
+
+def test_resource_stamped_on_finished_roots():
+    tr = Tracer()
+    tr.resource = {"replica": "rep-a"}
+    with tr.span("cycle"):
+        pass
+    assert tr.snapshot()[-1]["resource"] == {"replica": "rep-a"}
+
+
+def test_attach_carries_remote_context_across_threads():
+    tr = Tracer()
+    remote = W3CContext("9" * 32, "8" * 16)
+    seen = {}
+    with tr.adopt_remote(remote):
+        ctx = tr.context()
+
+    def work():
+        with tr.attach(ctx):
+            with tr.span("worker-root") as sp:
+                seen["tid"] = sp.trace_id
+
+    t = threading.Thread(target=work)
+    t.start()
+    t.join(5.0)
+    assert seen["tid"] == remote.trace_id
 
 
 def test_log_filter_stamps_trace_ids(caplog):
