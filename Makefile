@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf demo demo-hpa dryrun fuzz chaos soak soak-sharded soak-stream soak-restart clean
+.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf perf-smoke demo demo-hpa dryrun fuzz chaos soak soak-sharded soak-stream soak-restart clean
 
 test: lint       ## full suite (CPU, 8 virtual devices via conftest), gated on lint
 	$(PY) -m pytest tests/ -q
@@ -36,11 +36,16 @@ bench-watch:     ## background tunnel watcher: banks BENCH_LOCAL_r05.json at fir
 prewarm:         ## compile the scoring-program grid into COMPILE_CACHE_PATH (default /tmp/foremast-compile-cache)
 	$(CPU_ENV) COMPILE_CACHE_PATH=$${COMPILE_CACHE_PATH:-/tmp/foremast-compile-cache} $(PY) -m foremast_tpu prewarm
 
-perf:            ## perf regression gates (zero steady-state recompiles, delta hit ratio >= 0.9, zero no-change launches, triage launch cut, streamed-ingest p99 <= 10s at byte-identical verdicts) + steady-state, streamed-ingest and cold-vs-warm-restart legs
-	$(CPU_ENV) $(PY) -m pytest tests/ -m perf -q
+perf:            ## perf regression gates (zero steady-state recompiles, delta hit ratio >= 0.9, zero no-change launches, triage launch cut, streamed-ingest p99 <= 10s, mega-batch identity+win — all at byte-identical verdicts) + steady-state, streamed-ingest, cold-vs-warm-restart, mega-batch and fleet-simulator legs
+	$(CPU_ENV) FOREMAST_PERF_STRICT=1 $(PY) -m pytest tests/ -m perf -q
 	$(CPU_ENV) BENCH_CYCLE_STEADY=1 BENCH_CYCLE_JOBS=$${BENCH_CYCLE_JOBS:-500} BENCH_CYCLE_REPS=$${BENCH_CYCLE_REPS:-8} $(PY) -m foremast_tpu.bench_cycle
 	$(CPU_ENV) BENCH_CYCLE_STREAM=1 BENCH_CYCLE_JOBS=$${BENCH_CYCLE_STREAM_JOBS:-200} $(PY) -m foremast_tpu.bench_cycle
 	$(CPU_ENV) BENCH_CYCLE_RESTART=1 BENCH_CYCLE_JOBS=$${BENCH_CYCLE_RESTART_JOBS:-300} $(PY) -m foremast_tpu.bench_cycle
+	$(CPU_ENV) BENCH_CYCLE_MEGABATCH=1 BENCH_CYCLE_JOBS=$${BENCH_CYCLE_MEGABATCH_JOBS:-5000} $(PY) -m foremast_tpu.bench_cycle
+	$(CPU_ENV) BENCH_CYCLE_SIMFLEET=1 SIM_JOBS=$${SIM_JOBS:-5000} $(PY) -m foremast_tpu.bench_cycle
+
+perf-smoke:      ## bounded per-PR mega-batch gate (CI): mini simfleet A/B identity + launch-count collapse on the launch-heavy shape (wall-clock win gated under FOREMAST_PERF_STRICT=1 in `make perf` — CI runners are too noisy for an 11% margin)
+	$(CPU_ENV) $(PY) -m pytest tests/test_megabatch.py tests/test_simfleet.py -m perf -q
 
 fuzz:            ## extended native-parser fuzz campaign (100k mutations)
 	$(CPU_ENV) $(PY) tests/test_native_fuzz.py --child 100000

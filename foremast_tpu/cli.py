@@ -627,6 +627,35 @@ def cmd_prewarm(args) -> int:
     return 0
 
 
+def cmd_simfleet(args) -> int:
+    """Run the fleet-scale load simulator (foremast_tpu/simfleet).
+
+    Default: the in-process mega-batch A/B (identity + launch collapse
+    + measured speedup). `--leg` runs a single leg honoring --megabatch
+    / --stream. `--live ENDPOINT` instead serves the trace over HTTP,
+    submits the fleet to a RUNNING replica's job API, and (with --push)
+    streams the advancing samples to its /ingest/remote-write
+    (docs/operations.md "Running a simulated fleet").
+    """
+    from .simfleet import driver
+
+    if args.live:
+        out = driver.run_live(args.live, jobs=args.jobs, seed=args.seed,
+                              shape=args.shape, duration_s=args.duration,
+                              push=args.push)
+    elif args.leg:
+        out = driver.run_fleet(args.jobs, args.seed, args.shape,
+                               args.cycles, args.cadence, args.replicas,
+                               megabatch=args.megabatch,
+                               stream=args.stream)
+    else:
+        out = driver.run_fleet_ab(args.jobs, args.seed, args.shape,
+                                  args.cycles, args.cadence,
+                                  args.replicas, rounds=args.rounds)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_demo(args) -> int:
     if args.hpa:
         from .examples.demo_app import run_demo_hpa
@@ -742,6 +771,49 @@ def build_parser() -> argparse.ArgumentParser:
     pw.add_argument("--buckets", default="128,256",
                     help="comma-separated T (window-length) buckets")
     pw.set_defaults(func=cmd_prewarm)
+    sf = sub.add_parser(
+        "simfleet",
+        help="fleet-scale load simulator: in-process mega-batch A/B, "
+             "single legs, or driving a LIVE replica (--live)",
+    )
+    # defaults come from the SIM_* registry so the docs/configuration.md
+    # contract (`SIM_JOBS=100000 foremast-tpu simfleet`) holds for the
+    # CLI exactly as for `python -m foremast_tpu.simfleet`; flags win
+    # over env
+    from .utils import knobs as _knobs
+
+    sf.add_argument("--jobs", type=int, default=_knobs.read("SIM_JOBS"))
+    sf.add_argument("--seed", type=int, default=_knobs.read("SIM_SEED"))
+    sf.add_argument("--shape", default=_knobs.read("SIM_TRACE"),
+                    help="trace preset: steady | diurnal | deploy-wave "
+                         "| incident | churn")
+    sf.add_argument("--cycles", type=int,
+                    default=_knobs.read("SIM_CYCLES"))
+    sf.add_argument("--cadence", type=float,
+                    default=_knobs.read("SIM_CADENCE_S"),
+                    help="sim seconds per cycle (60 = every cycle "
+                         "advances every window)")
+    sf.add_argument("--replicas", type=int,
+                    default=_knobs.read("SIM_REPLICAS"))
+    sf.add_argument("--rounds", type=int,
+                    default=_knobs.read("SIM_ROUNDS"),
+                    help="A/B interleave rounds (SIM_ROUNDS; 1 keeps a "
+                         "100k+ run affordable)")
+    sf.add_argument("--leg", action="store_true",
+                    help="run ONE leg instead of the on/off A/B")
+    sf.add_argument("--megabatch", action="store_true",
+                    help="(with --leg) enable MEGABATCH for the leg")
+    sf.add_argument("--stream", action="store_true",
+                    help="(with --leg) push samples through the ingest "
+                         "receiver instead of poll-only")
+    sf.add_argument("--live", default="",
+                    help="drive a RUNNING replica at this endpoint "
+                         "instead of in-process")
+    sf.add_argument("--push", action="store_true",
+                    help="(with --live) also stream remote-write pushes")
+    sf.add_argument("--duration", type=float, default=60.0,
+                    help="(with --live) seconds to serve/push")
+    sf.set_defaults(func=cmd_simfleet)
     d = sub.add_parser("demo", help="local end-to-end demo, no cluster")
     variant = d.add_mutually_exclusive_group()
     variant.add_argument("--healthy", action="store_true",

@@ -292,6 +292,14 @@ class DeltaWindowSource:
             "warm_spill_drops": self.warm_spill_drops,
         }
 
+    def window_bytes(self) -> int:
+        """Resident bytes held by the hot-tier window cache (values +
+        mask + nan-ts columns), computed under the cache lock."""
+        with self._lock:
+            return sum(
+                e.win.values.nbytes + e.win.mask.nbytes + e.nan_ts.nbytes
+                for e in self._cache.values())
+
     def _series(self, url: str):
         """(ts, vals, nbytes) through the inner source; nbytes 0 when the
         inner has no byte-level seam (plain fixture dicts)."""

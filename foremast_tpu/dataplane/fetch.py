@@ -350,13 +350,22 @@ class RawFixtureDataSource:
     production code without a network."""
 
     def __init__(self, pages: dict | None = None,
-                 resolver: Callable[[str], bytes] | None = None):
+                 resolver: Callable[[str], bytes] | None = None,
+                 keep_urls: bool = True):
         self.pages = {} if pages is None else pages
         self.resolver = resolver
+        # keep_urls=False keeps only the counter: a 100k-job simfleet
+        # cycle issues ~200k fetches, and retaining every URL string
+        # would dominate the resident-memory figure the fleet driver
+        # exists to measure.
+        self.keep_urls = keep_urls
         self.requests: list[str] = []
+        self.request_count = 0
 
     def _raw(self, url: str) -> bytes:
-        self.requests.append(url)
+        self.request_count += 1
+        if self.keep_urls:
+            self.requests.append(url)
         raw = self.pages.get(url)
         if raw is None and self.resolver is not None:
             raw = self.resolver(url)

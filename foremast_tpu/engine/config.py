@@ -153,6 +153,26 @@ class EngineConfig:
     # a sustained sub-band distribution shift the full scorer would
     # convict can clear (docs/performance.md §5); hpa is never screened.
     triage_families: tuple = ("band",)
+    # single-dispatch mega-batching (MEGABATCH; engine/pipeline.py):
+    # instead of firing per-(family, T-bucket) rung launches mid-stream,
+    # each family's accumulator holds the WHOLE cycle's rows and flushes
+    # as one padded launch per (family, T) — the rung ladder becomes
+    # padding classes (mantissa-quantized above 512 rows, <= 1/16 waste;
+    # analyzer._mega_rows), so a family costs ONE program launch per
+    # cycle up to the MEGABATCH_MAX_ROWS ceiling (a 100k-row family
+    # chunks at the ceiling into ~4 launches — vs ~13 rung chunks).
+    # Trades the pipeline's
+    # fetch/score overlap for launch count — the right trade once
+    # dispatch overhead dominates (100k+ fleets; docs/performance.md §6).
+    # Verdicts are byte-identical either way (scorers are row-wise;
+    # pinned by tests/test_megabatch.py). Off by default: small fleets
+    # keep the overlap, and the prewarm grid covers the rung programs.
+    megabatch: bool = False
+    # mega-launch row ceiling at T<=1024 (MEGABATCH_MAX_ROWS; scaled
+    # down ~1/T beyond, floor 1024, for bounded launch memory). Fleets
+    # past the cap chunk at it — still ~8x fewer launches than the rung
+    # path's score_batch chunks.
+    megabatch_max_rows: int = 32768
     # persistent XLA compilation cache directory (COMPILE_CACHE_PATH;
     # empty = disabled). A restarted process reuses compiled programs
     # instead of re-paying the first-cycle compile storm (~26 s per mixed
@@ -428,6 +448,8 @@ def from_env(env=None) -> EngineConfig:
             f.strip() for f in env.get("TRIAGE_FAMILIES", "band").split(",")
             if f.strip()
         ),
+        megabatch=_env_bool(env, "MEGABATCH", False),
+        megabatch_max_rows=_env_int(env, "MEGABATCH_MAX_ROWS", 32768),
         compile_cache_path=env.get("COMPILE_CACHE_PATH", ""),
         prewarm_on_start=_env_bool(env, "PREWARM_ON_START", False),
         ma_window=_env_int(env, "MA_WINDOW", 30),

@@ -82,6 +82,23 @@ def to_external(status: str) -> str:
     return EXTERNAL_STATUS.get(status, "unknown")
 
 
+def verdict_digest(store) -> str:
+    """Fleet-wide verdict identity: blake2b over every open+terminal
+    job's (id, status, reason, sorted anomaly). This IS the A/B identity
+    contract — every bench/simulator gate compares this digest, so any
+    change to what counts as verdict identity happens here, once.
+    Deliberately excludes processing_content (the provenance attachment
+    the provenance A/B toggles)."""
+    import hashlib
+
+    dig = hashlib.blake2b(digest_size=16)
+    every = store.by_status(*OPEN_STATUSES, *TERMINAL_STATUSES)
+    for d in sorted(every, key=lambda d: d.id):
+        dig.update(repr((d.id, d.status, d.reason,
+                         sorted(d.anomaly.items()))).encode())
+    return dig.hexdigest()
+
+
 class InvalidTransition(Exception):
     pass
 

@@ -62,6 +62,21 @@ class CyclePipeline:
         cap = max(16, analyzer.config.score_batch)
         fire = min(max(analyzer.config.pipeline_fire_rows, 16), cap)
         self.cap = analyzer._bucket_rows(fire)
+        # single-dispatch mega-batching: accumulators hold the WHOLE
+        # cycle's rows and flush as one padded launch per (family, T) at
+        # finish — trading the mid-stream fetch/score overlap for launch
+        # count, which is the winning trade once dispatch overhead
+        # dominates (docs/performance.md §6). The fire threshold is the
+        # PER-T memory-aware _mega_cap, not the global row ceiling:
+        # _fire packs its whole bucket into (n, T) host arrays before
+        # _launch_chunks re-chunks, so a T-blind cap would let a
+        # long-window bucket materialize multi-GB packed arrays that the
+        # launch-time cap then bounds too late. Firing at _mega_cap(T)
+        # partitions rows exactly as the launch-time re-chunk would
+        # (chunks of C + padded remainder), so launch counts and
+        # verdicts are unchanged — only pack-time peak memory moves.
+        self._mega = bool(analyzer.config.megabatch)
+        self._mega_caps: dict = {}  # T -> analyzer._mega_cap(T)
         self.acc: dict = {f: {} for f in self.FAMILIES}  # family -> T -> []
         self.pending: list = []  # (family, entries, launch_state)
         self.failed: list = []   # (family, entries) awaiting per-job retry
@@ -69,6 +84,11 @@ class CyclePipeline:
         self.stage_seconds = {"dispatch": 0.0, "collect": 0.0}
         self.family_seconds: dict = {}
         self.launches = 0
+        # device launches per family this cycle (from the analyzer's
+        # device_launches delta around each _fire, so chunk-level splits
+        # and the band family's period-detection launches count) — the
+        # mega-batch "one launch per family per cycle" claim reads this
+        self.family_launches: dict = {}
         # fingerprint score memo (SCORE_MEMO): unchanged rows resolve
         # straight from the analyzer's cross-cycle memo and never enter an
         # accumulator — buckets hold only changed rows, so steady-state
@@ -183,12 +203,19 @@ class CyclePipeline:
     def _add(self, family: str, T: int, entry):
         bucket = self.acc[family].setdefault(T, [])
         bucket.append(entry)
-        if len(bucket) >= self.cap:
+        if self._mega:
+            cap = self._mega_caps.get(T)
+            if cap is None:
+                cap = self._mega_caps[T] = self.an._mega_cap(T)
+        else:
+            cap = self.cap
+        if len(bucket) >= cap:
             self.acc[family][T] = []
             self._fire(family, T, bucket)
 
     def _fire(self, family: str, T: int, entries: list):
         t0 = time.perf_counter()
+        d0 = self.an.device_launches
         try:
             if family == "pair":
                 st = self.an._launch_pairs(entries, T)
@@ -205,6 +232,9 @@ class CyclePipeline:
         self.stage_seconds["dispatch"] += dt
         self.family_seconds[family] = self.family_seconds.get(family, 0.0) + dt
         self.launches += 1
+        self.family_launches[family] = (
+            self.family_launches.get(family, 0)
+            + (self.an.device_launches - d0))
 
     @staticmethod
     def _entry_items(entries: list) -> list:

@@ -34,6 +34,7 @@
 //   fm_free(p) frees arrays returned by fm_parse_series.
 
 #include <cmath>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <algorithm>
@@ -381,6 +382,31 @@ void fm_resample(const double* ts, const double* vals, long n,
         out_vals[idx] = (float)v;
         out_mask[idx] = 1;
     }
+}
+
+long fm_render_matrix(long ts0, long step, const double* vals, long n,
+                      char* out, long out_cap) {
+    // Serialize n grid samples into the query_range matrix "values"
+    // payload: [ts,"v"],[ts,"v"],... at fixed 4-decimal precision — the
+    // render twin of the parse scanner above, for in-process backends
+    // (simfleet) whose Python f-string join dominated the serve path at
+    // 100k-fleet warm fetches. glibc printf rounds %.4f correctly like
+    // Python's fixed-precision format, so rendered bodies stay
+    // byte-identical to the Python fallback (parity-pinned in
+    // tests/test_simfleet.py). Returns bytes written, or -1 when the
+    // caller's buffer would overflow (caller falls back to Python).
+    long w = 0;
+    for (long i = 0; i < n; ++i) {
+        if (i) {
+            if (out_cap - w < 1) return -1;
+            out[w++] = ',';
+        }
+        int k = std::snprintf(out + w, (size_t)(out_cap - w),
+                              "[%ld,\"%.4f\"]", ts0 + i * step, vals[i]);
+        if (k < 0 || (long)k >= out_cap - w) return -1;
+        w += k;
+    }
+    return w;
 }
 
 void fm_free(void* p) { std::free(p); }

@@ -1,0 +1,33 @@
+"""simfleet: deterministic fleet-scale load simulation (ROADMAP item 2).
+
+Every scaling claim before this subsystem rested on 500–1500-job bench
+fleets; the ROADMAP north star is 100k–1M jobs. simfleet closes that gap
+with three tiers:
+
+  * `trace` — a seedable synthetic-fleet trace generator: diurnal load
+    curves, deploy waves, correlated multi-app incidents, job churn, and
+    configurable anomaly injection with ground-truth labels (trace
+    shapes per SWIFT's workload characterization, PAPERS.md).
+  * `backend` — the trace materialized as an in-process metric backend
+    speaking the interfaces the dataplane already speaks: Prometheus
+    `query_range` bodies that HONOR their start/end params (so delta
+    fetch exercises for real) and remote-write push payloads
+    byte-consistent with the polled bodies (so push ingest does too).
+    `serve()` exposes the same backend over HTTP for driving a LIVE
+    replica (docs/operations.md).
+  * `driver` — runs 100k+ jobs through one or more in-process replicas
+    with measured jobs/s and resident-memory figures, and A/Bs the
+    single-dispatch mega-batch path (MEGABATCH) against the rung path
+    at byte-identical verdicts. Wired into `make perf`
+    (BENCH_CYCLE_SIMFLEET=1) and the CI perf-smoke gate.
+
+Every emitted bench JSON records its seed, trace shape, and fleet size
+(docs/benchmarks.md): a simfleet number is reproducible from the
+artifact alone.
+"""
+from .trace import FleetSpec, SimTrace, preset  # noqa: F401
+from .backend import SimBackend  # noqa: F401
+from .driver import run_fleet, run_fleet_ab  # noqa: F401
+
+__all__ = ["FleetSpec", "SimTrace", "preset", "SimBackend",
+           "run_fleet", "run_fleet_ab"]

@@ -1,0 +1,10 @@
+"""`python -m foremast_tpu.simfleet` — run the fleet-scale simulator.
+
+SIM_* knobs (docs/configuration.md) pick the fleet size, seed, trace
+shape, cycle count/cadence, replica count, and whether to run the
+mega-batch A/B (SIM_AB, the default) or a single leg. Prints one JSON
+line per the bench honesty convention (docs/benchmarks.md).
+"""
+from .driver import main
+
+main()

@@ -1,0 +1,311 @@
+"""SimBackend: a SimTrace materialized as the metric backend the
+dataplane already speaks.
+
+Three serving surfaces over ONE trace, byte-consistent with each other:
+
+  * `resolver(url)` — Prometheus `query_range` matrix bodies that HONOR
+    the URL's start/end/step params and the sim clock (samples past
+    `now` are withheld), so the delta tail-fetch path exercises for
+    real. Plug into the production parse path via `source()`
+    (RawFixtureDataSource -> native scanner -> grid).
+  * `push_series(lo, hi)` — remote-write label/sample payloads for the
+    same samples, serialized through the SAME 4-decimal convention the
+    bodies use, so a pushed window and a polled window are
+    byte-identical (the PR 12 splice-identity contract).
+  * `serve(port)` — the resolver over stdlib HTTP, for pointing a LIVE
+    replica's metric queries at the simulator (docs/operations.md).
+
+Job Documents come from `make_docs`: per-class metric query sets
+(continuous band monitors, canary pairs, hpa tps+latency, continuous
+bivariate) whose URLs route back into this backend.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+from ..engine import jobs as J
+from ..utils.timeutils import to_rfc3339
+from .trace import _MIX_DENOM, SimTrace
+
+__all__ = ["SimBackend"]
+
+_RANGE_RE = re.compile(
+    r"[?&]job=(\d+).*?[?&]m=(\d+).*?[?&]start=([0-9.]+).*?[?&]end=([0-9.]+)")
+
+# per-class metric layouts: (metric_name, slot, role extras)
+_CLASSES = ("continuous", "canary", "hpa", "bivariate")
+
+
+class SimBackend:
+    def __init__(self, trace: SimTrace, clock=None):
+        self.trace = trace
+        self.step = trace.step
+        self.t0 = trace.t0
+        if self.t0 % self.step:
+            # push_series addresses samples by ABSOLUTE grid slot
+            # (k * step); an unaligned anchor would put pushed and
+            # polled samples on different grids and silently break the
+            # splice-identity contract
+            raise ValueError(
+                f"trace t0 {self.t0} must be step-aligned ({self.step}s)")
+        # sim clock: samples with ts > now are withheld (range queries
+        # honor it exactly like a live Prometheus would). `clock`
+        # (callable) overrides for live wall-clock serving.
+        self._now = float(trace.t0)
+        self._clock = clock
+        # serve() handles requests on ThreadingHTTPServer worker threads;
+        # unguarded += would lose increments under concurrent fetches
+        self._stats_lock = threading.Lock()
+        self.requests = 0
+        self.bytes_served = 0
+        # URL host the docs' queries carry; serve() rewrites it to the
+        # live HTTP address so a real replica's fetches route here
+        self.url_base = "http://simfleet"
+        spec = trace.spec
+        self.hist_steps = spec.hist_windows * spec.window_steps
+        self.W = spec.window_steps
+        # canary baselines sit one diurnal period behind the current
+        # window (same phase -> same distribution); without diurnal load
+        # the plain history head works. The trace horizon is offset by
+        # this lead so baselines stay on the grid (one definition:
+        # trace.lead_steps).
+        self.lead_steps = trace.lead_steps
+        # class thresholds over i % _MIX_DENOM (deterministic interleave)
+        denom = _MIX_DENOM
+        self._denom = denom
+        acc, self._cuts = 0.0, []
+        mix = dict(spec.mix)
+        # fractions summing under 1.0 leave a remainder the FleetSpec
+        # contract (trace.py) assigns to the FIRST class — widen the
+        # first band by it so e.g. mix=(("continuous", 0.5),) yields 50%
+        # continuous + 50% continuous remainder, not surprise bivariates
+        spare = max(0.0, 1.0 - sum(float(mix.get(c, 0.0))
+                                   for c in _CLASSES))
+        for j, cls in enumerate(_CLASSES):
+            acc += float(mix.get(cls, 0.0)) + (spare if j == 0 else 0.0)
+            self._cuts.append((min(int(round(acc * denom)), denom), cls))
+        self._cuts[-1] = (denom, self._cuts[-1][1])  # rounding residue
+
+    # --------------------------------------------------------------- clock
+    @property
+    def now(self) -> float:
+        return float(self._clock()) if self._clock is not None else self._now
+
+    def set_now(self, now: float):
+        self._now = float(now)
+
+    # ---------------------------------------------------------------- urls
+    def url(self, job: int, slot: int, tag: str, k_lo: int, k_hi: int) -> str:
+        s = self.t0 + k_lo * self.step
+        e = self.t0 + k_hi * self.step
+        return (f"{self.url_base}/q?job={job}&m={slot}&w={tag}"
+                f"&start={s:.0f}&end={e:.0f}&step={self.step}")
+
+    def body(self, job: int, slot: int, qstart: float, qend: float) -> bytes:
+        """The range-honoring query_range matrix body: exactly the grid
+        slots inside [qstart, min(qend, now)], 4-decimal values (the
+        convention push payloads share — docs/benchmarks.md)."""
+        qend = min(float(qend), self.now)
+        k_lo = max(int(-(-(qstart - self.t0) // self.step)), 0)
+        k_hi = min(int((qend - self.t0) // self.step),
+                   self.trace.horizon - 1)
+        if k_hi < k_lo:
+            vals = b""
+        else:
+            series = self.trace.series(job, slot, k_lo, k_hi)
+            t0, step = self.t0, self.step
+            # the render twin of the native parser: one C call instead
+            # of a per-sample f-string join (which dominated serving at
+            # 100k-fleet warm fetches); byte-identical fallback below
+            from .. import native
+
+            vals = native.render_matrix(t0 + k_lo * step, step, series)
+            if vals is None:
+                vals = ",".join(
+                    f'[{t0 + (k_lo + i) * step},"{v:.4f}"]'
+                    for i, v in enumerate(series.tolist())).encode()
+        return (b'{"status":"success","data":{"resultType":"matrix",'
+                b'"result":[{"metric":{"__name__":"simfleet_metric"},'
+                b'"values":[' + vals + b']}]}}')
+
+    def resolver(self, url: str) -> bytes:
+        m = _RANGE_RE.search(url)
+        if m is None:
+            raise ValueError(f"not a simfleet range URL: {url}")
+        body = self.body(int(m.group(1)), int(m.group(2)),
+                         float(m.group(3)), float(m.group(4)))
+        with self._stats_lock:
+            self.requests += 1
+            self.bytes_served += len(body)
+        return body
+
+    def source(self):
+        """A RawFixtureDataSource over this backend — the production
+        byte-parse path (native scanner + Python fallback)."""
+        from ..dataplane.fetch import RawFixtureDataSource
+
+        # keep_urls=False: a 100k-job cycle issues ~200k fetches, and
+        # retaining every URL string would dominate the resident-memory
+        # figure the driver measures — request_count carries the tally.
+        return RawFixtureDataSource(resolver=self.resolver,
+                                    keep_urls=False)
+
+    # ---------------------------------------------------------------- docs
+    def class_of(self, job: int) -> str:
+        r = (job * 467) % self._denom  # co-prime stride: declustered mix
+        for cut, cls in self._cuts:
+            if r < cut:
+                return cls
+        return self._cuts[-1][1]
+
+    def job_id(self, job: int) -> str:
+        return f"sim-{self.class_of(job)}-{job}"
+
+    def _metric_layout(self, cls: str) -> list:
+        """[(metric_name, slot, kind)] per class; kind picks URL roles.
+
+        Metric names pick their judgment policy (config.policy_for):
+        continuous monitors watch the 3-sigma error4xx band — wide
+        enough that the diurnal swing's hold-last prediction drift
+        (~1.1 sigma at the steep phase over a 128-step window) stays
+        far under the verdict gate while a sustained +10-sigma anomaly
+        still floods it. The 2-sigma error5xx policy is fine for the
+        canary PAIR family (its internal band condemns at a 30%
+        violation fraction, and the phase-aligned baseline keeps the
+        rank tests quiet)."""
+        if cls == "continuous":
+            return [("error4xx", 0, "band")]
+        if cls == "canary":
+            return [("error5xx", 0, "pair")]
+        if cls == "hpa":
+            return [("tps", 0, "hpa_tps"), ("latency", 1, "hpa_sla")]
+        return [("latency", 0, "band"), ("cpu", 1, "band")]  # bivariate
+
+    def make_docs(self, start: int = 0, n: int | None = None) -> list:
+        """Documents [start, start+n) with URLs routed at this backend.
+        Churn arrivals reuse this with a later `start`."""
+        tr = self.trace
+        n = tr.spec.jobs if n is None else n
+        lead, hist, W = self.lead_steps, self.hist_steps, self.W
+        hist_lo = lead
+        hist_hi = lead + hist
+        far = tr.horizon - 1
+        start_rfc = to_rfc3339(self.t0)
+        end_rfc = to_rfc3339(self.t0 + (far + 1440) * self.step)
+        docs = []
+        for job in range(start, start + n):
+            cls = self.class_of(job)
+            metrics = {}
+            for name, slot, kind in self._metric_layout(cls):
+                if kind == "pair":
+                    # phase-aligned baseline: one diurnal period behind
+                    # the current window (same phase, same distribution)
+                    b_lo = hist_hi - lead if lead else hist_lo
+                    metrics[name] = J.MetricQueries(
+                        current=self.url(job, slot, "cur", hist_hi, far),
+                        baseline=self.url(job, slot, "base", b_lo,
+                                          b_lo + W),
+                    )
+                elif kind == "hpa_tps":
+                    metrics[name] = J.MetricQueries(
+                        current=self.url(job, slot, "cur", hist_hi, far),
+                        historical=self.url(job, slot, "hist", hist_lo,
+                                            hist_hi),
+                    )
+                elif kind == "hpa_sla":
+                    mq = J.MetricQueries(
+                        current=self.url(job, slot, "cur", hist_hi, far),
+                        historical=self.url(job, slot, "hist", hist_lo,
+                                            hist_hi),
+                    )
+                    mq.priority, mq.is_increase = 1, True
+                    metrics[name] = mq
+                else:  # band
+                    metrics[name] = J.MetricQueries(
+                        current=self.url(job, slot, "cur", hist_hi, far),
+                        historical=self.url(job, slot, "hist", hist_lo,
+                                            hist_hi),
+                    )
+            strategy = {"continuous": "continuous", "bivariate":
+                        "continuous", "hpa": "hpa"}.get(cls, "canary")
+            docs.append(J.Document(
+                id=self.job_id(job), app_name=f"app-{tr.app_of(job)}",
+                namespace="simfleet", strategy=strategy,
+                start_time="START_TIME" if strategy != "canary"
+                else start_rfc,
+                end_time="END_TIME" if strategy != "canary" else end_rfc,
+                metrics=metrics,
+            ))
+        return docs
+
+    # --------------------------------------------------------------- pushes
+    def push_series(self, lo: float, hi: float, start: int = 0,
+                    n: int | None = None, id_map: dict | None = None) -> list:
+        """Remote-write (labels, samples) payloads for every CURRENT-
+        window sample in (lo, hi] across jobs [start, start+n) — the
+        push twin of the polled bodies: same 4-decimal serialization,
+        so splice and refetch are byte-identical. `id_map` translates
+        simulator job indices to the TARGET's job ids (a live replica
+        mints its own at create; pushes labeled with the simulator's
+        ids would never route)."""
+        tr = self.trace
+        n = tr.spec.jobs if n is None else n
+        k_lo = int(lo // self.step) + 1
+        k_hi = min(int(hi // self.step), self.t0 // self.step
+                   + tr.horizon - 1)
+        k_lo = max(k_lo, (self.t0 // self.step) + self.lead_steps
+                   + self.hist_steps)
+        if k_hi < k_lo:
+            return []
+        base_k = self.t0 // self.step
+        series = []
+        for job in range(start, start + n):
+            cls = self.class_of(job)
+            jid = self.job_id(job)
+            if id_map is not None:
+                jid = id_map.get(job)
+                if jid is None:
+                    continue  # never created on the target: nothing to push
+            for name, slot, _kind in self._metric_layout(cls):
+                vals = tr.series(job, slot, k_lo - base_k, k_hi - base_k)
+                samples = [(float(k * self.step), float(f"{v:.4f}"))
+                           for k, v in zip(range(k_lo, k_hi + 1),
+                                           vals.tolist())]
+                if samples:
+                    series.append((
+                        {"foremast_job": jid, "foremast_metric": name},
+                        samples))
+        return series
+
+    # ------------------------------------------------------------- live http
+    def serve(self, port: int = 0):
+        """Serve the resolver over HTTP (daemon thread) so a LIVE replica
+        can poll the simulated fleet (docs/operations.md). Returns
+        (server, base_url); caller owns shutdown()."""
+        import http.server
+
+        backend = self
+
+        class _H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    body = backend.resolver(self.path)
+                except ValueError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # noqa: D102 - quiet by design
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), _H)
+        t = threading.Thread(target=srv.serve_forever,
+                             name="simfleet-backend", daemon=True)
+        t.start()
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}"

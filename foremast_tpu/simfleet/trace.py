@@ -1,0 +1,258 @@
+"""Deterministic synthetic-fleet traces: the workload half of simfleet.
+
+A `SimTrace` is a pure function of its `FleetSpec` (seed included): the
+same spec reproduces the same fleet byte-for-byte on any host, which is
+what lets a bench JSON carrying (seed, trace shape, fleet size) stand as
+a reproducible artifact. Trace shapes follow SWIFT's workload
+characterization (PAPERS.md): a base noise field plus
+
+  * **diurnal load** — a per-job-phased sine on top of the level;
+  * **deploy waves** — sub-verdict level shifts rolling across app
+    cohorts over the horizon (healthy drift the screen/memo must absorb,
+    not convict);
+  * **correlated incidents** — multi-app bursts: every job of the drawn
+    apps shifts by a CONVICTING magnitude inside the incident window;
+  * **anomaly injection** — a seeded subset of jobs carries a sustained
+    convicting shift from mid-current-window onward, with ground-truth
+    labels (`truth_jobs`) the driver scores convictions against.
+
+Series are generated lazily per (job, slot, sample range) from a small
+shared noise field plus analytic overlays, so a 1M-job fleet costs the
+noise field (n_shapes x horizon), not 1M materialized series.
+"""
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, replace
+
+import numpy as np
+
+__all__ = ["FleetSpec", "SimTrace", "preset", "PRESETS", "lead_steps"]
+
+# class mix denominator: job classes interleave deterministically by
+# job index so any contiguous or hashed partition (shard rings, churn
+# arrivals) sees the same mix
+_MIX_DENOM = 1000
+
+
+@dataclass(frozen=True)
+class FleetSpec:
+    """Everything a trace is a function of. Fully JSON-able via
+    `as_dict` — the bench-artifact honesty contract."""
+
+    jobs: int = 2000
+    seed: int = 0
+    shape: str = "diurnal"  # preset name, carried for the artifact
+    window_steps: int = 128  # current (scoring) window length
+    hist_windows: int = 4    # history = hist_windows * window_steps
+    step_s: int = 60
+    apps: int = 256          # jobs group into apps (incidents correlate)
+    n_shapes: int = 128      # distinct base noise rows
+    level: float = 10.0
+    noise_sigma: float = 1.0
+    diurnal_amp: float = 0.0         # sigmas of diurnal swing
+    diurnal_period_s: float = 86400.0
+    # class mix (fractions; remainder goes to the first class). Classes:
+    # continuous band monitors, canary pair analyses, hpa autoscaling
+    # jobs, continuous 2-metric bivariate monitors.
+    mix: tuple = (("continuous", 0.70), ("canary", 0.15),
+                  ("hpa", 0.10), ("bivariate", 0.05))
+    deploy_waves: int = 0
+    wave_shift_sigma: float = 1.0    # sub-verdict on purpose
+    incidents: int = 0
+    incident_apps: int = 8
+    incident_magnitude_sigma: float = 12.0  # convicting
+    incident_duration_s: float = 1800.0
+    anomaly_rate: float = 0.0
+    anomaly_magnitude_sigma: float = 10.0   # convicting, sustained
+    churn_per_cycle: float = 0.0     # fraction of fleet arriving per cycle
+
+    def as_dict(self) -> dict:
+        d = asdict(self)
+        d["mix"] = {k: v for k, v in self.mix}
+        return d
+
+
+PRESETS = {
+    # quiet steady fleet: the memo/delta regime
+    "steady": {},
+    # the default: diurnal load + a little injected anomaly tail
+    "diurnal": {"diurnal_amp": 2.0, "anomaly_rate": 0.01},
+    # rolling deploys: sub-verdict level shifts across app cohorts
+    "deploy-wave": {"diurnal_amp": 2.0, "deploy_waves": 4,
+                    "anomaly_rate": 0.01},
+    # correlated multi-app incidents on top of diurnal load
+    "incident": {"diurnal_amp": 2.0, "incidents": 2, "anomaly_rate": 0.0},
+    # job churn: new canary analyses arriving every cycle
+    "churn": {"diurnal_amp": 2.0, "churn_per_cycle": 0.01,
+              "anomaly_rate": 0.01},
+}
+
+
+def lead_steps(spec: FleetSpec) -> int:
+    """Grid steps the fleet windows shift right to make room for the
+    canary baselines, which sit one diurnal period behind the current
+    window (same phase -> same distribution; a phase-blind baseline
+    would hand the rank tests a real mean shift to convict). The ONE
+    definition — trace onset anchoring, backend window layout, and the
+    driver's horizon sizing all read it."""
+    if not spec.diurnal_amp:
+        return 0
+    return int(round(spec.diurnal_period_s / spec.step_s))
+
+
+def preset(shape: str, jobs: int, seed: int = 0, **overrides) -> FleetSpec:
+    """A FleetSpec for a named trace shape (PRESETS), with overrides."""
+    if shape not in PRESETS:
+        raise ValueError(
+            f"unknown trace shape {shape!r}; one of {sorted(PRESETS)}")
+    kw = dict(PRESETS[shape])
+    kw.update(overrides)
+    return replace(FleetSpec(jobs=jobs, seed=seed, shape=shape), **kw)
+
+
+class SimTrace:
+    """A materializable trace over `[t0, t0 + horizon_steps * step)`.
+
+    All randomness is drawn at __init__ in a FIXED order from one
+    `default_rng(seed)` — adding a feature must append draws, never
+    reorder them, or every recorded (seed, shape) artifact silently
+    changes meaning.
+    """
+
+    # metric-slot stride per job: slot s of job j reads base row
+    # (j * _SLOT_STRIDE + s) % n_shapes, so a job's metrics differ
+    _SLOT_STRIDE = 7
+
+    def __init__(self, spec: FleetSpec, t0: int, horizon_steps: int,
+                 extra_jobs: int = 0):
+        self.spec = spec
+        self.t0 = int(t0)
+        self.horizon = int(horizon_steps)
+        self.step = int(spec.step_s)
+        # total job index space: the base fleet plus churn arrivals the
+        # driver may mint (indices beyond spec.jobs)
+        self.total_jobs = int(spec.jobs) + int(extra_jobs)
+        rng = np.random.default_rng(spec.seed)
+        self.base = (spec.level + spec.noise_sigma
+                     * rng.standard_normal((spec.n_shapes, self.horizon)))
+        hist_steps = spec.hist_windows * spec.window_steps
+        W = spec.window_steps
+        self.lead_steps = lead_steps(spec)
+        # overlays become ACTIVE from mid-current-window at the driver's
+        # warm point (current windows start at lead + hist), so history
+        # and baselines stay clean and convictions land inside the
+        # driven span
+        self.active_from = float(
+            self.t0 + (self.lead_steps + hist_steps + W // 2) * self.step)
+        t_end = float(self.t0 + self.horizon * self.step)
+        # deploy waves: evenly spread onset times, app-cohort targets
+        self._wave_windows: list = []
+        if spec.deploy_waves > 0:
+            n = spec.deploy_waves
+            span = t_end - self.t0
+            for w in range(n):
+                onset = self.t0 + span * (w + 1) / (n + 1)
+                lo_app = (w * spec.apps) // n
+                hi_app = ((w + 1) * spec.apps) // n
+                self._wave_windows.append(
+                    (onset, t_end, lo_app, hi_app,
+                     spec.wave_shift_sigma * spec.noise_sigma))
+        # correlated incidents: rng draws the app groups (fixed order)
+        self._incidents: list = []
+        for _ in range(max(spec.incidents, 0)):
+            apps = rng.choice(spec.apps,
+                              size=min(spec.incident_apps, spec.apps),
+                              replace=False)
+            i0 = self.active_from
+            self._incidents.append(
+                (float(i0), float(i0 + spec.incident_duration_s),
+                 frozenset(int(a) for a in apps),
+                 spec.incident_magnitude_sigma * spec.noise_sigma))
+        # anomaly injection: a seeded subset of the BASE fleet carries a
+        # sustained convicting shift from active_from onward
+        n_anom = int(round(spec.jobs * spec.anomaly_rate))
+        self._anomalous = (
+            frozenset(int(j) for j in
+                      rng.choice(spec.jobs, size=n_anom, replace=False))
+            if n_anom else frozenset())
+        self._overlay_cache: dict[int, tuple] = {}
+        self._no_overlays: tuple = ()
+
+    # ------------------------------------------------------------- identity
+    def app_of(self, job: int) -> int:
+        return int(job) % self.spec.apps
+
+    def labels(self) -> dict:
+        """Ground-truth labels for the artifact: which jobs carry
+        injected convicting anomalies, and the incident windows."""
+        return {
+            "anomalous_jobs": sorted(self._anomalous),
+            "incidents": [
+                {"start": s, "end": e, "apps": sorted(apps),
+                 "magnitude": mag}
+                for s, e, apps, mag in self._incidents
+            ],
+            "active_from": self.active_from,
+        }
+
+    def truth_jobs(self, jobs: int | None = None) -> frozenset:
+        """Job indices expected to CONVICT: injected anomalies plus every
+        job of an incident app (overlays are sustained-convicting by
+        construction for the band/pair scorers)."""
+        n = self.spec.jobs if jobs is None else jobs
+        out = set(j for j in self._anomalous if j < n)
+        for _s, _e, apps, _m in self._incidents:
+            out.update(j for j in range(n) if self.app_of(j) in apps)
+        return frozenset(out)
+
+    # --------------------------------------------------------------- series
+    def _overlays_for(self, job: int) -> tuple:
+        """((t_start, t_end, magnitude, slot_or_None), ...) for one job.
+        slot None applies to every metric slot; convicting overlays pin
+        slot 0 (the verdict-bearing metric)."""
+        got = self._overlay_cache.get(job)
+        if got is not None:
+            return got
+        ov = []
+        app = self.app_of(job)
+        for onset, end, lo, hi, mag in self._wave_windows:
+            if lo <= app < hi:
+                ov.append((onset, end, mag, None))
+        for s, e, apps, mag in self._incidents:
+            if app in apps:
+                ov.append((s, e, mag, 0))
+        if job in self._anomalous:
+            t_end = float(self.t0 + self.horizon * self.step)
+            ov.append((self.active_from, t_end,
+                       self.spec.anomaly_magnitude_sigma
+                       * self.spec.noise_sigma, 0))
+        out = tuple(ov) if ov else self._no_overlays
+        # hard-bounded for ALL jobs: deploy-wave presets give every job an
+        # overlay, so an overlay-conditional bound would grow per-job at
+        # fleet scale and pollute the driver's resident-memory figures;
+        # past the bound the (cheap) recompute above serves directly
+        if len(self._overlay_cache) < 16384:
+            self._overlay_cache[job] = out
+        return out
+
+    def series(self, job: int, slot: int, k_lo: int, k_hi: int) -> np.ndarray:
+        """Values at grid slots [k_lo, k_hi] INCLUSIVE (clipped to the
+        horizon by the caller). float64, deterministic."""
+        spec = self.spec
+        k = np.arange(k_lo, k_hi + 1)
+        out = self.base[(job * self._SLOT_STRIDE + slot)
+                        % spec.n_shapes][k].copy()
+        t = None
+        if spec.diurnal_amp:
+            t = self.t0 + k * self.step
+            phase = (job * 0.6180339887) % 1.0
+            out += (spec.diurnal_amp * spec.noise_sigma
+                    * np.sin(2.0 * np.pi
+                             * (t / spec.diurnal_period_s + phase)))
+        for s0, s1, mag, sl in self._overlays_for(job):
+            if sl is not None and sl != slot:
+                continue
+            if t is None:
+                t = self.t0 + k * self.step
+            out[(t >= s0) & (t < s1)] += mag
+        return out

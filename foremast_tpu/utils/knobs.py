@@ -257,6 +257,50 @@ register("TRACE_EXPORT_URL", "", str,
          "finished traces are POSTed to as OTLP JSON; empty disables "
          "export — /debug/traces and `foremast-tpu trace` still work")
 
+# -- single-dispatch mega-batching (engine/pipeline.py; read by
+#    engine/config.from_env like the other ML_*/engine knobs — registered
+#    here for the inventory + docs contract) --
+register("MEGABATCH", False, parse_bool,
+         "collapse per-family/per-T-bucket rung launches into one padded "
+         "mega-batch launch per family per cycle (padding classes, "
+         "byte-identical verdicts); off keeps the streamed rung path")
+register("MEGABATCH_MAX_ROWS", 32768, int,
+         "mega-launch row ceiling at T<=1024 (scaled ~1/T beyond); "
+         "fleets past it chunk at the ceiling")
+
+# -- fleet-scale load simulator (foremast_tpu/simfleet; `make perf`
+#    BENCH_CYCLE_SIMFLEET leg and `python -m foremast_tpu.simfleet`) --
+register("SIM_JOBS", 2000, int,
+         "simulated fleet size the simfleet driver runs", scope="bench")
+register("SIM_SEED", 0, int,
+         "trace seed; every simfleet artifact records it so runs are "
+         "reproducible from the JSON alone", scope="bench")
+register("SIM_TRACE", "diurnal", str,
+         "trace shape preset: steady | diurnal | deploy-wave | incident "
+         "| churn (simfleet/trace.py)", scope="bench")
+register("SIM_CYCLES", 6, int,
+         "measured engine cycles per simfleet leg", scope="bench")
+register("SIM_CADENCE_S", 60.0, float,
+         "sim-clock seconds advanced per cycle (CYCLE_SECONDS twin; the "
+         "default equals the metric step so every cycle advances every "
+         "window — the launch-bound regime the mega-batch A/B measures)",
+         scope="bench")
+register("SIM_REPLICAS", 1, int,
+         "in-process replicas the simulated fleet partitions across "
+         "(hash-ring ownership, one shared store)", scope="bench")
+register("SIM_ROUNDS", 2, int,
+         "interleaved off/on rounds per simfleet A/B (best-of per side, "
+         "digests checked every round); 1 keeps a 100k+ run affordable",
+         scope="bench")
+register("SIM_AB", True, parse_bool,
+         "run the mega-batch on/off A/B (identity + launch collapse); "
+         "0 runs a single leg honoring MEGABATCH/SIM_STREAM",
+         scope="bench")
+register("SIM_STREAM", False, parse_bool,
+         "single-leg mode: push the advancing samples through the "
+         "ingest receiver (remote-write) instead of poll-only",
+         scope="bench")
+
 # -- multi-host world (parallel/distributed.py) --
 register("COORDINATOR_ADDRESS", "", str,
          "jax.distributed coordinator (multi-host deploys)")

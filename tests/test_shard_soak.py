@@ -105,12 +105,20 @@ class Replica:
         """One worker-loop lap: membership tick, adoption scan, engine
         cycle (the cycle's trailing store.flush() mirrors to the archive)."""
         self.shard.tick()
+        adopted_ids: list[str] = []
+
+        def _on_adopt(d):
+            adopted_ids.append(d.id)
+            self.analyzer.provenance.adopt(d.id, d.processing_content)
+
         n = self.store.adopt_stale_from_archive(
             worker=self.rid, owns_fn=self.shard.owns,
             dead_holder_fn=self.shard.dead_holder,
-            on_adopt=lambda d: self.analyzer.provenance.adopt(
-                d.id, d.processing_content))
-        self.shard.mark_adopt_complete(n)
+            on_adopt=_on_adopt)
+        # jobs= mirrors the runtime's wiring (runtime.py _worker_loop):
+        # the adoption flight event carries the adopted ids so the
+        # incident is correlatable with the releasing side's handoff
+        self.shard.mark_adopt_complete(n, jobs=adopted_ids)
         out = self.analyzer.run_cycle(worker=self.rid, now=score_now)
         for jid, status in out.items():
             if status in J.TERMINAL_STATUSES:
@@ -298,7 +306,17 @@ def test_kill9_one_of_three_replicas_zero_lost_zero_double_scored(tmp_path):
                  for e in r.analyzer.flight.snapshot(limit=200)
                  if e["type"] == "shard-adoption"]
     assert adoptions
-    assert all(e["detail"]["cycle_id"] for e in adoptions)
+    # scope the cycle-id check to the POST-KILL adoptions (the dead
+    # holder's jobs): the initial-distribution adoptions on lap 1 land
+    # before the adopting replica's first engine cycle, so their events
+    # honestly carry cycle_id "" (sharding._cycle_id documents that), and
+    # whether those early events are still inside this bounded snapshot
+    # depends on how many events the soak generated — not on the
+    # correlation contract under test here
+    post_kill = [e for e in adoptions
+                 if set(e["detail"].get("jobs") or []) & b_open_ids]
+    assert post_kill, adoptions
+    assert all(e["detail"]["cycle_id"] for e in post_kill), post_kill
     # ---- detection latency was measured across the soak (all-canary
     # fleet here; the per-class criterion is tests/test_fleet_plane.py)
     for r in (A, C):
