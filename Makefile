@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf perf-smoke demo demo-hpa dryrun fuzz chaos soak soak-sharded soak-stream soak-restart clean
+.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf perf-smoke demo demo-hpa dryrun fuzz chaos soak soak-sharded soak-stream soak-restart soak-jobstore clean
 
 test: lint       ## full suite (CPU, 8 virtual devices via conftest), gated on lint
 	$(PY) -m pytest tests/ -q
@@ -64,6 +64,9 @@ soak-stream:     ## streaming-ingest soaks (<120s): push+poll under chaos latenc
 
 soak-restart:    ## crash-durability soak (<60s): kill -9 a replica mid-push-stream, restart over the same WINDOW_STORE_DIR; WAL+segment replay, zero refetch storm, verdicts == never-restarted baseline (torn-WAL chaos leg included)
 	$(CPU_ENV) $(PY) -m pytest tests/test_restart_soak.py -q
+
+soak-jobstore:   ## job-store durability soak (<60s): kill -9 mid-transition with claimed leases over a JOB_STORE_DIR; WAL replay through the normal transition path, zero lost / zero double-scored jobs, provenance chains intact (disk-fault chaos leg + graceful-shutdown archive drain included)
+	$(CPU_ENV) $(PY) -m pytest tests/test_jobstore_soak.py -q
 
 demo:            ## hermetic rollback demo (no cluster)
 	$(CPU_ENV) $(PY) -m foremast_tpu demo

@@ -313,6 +313,7 @@ def _render_explain(payload: dict) -> str:
     cyc = rec.get("cycle") or {}
     cycle_id = cyc.get("cycle_id") or rec.get("cycle_id", "")
     src = (" (from archive)" if rec.get("from_archive")
+           else " (from spilled tier)" if rec.get("from_tier")
            else " (from document summary)" if rec.get("from_document")
            else "")
     lines.append(f"  verdict path: {rec.get('path', '?')}"

@@ -1,0 +1,210 @@
+"""Shared CRC-framed append-only segment/WAL primitives.
+
+PR 13 built these inside ``dataplane/winstore.py`` for the window tier;
+the tiered JOB store (``engine/jobtier.py``) and the segment-backed
+``FileArchive`` (``engine/archive.py``) durably store state on the same
+invariants, so the framing lives here once:
+
+  * **frame** — ``MAGIC | u32 payload_len | u32 crc32(payload) |
+    payload``. Appends to a given file are serialized by the caller's
+    lock (frames never interleave) and a failed short write rolls the
+    file back (``append_frame``), so a crash can only ever tear the
+    LAST frame.
+  * **scan** — walk a buffer frame by frame; a bad frame ends the scan,
+    and the status distinguishes a torn tail (crash mid-append, safe to
+    truncate) from mid-file corruption (a CRC-valid frame exists later
+    — real disk damage). Whether a caller may resume PAST damage
+    depends on whether record order matters: WALs replay in order and
+    must stop; segment records are independent newest-wins states and
+    may salvage-walk on via ``next_valid_frame``.
+  * **append_frame** — O_APPEND write loop with short-write rollback
+    (``ftruncate`` to the pre-append size), optional fsync, the
+    ``tear=`` crash-shape test seam, and the ``disk=`` chaos seam
+    (resilience/faults.py): an injector decision surfaces as a short
+    write exercising the rollback path, an ENOSPC, or an EIO — the
+    three disk-pressure failures the store fault paths must degrade
+    under, drillable from env config.
+"""
+from __future__ import annotations
+
+import errno
+import os
+import struct
+import zlib
+
+__all__ = [
+    "MAGIC", "HEAD", "FRAME_OVERHEAD",
+    "SCAN_OK", "SCAN_TORN", "SCAN_CORRUPT",
+    "frame", "next_valid_frame", "scan", "append_frame", "append_frames",
+    "read_file",
+]
+
+MAGIC = b"FWS1"
+HEAD = struct.Struct("<II")
+FRAME_OVERHEAD = len(MAGIC) + HEAD.size
+
+# scan outcomes (recovery paths surface them as counters)
+SCAN_OK = "ok"
+SCAN_TORN = "torn_tail"
+SCAN_CORRUPT = "corrupt"
+
+
+def frame(payload: bytes) -> bytes:
+    return MAGIC + HEAD.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def next_valid_frame(buf, start: int) -> int:
+    """Offset of the first CRC-valid frame at/after ``start``, or -1.
+    A bare 4-byte MAGIC match is NOT enough — it can occur by chance
+    inside raw binary payloads (f32/f64 columns)."""
+    n = len(buf)
+    j = buf.find(MAGIC, start)
+    while j != -1:
+        end = j + FRAME_OVERHEAD
+        if end <= n:
+            plen, crc = HEAD.unpack(buf[j + len(MAGIC):end])
+            if end + plen <= n and zlib.crc32(buf[end:end + plen]) == crc:
+                return j
+        j = buf.find(MAGIC, j + 1)
+    return -1
+
+
+def scan(buf, start: int = 0) -> tuple[list[tuple[int, int]], str, int]:
+    """Walk ``buf`` frame by frame from ``start`` ->
+    ([(payload_off, payload_len)], status, bad_off). A bad frame ends
+    the scan; status distinguishes a torn tail (nothing parseable after
+    it — the crash-mid-append shape, safe to truncate) from mid-file
+    corruption (a CRC-valid frame exists later — disk damage)."""
+    frames: list[tuple[int, int]] = []
+    i, n = start, len(buf)
+    while i < n:
+        end = i + FRAME_OVERHEAD
+        if (buf[i:i + len(MAGIC)] != MAGIC or end > n):
+            break
+        plen, crc = HEAD.unpack(buf[i + len(MAGIC):end])
+        if end + plen > n or zlib.crc32(buf[end:end + plen]) != crc:
+            break
+        frames.append((end, plen))
+        i = end + plen
+    if i >= n:
+        return frames, SCAN_OK, n
+    # classify: only a later CRC-valid frame proves the middle is
+    # damaged — misreading a benign crash-mid-append as corruption
+    # would escalate a routine restart into a full resync.
+    status = SCAN_CORRUPT if next_valid_frame(buf, i + 1) != -1 \
+        else SCAN_TORN
+    return frames, status, i
+
+
+def _injected_fault(injector, path: str, fd: int, base: int,
+                    framed: bytes) -> None:
+    """Apply one ``disk=`` chaos decision at the append seam. ``short``
+    leaves a torn prefix then rolls back and raises — the detected
+    short-write path every store must degrade through; ``enospc`` /
+    ``eio`` raise before any byte lands."""
+    kind = injector.decide_disk()
+    if not kind:
+        return
+    if kind == "short":
+        os.write(fd, framed[:max(len(framed) // 2, 1)])
+        try:
+            os.ftruncate(fd, base)
+        except OSError:
+            pass
+        raise OSError(errno.EIO, f"chaos: short write on {path}")
+    code = errno.ENOSPC if kind == "enospc" else errno.EIO
+    raise OSError(code, f"chaos: injected {kind} on {path}")
+
+
+def append_frame(path: str, payload: bytes, fsync: bool = False,
+                 tear: bool = False, injector=None) -> int:
+    """Append one CRC frame to ``path``; returns the file size BEFORE
+    the append (so callers compute the payload offset as
+    ``base + FRAME_OVERHEAD``). A short write rolls the file back to
+    that size — a torn frame MID-file would strand everything appended
+    after it on the next scan, so failures must degrade cleanly.
+    ``tear=True`` writes only a prefix of the frame (the crash-mid-
+    append shape the recovery scans must truncate)."""
+    framed = frame(payload)
+    if tear:
+        framed = framed[:max(len(framed) // 2, 1)]
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        base = os.fstat(fd).st_size
+        if injector is not None:
+            _injected_fault(injector, path, fd, base, framed)
+        done = 0
+        try:
+            while done < len(framed):
+                n = os.write(fd, memoryview(framed)[done:])
+                if n <= 0:
+                    raise OSError("zero-byte write")
+                done += n
+        except OSError:
+            if done:
+                try:
+                    os.ftruncate(fd, base)
+                except OSError:
+                    pass
+            raise
+        if fsync:
+            os.fsync(fd)
+    finally:
+        os.close(fd)
+    return base
+
+
+def append_frames(path: str, payloads, fsync: bool = False,
+                  injector=None) -> tuple[int, int]:
+    """Append MANY frames through one fd (batch mutations — a claim
+    sweep leases hundreds of docs per call; per-frame open/close would
+    dominate). Returns ``(size_before, frames_written)``.
+
+    Failure contract: a mid-batch error truncates back to the LAST
+    COMPLETE frame boundary — earlier frames in the batch are already
+    valid records and are kept — then re-raises with
+    ``frames_written`` set on the exception so callers can index the
+    surviving prefix. The injector seam fires per frame (chaos rates
+    are per record, matching the single-append path)."""
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    written = 0
+    try:
+        base = os.fstat(fd).st_size
+        boundary = base
+        try:
+            for payload in payloads:
+                framed = frame(payload)
+                if injector is not None:
+                    _injected_fault(injector, path, fd, boundary, framed)
+                done = 0
+                try:
+                    while done < len(framed):
+                        n = os.write(fd, memoryview(framed)[done:])
+                        if n <= 0:
+                            raise OSError("zero-byte write")
+                        done += n
+                except OSError:
+                    if done:
+                        try:
+                            os.ftruncate(fd, boundary)
+                        except OSError:
+                            pass
+                    raise
+                boundary += len(framed)
+                written += 1
+            if fsync:
+                os.fsync(fd)
+        except OSError as e:
+            e.frames_written = written
+            raise
+    finally:
+        os.close(fd)
+    return base, written
+
+
+def read_file(path: str) -> bytes:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except FileNotFoundError:
+        return b""

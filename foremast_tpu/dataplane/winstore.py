@@ -63,80 +63,28 @@ import mmap
 import os
 import struct
 import time
-import zlib
 
 import numpy as np
 
 from ..ops.windowing import Window
 from ..utils.locks import make_lock
+from . import segfile
+from .segfile import SCAN_CORRUPT, SCAN_OK, SCAN_TORN  # noqa: F401 (API)
 
 log = logging.getLogger("foremast_tpu.winstore")
 
 __all__ = ["WindowStore"]
 
-# frame: MAGIC | u32 payload_len | u32 crc32(payload) | payload.
-# Appends to a given file are serialized by its lock (_wal_lock /
-# _seg_lock) — frames never interleave — and a failed short write rolls
-# the file back (_append), so a crash can only ever tear the LAST frame.
-_MAGIC = b"FWS1"
-_HEAD = struct.Struct("<II")
-_FRAME_OVERHEAD = len(_MAGIC) + _HEAD.size
-
-# scan outcomes (recover() surfaces them as counters)
-SCAN_OK = "ok"
-SCAN_TORN = "torn_tail"
-SCAN_CORRUPT = "corrupt"
-
-
-def _frame(payload: bytes) -> bytes:
-    return _MAGIC + _HEAD.pack(len(payload), zlib.crc32(payload)) + payload
-
-
-def _next_valid_frame(buf, start: int) -> int:
-    """Offset of the first CRC-valid frame at/after ``start``, or -1.
-    A bare 4-byte MAGIC match is NOT enough — it can occur by chance
-    inside raw f32/f64 column payloads."""
-    n = len(buf)
-    j = buf.find(_MAGIC, start)
-    while j != -1:
-        end = j + _FRAME_OVERHEAD
-        if end <= n:
-            plen, crc = _HEAD.unpack(buf[j + len(_MAGIC):end])
-            if end + plen <= n and zlib.crc32(buf[end:end + plen]) == crc:
-                return j
-        j = buf.find(_MAGIC, j + 1)
-    return -1
-
-
-def _scan(buf, start: int = 0) -> tuple[list[tuple[int, int]], str, int]:
-    """Walk ``buf`` frame by frame from ``start`` ->
-    ([(payload_off, payload_len)], status, bad_off). A bad frame ends
-    the scan; status distinguishes a torn tail (nothing parseable after
-    it — the crash-mid-append shape, safe to truncate) from mid-file
-    corruption (a CRC-valid frame exists later — disk damage; whether
-    the caller may resume past it depends on whether record ORDER
-    matters: the WAL replays in order and must stop, segment records
-    are independent newest-wins states and may continue)."""
-    frames: list[tuple[int, int]] = []
-    i, n = start, len(buf)
-    while i < n:
-        end = i + _FRAME_OVERHEAD
-        if (buf[i:i + len(_MAGIC)] != _MAGIC or end > n):
-            break
-        plen, crc = _HEAD.unpack(buf[i + len(_MAGIC):end])
-        if end + plen > n or zlib.crc32(buf[end:end + plen]) != crc:
-            break
-        frames.append((end, plen))
-        i = end + plen
-    if i >= n:
-        return frames, SCAN_OK, n
-    # classify: only a later CRC-valid frame proves the middle is
-    # damaged — misreading a benign crash-mid-append as corruption
-    # would latch a store-wide resync (the refetch storm this module
-    # exists to avoid).
-    status = SCAN_CORRUPT if _next_valid_frame(buf, i + 1) != -1 \
-        else SCAN_TORN
-    return frames, status, i
+# Frame format + scan/salvage primitives live in dataplane/segfile.py
+# since the job tier and the segment-backed FileArchive store on the
+# same invariants; the aliases keep this module's long-standing surface
+# (tests and PR 13-era callers address them here).
+_MAGIC = segfile.MAGIC
+_HEAD = segfile.HEAD
+_FRAME_OVERHEAD = segfile.FRAME_OVERHEAD
+_frame = segfile.frame
+_next_valid_frame = segfile.next_valid_frame
+_scan = segfile.scan
 
 
 def _pack_state(state: dict) -> bytes:
@@ -255,45 +203,12 @@ class WindowStore:
 
     # ------------------------------------------------------------- helpers
     def _append(self, path: str, payload: bytes, tear: bool = False) -> bool:
-        frame = _frame(payload)
-        if tear:
-            # torn write: only a prefix of the frame reaches the disk —
-            # what a crash mid-append leaves behind
-            frame = frame[:max(len(frame) // 2, 1)]
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-        try:
-            base = os.fstat(fd).st_size
-            done = 0
-            try:
-                while done < len(frame):
-                    n = os.write(fd, memoryview(frame)[done:])
-                    if n <= 0:
-                        raise OSError("zero-byte write")
-                    done += n
-            except OSError:
-                # a short write leaves a torn frame MID-file once later
-                # appends land after it, stranding everything behind the
-                # tear on the next scan — roll back to the pre-append
-                # size so the failure degrades cleanly instead
-                if done:
-                    try:
-                        os.ftruncate(fd, base)
-                    except OSError:
-                        pass
-                raise
-            if self.fsync:
-                os.fsync(fd)
-        finally:
-            os.close(fd)
+        segfile.append_frame(path, payload, fsync=self.fsync, tear=tear)
         return True
 
     @staticmethod
     def _read_file(path: str) -> bytes:
-        try:
-            with open(path, "rb") as f:
-                return f.read()
-        except FileNotFoundError:
-            return b""
+        return segfile.read_file(path)
 
     def _seg_buffer(self):
         """The segment file as an mmap covering its current size (made

@@ -7,8 +7,11 @@ design.md:49-51). The TPU runtime's live store is in-process (jobs resolve
 in milliseconds; a queue database adds nothing), so the archive is a
 write-behind sink for *terminal* jobs and hpalogs:
 
-  * `FileArchive` — newline-delimited JSON with size-based rotation; zero
-    dependencies, queryable via /v1/healthcheck/search.
+  * `FileArchive` — CRC-framed segment records (dataplane/segfile, the
+    same format the window tier and job tier persist on) with size-based
+    compacting rotation; zero dependencies, queryable via
+    /v1/healthcheck/search. Pre-existing newline-JSON archives are read
+    transparently and converted at the next compaction.
   * `EsArchive` — same record stream PUT into real ES-compatible indices
     (same names as the reference), for fleets that already run
     ES/OpenSearch + Kibana. Best-effort: archive failures must never fail
@@ -23,7 +26,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import urllib.error
 import urllib.request
 
@@ -32,6 +34,7 @@ try:
 except ImportError:  # Windows: no flock; single-process archives only
     fcntl = None
 
+from ..dataplane import segfile
 from ..utils.locks import make_lock
 
 __all__ = ["FileArchive", "EsArchive", "MEMBER_STATE_PREFIX"]
@@ -76,16 +79,106 @@ def _match(rec: dict, app, namespace, status, strategy) -> bool:
     )
 
 
+def _parse_framed(buf: bytes, start: int) -> tuple[list[dict], int]:
+    """Parse CRC-framed records from ``buf[start:]`` ->
+    ``(records, consumed)``. ``consumed`` is the offset incremental
+    readers may resume from: end-of-buffer on a clean parse, else the
+    FIRST damaged offset — archive records are independent newest-wins
+    states, so the walk salvages past damage (``next_valid_frame``) but
+    the damaged region stays "unconsumed" and is re-walked (idempotently)
+    until compaction rewrites it away."""
+    recs: list[dict] = []
+    i, n = start, len(buf)
+    first_bad = None
+    while i < n:
+        frames, status, bad = segfile.scan(buf, i)
+        for off, plen in frames:
+            try:
+                recs.append(json.loads(buf[off:off + plen]))
+            except json.JSONDecodeError:
+                continue  # CRC-valid but unparseable: skip, never fatal
+        if status == segfile.SCAN_OK:
+            break
+        if first_bad is None:
+            first_bad = bad
+        i = segfile.next_valid_frame(buf, bad + 1)
+        if i == -1:
+            break
+    return recs, (first_bad if first_bad is not None else n)
+
+
+def _parse_legacy(buf: bytes, start: int) -> tuple[list[dict], int]:
+    """Parse newline-JSON records (pre-segment archives) ->
+    ``(records, consumed)``; a torn tail line (no trailing newline yet)
+    stays unconsumed for the next incremental pass."""
+    recs: list[dict] = []
+    end = buf.rfind(b"\n", start) + 1
+    if end <= start:
+        return recs, start
+    for line in buf[start:end].split(b"\n"):
+        if not line:
+            continue
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue  # torn interleave from a pre-flock writer: skip
+    return recs, end
+
+
+def _parse_file(buf: bytes) -> list[dict]:
+    """Whole-file parse with per-file format detection (compaction and
+    generation rebuilds; the incremental path in _advance_view_locked
+    remembers the format instead of sniffing)."""
+    if not buf:
+        return []
+    framed = buf[:len(segfile.MAGIC)] == segfile.MAGIC
+    return (_parse_framed if framed else _parse_legacy)(buf, 0)[0]
+
+
+def _merge_view(docs: dict, states: dict, recs: list[dict]) -> None:
+    """Fold records into the {id: doc} / {key: (value, ts)} maps,
+    newest-wins by each record's OWN stamp (append order lies: a wedged
+    peer can append a stale open record after a terminal one)."""
+    for rec in recs:
+        t = rec.get("_type")
+        if t == "document":
+            rid = rec.get("id", "")
+            cur = docs.get(rid)
+            if cur is None or (rec.get("modified_at", 0.0)
+                               >= cur.get("modified_at", 0.0)):
+                docs[rid] = rec
+        elif t == "state":
+            key = rec.get("key", "")
+            cur = states.get(key)
+            if cur is None or rec.get("updated_at", 0.0) >= cur[1]:
+                states[key] = (rec.get("value"), rec.get("updated_at", 0.0))
+
+
 class FileArchive:
-    """Append-only JSONL archive with compacting rotation.
+    """Append-only segment-record archive with compacting rotation.
+
+    Records land as CRC frames (dataplane/segfile — the same format the
+    window tier and tiered job store persist on), so a crash can only
+    tear the last frame and readers can always tell damage from a torn
+    tail. Files written by pre-segment builds (newline-JSON) are read
+    transparently, keep receiving newline appends so the two formats
+    never mix within one file, and convert at their next compaction.
 
     MULTI-PROCESS SAFE on POSIX: the cross-replica failover deployment
     shares one archive path between runtimes (docs/operations.md), so
     every file MUTATION holds an fcntl flock on a sidecar `.lock` file
-    (readers stay lock-free — see _iter_records), and each record lands
+    (readers stay lock-free — see _refresh_view), and each record lands
     as ONE O_APPEND os.write, so concurrent appends can never interleave
-    into torn lines. Without fcntl (Windows) a per-process lock is all
+    into torn frames. Without fcntl (Windows) a per-process lock is all
     there is: share an archive only via ES there.
+
+    READS are served from an incrementally-maintained view (latest doc
+    per id + latest state blob per key): between mutations a read costs
+    a couple of stat(2)s, and after appends only the NEW bytes of the
+    active file are parsed — the per-heartbeat membership read
+    (list_state) and the adoption scan (search/claim_job) no longer pay
+    a full two-generation JSON walk per call. Rotation (new `.1` inode)
+    triggers the only full rebuild, counted on ``view_rebuilds``.
 
     Rotation COMPACTS instead of discarding: when the active file
     exceeds max_bytes, both generations merge into `.1` keeping the
@@ -109,14 +202,21 @@ class FileArchive:
         # fleet size); state blobs are last-per-key.
         self.keep_terminal_seconds = keep_terminal_seconds
         self._lock = make_lock("engine.archive.file")
-        # times a lock-free scan exhausted its rescans and fell back to a
-        # locked scan (sustained-rotation churn); exposed for observability
+        # times a lock-free view refresh exhausted its rescans and fell
+        # back to a rebuild under the mutation lock (sustained-rotation
+        # churn); exposed for observability
         self.locked_scan_fallbacks = 0
         self.compactions = 0
-        # list_state memo: (mutation sig, {key: (value, updated_at)}).
-        # The shard membership layer reads state every heartbeat; between
-        # archive mutations that must not cost a full two-generation scan
-        self._state_view: tuple | None = None
+        # full two-generation view rebuilds (first read + every rotation);
+        # steady-state reads between rotations advance incrementally and
+        # never bump this — the counter IS the O(archive)-walk budget
+        self.view_rebuilds = 0
+        # (ino of .1, active-file format, active bytes consumed,
+        #  {id: doc}, {key: (value, updated_at)}) — replaced wholesale
+        # (copy-on-write) so readers iterate a stable snapshot while a
+        # concurrent refresh installs the next one
+        self._view: tuple | None = None
+        self._view_lock = make_lock("engine.archive.view")
         # times the sidecar .lock could not be opened/flocked while fcntl
         # IS available: mutations proceeded under the in-process lock only,
         # and compaction was suppressed (truncating without the
@@ -166,12 +266,12 @@ class FileArchive:
         return _Lock()
 
     # -- writing --
-    def _maybe_compact_locked(self, line_len: int,
+    def _maybe_compact_locked(self, rec_len: int,
                               cross_locked: bool) -> None:
         """Size-triggered compaction check (caller holds the flock)."""
         try:
             if (os.path.exists(self.path)
-                    and os.path.getsize(self.path) + line_len > self.max_bytes):
+                    and os.path.getsize(self.path) + rec_len > self.max_bytes):
                 if cross_locked:
                     self._compact_locked()
                 else:
@@ -185,14 +285,30 @@ class FileArchive:
         except OSError:
             pass
 
-    def _raw_append_locked(self, line: bytes) -> bool:
+    def _active_framed_locked(self) -> bool:
+        """Format of the ACTIVE file (caller holds the flock). Sniffed
+        per append — not cached — because a shared-path peer's compaction
+        can convert a legacy file under us; four bytes per append keeps
+        the no-mixed-files invariant safe against that."""
+        try:
+            with open(self.path, "rb") as f:
+                head = f.read(len(segfile.MAGIC))
+        except OSError:
+            return True  # absent: next append starts a framed file
+        return len(head) < len(segfile.MAGIC) or head == segfile.MAGIC
+
+    def _raw_append_locked(self, payload: bytes) -> bool:
         """One interleave-atomic write(2) (caller holds the flock).
         Shared by _append and claim_job so the write path cannot drift."""
+        if self._active_framed_locked():
+            blob = segfile.frame(payload)
+        else:
+            blob = payload + b"\n"  # legacy file: stay line-framed
         try:
             fd = os.open(self.path,
                          os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
             try:
-                os.write(fd, line)
+                os.write(fd, blob)
             finally:
                 os.close(fd)
         except OSError:
@@ -200,17 +316,19 @@ class FileArchive:
         return True
 
     def _append(self, rec: dict) -> bool:
-        line = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
+        payload = json.dumps(rec, separators=(",", ":")).encode()
         with self._flock() as lk:
-            self._maybe_compact_locked(len(line), lk.cross_locked)
-            return self._raw_append_locked(line)
+            self._maybe_compact_locked(
+                len(payload) + segfile.FRAME_OVERHEAD, lk.cross_locked)
+            return self._raw_append_locked(payload)
 
     def _compact_locked(self):
         """Merge both generations into `.1`, last-write-wins (caller holds
         the mutation lock, so no concurrent append can slip between the
         copy and the truncation). Terminal documents age out past
         keep_terminal_seconds so the compacted size tracks the LIVE job
-        count, not deployment history."""
+        count, not deployment history. Output is always framed — this is
+        where a legacy newline archive converts."""
         import time as _time
 
         now = _time.time()
@@ -248,9 +366,12 @@ class FileArchive:
             or now - rec.get("updated_at", 0.0) <= KEEP_MEMBER_SECONDS
         ]
         tmp = self.path + ".1.tmp"
-        with open(tmp, "w") as f:
+        with open(tmp, "wb") as f:
             for rec in (*keep_docs, *keep_states, *hpalogs):
-                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                f.write(segfile.frame(
+                    json.dumps(rec, separators=(",", ":")).encode()))
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.path + ".1")
         # truncate the active file (its records now live compacted in .1)
         fd = os.open(self.path, os.O_WRONLY | os.O_TRUNC | os.O_CREAT, 0o644)
@@ -273,87 +394,46 @@ class FileArchive:
         adoption degrades to the optimistic semantics, which stay safe
         (last-write-wins verdicts); counted on lock_degradations.
 
-        Cost note: each call scans both generations under the flock, so a
-        large adoption burst over a big file archive serializes O(archive)
-        scans. Fine for this archive's role (dev/test medium, small shared
-        deployments); fleet-scale production uses EsArchive, where the CAS
-        is one conditional PUT."""
-        line = (json.dumps({"_type": "document", **rec},
-                           separators=(",", ":")) + "\n").encode()
+        Cost note: the check reads the incrementally-maintained doc view
+        (refreshed under the flock, so it is exact), so a mass-adoption
+        burst costs one suffix parse of the appends since the last read —
+        not the O(archive) two-generation scan per claim it used to."""
+        payload = json.dumps({"_type": "document", **rec},
+                             separators=(",", ":")).encode()
         with self._flock() as lk:
             # same size-triggered compaction as _append: a mass-adoption
             # burst (rebalance after a replica death) appends one claim
             # record per job and must not grow the file unboundedly
-            self._maybe_compact_locked(len(line), lk.cross_locked)
-            latest = None
-            for r in self._scan_once():
-                if r.get("_type") != "document" or r.get("id") != job_id:
-                    continue
-                if latest is None or (r.get("modified_at", 0.0)
-                                      >= latest.get("modified_at", 0.0)):
-                    latest = r
+            self._maybe_compact_locked(
+                len(payload) + segfile.FRAME_OVERHEAD, lk.cross_locked)
+            view = self._refresh_view(locked=True)
+            latest = view[3].get(job_id)
             if latest is None:
                 return False
             if latest.get("modified_at", 0.0) != expected_modified_at:
                 return False
-            return self._raw_append_locked(line)
+            return self._raw_append_locked(payload)
 
     def index_hpalog(self, log: dict) -> bool:
         return self._append({"_type": "hpalog", **log})
 
     def get(self, job_id: str) -> dict | None:
         """Latest (by modified_at) archived record for one job id."""
-        out = None
-        for rec in self._iter_records():
-            if rec.get("_type") == "document" and rec.get("id") == job_id:
-                if out is None or (rec.get("modified_at", 0.0)
-                                   >= out.get("modified_at", 0.0)):
-                    out = rec
-        return out
+        return self._refresh_view()[3].get(job_id)
 
     # -- reading --
-    def _iter_records(self):
-        # Lock-free streaming scan: a torn tail line from a concurrent
-        # append fails JSON decode and is skipped, so readers don't take
-        # the mutation lock (holding it here blocked index_job for the
-        # whole scan — up to two 64 MB generations per /search call). A
-        # compaction *during* the scan could hide records mid-move (new
-        # ".1" written after we read the old one, active file truncated
-        # after we read it), so detect it — ".1" inode change or active
-        # file shrink — and rescan; consumers are last-write-wins per
-        # id/key, so re-delivered records are harmless. If churn outlasts
-        # the rescans, one final scan runs UNDER the mutation lock
-        # (compaction cannot race it), so a /search never silently
-        # returns a partial view; the fallback is counted for
-        # observability.
-        for _attempt in range(3):
-            sig_before = self._mutation_sig()
-            yield from self._scan_once()
-            sig_after = self._mutation_sig()
-            if (sig_after[0] == sig_before[0]
-                    and sig_after[1] >= sig_before[1]):
-                return
-        self.locked_scan_fallbacks += 1
-        with self._flock():
-            yield from self._scan_once()
-
     def _scan_once(self):
+        """Whole-archive record walk (compaction's input): both
+        generations, per-file format detection, damage skipped."""
         for p in (self.path + ".1", self.path):
-            try:
-                f = open(p)
-            except OSError:
-                continue
-            with f:
-                for line in f:
-                    try:
-                        yield json.loads(line)
-                    except json.JSONDecodeError:
-                        continue  # torn tail write after a crash
+            yield from _parse_file(segfile.read_file(p))
 
     def _mutation_sig(self):
         """(inode of .1, size of active file): compaction replaces .1
         (new inode) and truncates the active file (size shrink) — either
-        tells a lock-free reader its scan may have missed moving records."""
+        tells a lock-free reader its refresh may have missed moving
+        records. Plain appends only GROW the active file, which the
+        incremental view absorbs without a rescan."""
         try:
             ino1 = os.stat(self.path + ".1").st_ino
         except OSError:
@@ -364,6 +444,88 @@ class FileArchive:
             size = 0
         return (ino1, size)
 
+    def _advance_view_locked(self, force_full: bool = False):
+        """Bring the view up to date (caller holds _view_lock) and return
+        it. Same `.1` generation + grown active file -> parse only the new
+        suffix into a copy-on-write successor; anything else (first read,
+        rotation, shrink race) -> full rebuild. The returned tuple is
+        immutable once installed: readers keep iterating their snapshot
+        while the next one lands."""
+        try:
+            ino1 = os.stat(self.path + ".1").st_ino
+        except OSError:
+            ino1 = None
+        v = self._view
+        if not force_full and v is not None and v[0] == ino1:
+            _, framed, scanned, docs, states = v
+            try:
+                size = os.stat(self.path).st_size
+            except OSError:
+                size = 0
+            if size == scanned:
+                return v
+            if size > scanned:
+                try:
+                    with open(self.path, "rb") as f:
+                        f.seek(scanned)
+                        tail = f.read()
+                except OSError:
+                    tail = b""
+                if framed is None:  # file was empty at the last rebuild
+                    framed = tail[:len(segfile.MAGIC)] == segfile.MAGIC
+                recs, consumed = (_parse_framed if framed
+                                  else _parse_legacy)(tail, 0)
+                if not recs and consumed == 0:
+                    return v  # only a torn/in-flight tail: nothing new
+                new_docs = dict(docs)
+                new_states = dict(states)
+                _merge_view(new_docs, new_states, recs)
+                nv = (ino1, framed, scanned + consumed, new_docs, new_states)
+                self._view = nv
+                return nv
+            # size < scanned with an unchanged .1 inode: mid-compaction
+            # race or external truncation — rebuild from scratch
+        docs, states = {}, {}
+        _merge_view(docs, states, _parse_file(
+            segfile.read_file(self.path + ".1")))
+        bufa = segfile.read_file(self.path)
+        framed = (bufa[:len(segfile.MAGIC)] == segfile.MAGIC) if bufa \
+            else None
+        consumed = 0
+        if bufa:
+            recs, consumed = (_parse_framed if framed
+                              else _parse_legacy)(bufa, 0)
+            _merge_view(docs, states, recs)
+        self.view_rebuilds += 1
+        nv = (ino1, framed, consumed, docs, states)
+        self._view = nv
+        return nv
+
+    def _refresh_view(self, locked: bool = False):
+        """Lock-free view refresh with rotation-race protection: a
+        compaction DURING the refresh could hide records mid-move (new
+        `.1` written after we read the old one, active file truncated
+        after we read it), so detect it — `.1` inode change or active
+        file shrink — and retry; the view merge is last-write-wins per
+        id/key, so re-delivered records are harmless. If churn outlasts
+        the retries, one rebuild runs UNDER the mutation lock (compaction
+        cannot race it), so a read never silently serves a partial view;
+        the fallback is counted for observability. ``locked=True`` means
+        the caller already holds the flock (claim_job): one advance is
+        exact by construction."""
+        for _attempt in range(1 if locked else 3):
+            sig_before = self._mutation_sig()
+            with self._view_lock:
+                v = self._advance_view_locked()
+            sig_after = self._mutation_sig()
+            if locked or (sig_after[0] == sig_before[0]
+                          and sig_after[1] >= sig_before[1]):
+                return v
+        self.locked_scan_fallbacks += 1
+        with self._flock():
+            with self._view_lock:
+                return self._advance_view_locked(force_full=True)
+
     def search(self, app=None, namespace=None, status=None, strategy=None,
                limit: int = 50, oldest_first: bool = False) -> list[dict]:
         """Latest record per job id (by its own modified_at), capped.
@@ -373,25 +535,14 @@ class FileArchive:
         stamps, so a newest-first cap at fleet scale would cut exactly
         the records failover exists to find.
 
-        Dedupe happens BEFORE filtering, so a status filter sees only each
-        job's LATEST archived state — the same semantics as ES, where a PUT
-        per id overwrites and a search can never surface a superseded
+        Dedupe happens BEFORE filtering (the view already holds only each
+        job's LATEST archived state) — the same semantics as ES, where a
+        PUT per id overwrites and a search can never surface a superseded
         state. (Filtering first would resurrect a completed job's earlier
         open-status record — fatal for cross-replica adoption, which asks
         the archive for open jobs.)"""
-        by_id: dict[str, dict] = {}
-        for rec in self._iter_records():
-            if rec.get("_type") != "document":
-                continue
-            cur = by_id.get(rec.get("id", ""))
-            # newest by the record's OWN stamp, not append order: with
-            # multiple writers, a wedged peer can append a stale open
-            # record after another replica's terminal one
-            if cur is None or (rec.get("modified_at", 0.0)
-                               >= cur.get("modified_at", 0.0)):
-                by_id[rec.get("id", "")] = rec
         out = [
-            rec for rec in by_id.values()
+            rec for rec in self._refresh_view()[3].values()
             if _match(rec, app, namespace, status, strategy)
         ]
         out.sort(key=lambda r: r.get("modified_at", 0.0),
@@ -405,41 +556,21 @@ class FileArchive:
 
     def get_state(self, key: str):
         """Latest (value, updated_at) for an engine state blob, or None."""
-        best = None
-        for rec in self._iter_records():
-            if rec.get("_type") != "state" or rec.get("key") != key:
-                continue
-            if best is None or rec.get("updated_at", 0.0) >= best[1]:
-                best = (rec.get("value"), rec.get("updated_at", 0.0))
-        return best
+        return self._refresh_view()[4].get(key)
 
     def list_state(self, prefix: str = "") -> dict | None:
         """{key: (value, updated_at)} — latest per key under `prefix`
         (the shard-membership enumeration; engine/sharding.py). Returns a
         dict on success; implementations that can FAIL the read (EsArchive,
         the breaker wrapper) return None instead of {} so callers can keep
-        their previous view through an outage."""
-        sig = self._mutation_sig()
-        cached = self._state_view
-        if cached is None or cached[0] != sig:
-            # full scan, cached against the PRE-scan signature: any append
-            # or compaction racing the scan changes the sig, so the next
-            # call rescans — between archive mutations the shard layer's
-            # per-heartbeat membership read costs a couple of stat(2)s
-            # instead of a streaming parse of both generations
-            best: dict[str, tuple] = {}
-            for rec in self._iter_records():
-                if rec.get("_type") != "state":
-                    continue
-                key = rec.get("key", "")
-                cur = best.get(key)
-                if cur is None or rec.get("updated_at", 0.0) >= cur[1]:
-                    best[key] = (rec.get("value"), rec.get("updated_at", 0.0))
-            cached = (sig, best)
-            self._state_view = cached
+        their previous view through an outage. Served from the
+        incremental view: between mutations the per-heartbeat membership
+        read costs a couple of stat(2)s, and each heartbeat's own append
+        costs one suffix parse — never a two-generation walk."""
+        states = self._refresh_view()[4]
         if not prefix:
-            return dict(cached[1])
-        return {k: v for k, v in cached[1].items() if k.startswith(prefix)}
+            return dict(states)
+        return {k: v for k, v in states.items() if k.startswith(prefix)}
 
 
 class EsArchive:

@@ -20,6 +20,7 @@ implement the same four methods.
 """
 from __future__ import annotations
 
+import heapq
 import json
 import logging
 import os
@@ -28,6 +29,7 @@ import time
 from dataclasses import asdict, dataclass, field
 
 from .archive import _match
+from .jobtier import KIND_DOC, KIND_STATE
 from ..utils.locks import make_lock, make_rlock
 
 log = logging.getLogger("foremast_tpu.engine.jobs")
@@ -213,7 +215,9 @@ class JobStore:
     """
 
     def __init__(self, snapshot_path: str | None = None, archive=None,
-                 mirror_open: bool = True):
+                 mirror_open: bool = True, tier=None,
+                 tier_hot_seconds: float = 300.0,
+                 tier_checkpoint_min_seconds: float = 5.0):
         self._lock = make_rlock("engine.jobs.store")
         self._jobs: dict[str, Document] = {}
         self._hpalogs: list[HpaLog] = []
@@ -266,8 +270,45 @@ class JobStore:
         self._flush_wake = threading.Event()
         self._flusher: threading.Thread | None = None
         self._closed = False
+        # crash-durable tier (engine/jobtier.py): WAL ahead of every
+        # mutation ack; terminal/cold docs + engine state spill to the
+        # CRC-framed segment at checkpoint and EVICT from RAM once the
+        # segment (and the archive, when one exists) confirmed holding
+        # them — the 1M-jobs-per-replica path. None = RAM-only store
+        # with snapshot durability, exactly the pre-tier behavior.
+        self.tier = tier
+        self.tier_hot_seconds = float(tier_hot_seconds)
+        self.tier_checkpoint_min_seconds = float(tier_checkpoint_min_seconds)
+        # id -> modified_at of the doc version the SEGMENT confirmed
+        # holding (the spill analogue of archived_at; same cut-version
+        # rule so a concurrent mutation keeps the doc spill-dirty)
+        self._tier_spilled: dict[str, float] = {}
+        self._tier_state_spilled: dict[str, float] = {}
+        self._tier_last_checkpoint = 0.0
+        self.tier_evictions_total = 0
+        self.tier_recovery: dict = {}
         if snapshot_path:
             self._load()
+
+    # -- tier WAL (record-or-effect: the record lands BEFORE the caller
+    # sees the mutation acknowledged; the effect reaches the segment at
+    # checkpoint, and the rotated WAL generation is only retired once
+    # the spill debt is zero) --
+    def _wal_docs(self, recs) -> None:
+        """WAL post-mutation Document records ahead of the ack. Always
+        called OUTSIDE self._lock (the tier does file I/O); failures
+        degrade inside the tier (counted) — the mutation stays dirty in
+        RAM and the snapshot/checkpoint paths still cover it."""
+        if self.tier is not None and recs:
+            if len(recs) == 1:
+                self.tier.wal_append(KIND_DOC, recs[0])
+            else:
+                self.tier.wal_append_many(KIND_DOC, recs)
+
+    def _wal_state(self, key: str, value, stamp: float) -> None:
+        if self.tier is not None:
+            self.tier.wal_append(KIND_STATE,
+                                 {"k": key, "v": value, "ts": stamp})
 
     # -- documents --
     def create(self, doc: Document) -> tuple[Document, bool]:
@@ -279,11 +320,24 @@ class JobStore:
                 return cur, False
             self._jobs[doc.id] = doc
             self._persist()
-            return doc, True
+            rec = doc.to_json() if self.tier is not None else None
+        self._wal_docs([rec] if rec is not None else [])
+        return doc, True
 
     def get(self, job_id: str) -> Document | None:
         with self._lock:
-            return self._jobs.get(job_id)
+            doc = self._jobs.get(job_id)
+        if doc is None and self.tier is not None:
+            # evicted cold doc: materialize from the segment tier (the
+            # returned copy is READ-ONLY by construction — only terminal
+            # docs evict, and terminal docs never transition again)
+            rec = self.tier.get_doc(job_id)
+            if rec is not None:
+                try:
+                    return Document.from_json(rec)
+                except (TypeError, ValueError):
+                    return None
+        return doc
 
     def transition(self, job_id: str, new_status: str, *, reason: str = "",
                    anomaly: dict | None = None, worker: str = "",
@@ -308,16 +362,32 @@ class JobStore:
                 doc.lease_at = doc.modified_at
             self._persist()
             cut_modified = doc.modified_at
-            archive_rec = (
+            terminal = new_status in TERMINAL_STATUSES
+            rec = (
                 doc.to_json()
-                if self.archive is not None and new_status in TERMINAL_STATUSES
+                if self.tier is not None or (self.archive is not None
+                                             and terminal)
                 else None
             )
-        # archive I/O OUTSIDE the lock: a slow/unreachable archive must not
-        # stall claim/create/status for every other worker and API thread.
-        # Terminal docs never transition again, so the record is stable.
+            archive_rec = rec if self.archive is not None and terminal \
+                else None
+        # WAL ahead of the ack (the caller treats this return as the
+        # acknowledgement), then archive I/O — both OUTSIDE the lock: a
+        # slow disk or unreachable archive must not stall claim/create/
+        # status for every other worker and API thread. Terminal docs
+        # never transition again, so the record is stable.
+        if rec is not None:
+            self._wal_docs([rec])
         if archive_rec is not None and self.archive.index_job(archive_rec):
             doc.archived_at = cut_modified
+            if self.tier is not None:
+                # the archive-confirm mark is itself a WAL'd mutation:
+                # the mirror-drain backlog (archive_dirty_count) must
+                # survive kill -9, or recovery would re-mirror — and a
+                # stale open mirror could shadow this terminal record
+                rec2 = dict(rec)
+                rec2["archived_at"] = cut_modified
+                self._wal_docs([rec2])
         return doc
 
     def claim_open_jobs(self, worker: str, limit: int = 1024,
@@ -377,6 +447,9 @@ class JobStore:
                 self.lease_claims_total += claims
                 self.lease_steals_total += steals
                 self._persist()
+            recs = [d.to_json() for d in out] \
+                if self.tier is not None and out else []
+        self._wal_docs(recs)  # lease claims/steals ack through the WAL
         return out
 
     def release_leases(self, worker: str = "", content_fn=None) -> int:
@@ -399,6 +472,7 @@ class JobStore:
         doc under the store lock). Returns the number of jobs released."""
         now = time.time()
         released = 0
+        recs: list[dict] = []
         with self._lock:
             for doc in self._jobs.values():
                 if doc.status not in OPEN_STATUSES:
@@ -420,6 +494,8 @@ class JobStore:
                 doc.released_at = now
                 doc.modified_at = now
                 released += 1
+                if self.tier is not None:
+                    recs.append(doc.to_json())
             if released:
                 # shutdown is the mirror's last chance: docs parked in
                 # failure backoff re-enter the next cut so the drain can
@@ -428,6 +504,7 @@ class JobStore:
                 self._mirror_backoff.clear()
                 self.lease_releases_total += released
                 self._persist()
+        self._wal_docs(recs)  # handoff stamps survive a kill -9 mid-drain
         return released
 
     def release_unowned(self, owns_fn, worker: str = "",
@@ -446,6 +523,7 @@ class JobStore:
         released ids."""
         now = time.time()
         released: list[str] = []
+        recs: list[dict] = []
         with self._lock:
             for doc in self._jobs.values():
                 if doc.status not in OPEN_STATUSES:
@@ -470,9 +548,12 @@ class JobStore:
                 # any mirror-failure backoff so the next flush retries
                 self._mirror_backoff.pop(doc.id, None)
                 released.append(doc.id)
+                if self.tier is not None:
+                    recs.append(doc.to_json())
             if released:
                 self.lease_releases_total += len(released)
                 self._persist()
+        self._wal_docs(recs)
         return released
 
     def prune_handed_off(self, owns_fn) -> int:
@@ -495,9 +576,16 @@ class JobStore:
             ]
             for jid in dead:
                 del self._jobs[jid]
+                self._tier_spilled.pop(jid, None)
                 dropped += 1
             if dropped:
                 self._persist()
+        if dropped and self.tier is not None:
+            # tombstone the tier copies: a spilled OPEN record of a job
+            # we handed off would be resurrected at the next recovery
+            # and shadow the adopter's eventual terminal verdict —
+            # exactly the stale-copy problem this prune exists to fix
+            self.tier.tombstone_docs(dead)
         return dropped
 
     def archive_dirty_count(self) -> int:
@@ -538,23 +626,48 @@ class JobStore:
                 doc.lease_holder = worker
                 doc.lease_at = doc.modified_at
             self._persist()
-            return doc
+            rec = doc.to_json() if self.tier is not None else None
+        self._wal_docs([rec] if rec is not None else [])
+        return doc
 
     def requeue(self, job_id: str, worker: str = "") -> Document:
         """Back to INITIAL for the next cycle (keeps reason/anomaly/config)."""
         return self.transition(job_id, INITIAL, worker=worker)
 
     def by_status(self, *statuses: str) -> list[Document]:
+        """Live docs plus spilled tier docs (RAM wins per id) — the
+        verdict_digest contract rides on this including EVERY job the
+        store answers for, evicted or not."""
         with self._lock:
-            return [d for d in self._jobs.values() if d.status in statuses]
+            out = [d for d in self._jobs.values() if d.status in statuses]
+            live_ids = set(self._jobs) if self.tier is not None else None
+        if self.tier is not None:
+            # tier iteration outside the store lock: a million spilled
+            # docs must not stall transitions for the duration
+            for rec in self.tier.iter_docs(statuses):
+                if rec.get("id") in live_ids:
+                    continue
+                try:
+                    out.append(Document.from_json(rec))
+                except (TypeError, ValueError):
+                    continue
+        return out
 
     def status_counts(self) -> dict:
-        """{status: count} over the live store (self-metrics gauge)."""
+        """{status: count} over live + spilled jobs (self-metrics gauge).
+        Tier counts come from its index (no parse); the small hot set
+        corrects the overlap for docs living in both places."""
         counts: dict[str, int] = {}
+        if self.tier is not None:
+            counts.update(self.tier.doc_status_counts())
         with self._lock:
             for d in self._jobs.values():
+                if self.tier is not None:
+                    spilled = self.tier.status_of(d.id)
+                    if spilled is not None:
+                        counts[spilled] = counts.get(spilled, 0) - 1
                 counts[d.status] = counts.get(d.status, 0) + 1
-        return counts
+        return {k: v for k, v in counts.items() if v > 0}
 
     @property
     def snapshot_flush_seconds(self) -> float:
@@ -590,13 +703,25 @@ class JobStore:
         breath timers through get_state's archive fallback)."""
         with self._lock:
             self._state[key] = value
-            self._state_updated[key] = time.time()
+            self._state_updated[key] = stamp = time.time()
             self._persist()
+        self._wal_state(key, value, stamp)
 
     def get_state(self, key: str, default=None):
         with self._lock:
             if key in self._state:
                 return self._state[key]
+        # restart with a tier: the blob spilled at the last checkpoint
+        if self.tier is not None:
+            rec = self.tier.get_state(key)
+            if rec is not None:
+                value, stamp = rec
+                with self._lock:
+                    if key not in self._state:  # don't clobber a local write
+                        self._state[key] = value
+                        self._state_updated[key] = stamp
+                        self._tier_state_spilled[key] = stamp
+                    return self._state[key]
         # fresh replacement runtime: fall back to the peer-mirrored blob
         if self.archive is not None and hasattr(self.archive, "get_state"):
             rec = self.archive.get_state(key)
@@ -630,6 +755,7 @@ class JobStore:
                 and now - doc.modified_at > max_age_seconds
             ]
         dropped = 0
+        marked: list[dict] = []
         for doc in candidates:  # archive I/O outside the lock
             if doc.archived_at < doc.modified_at:
                 # the archive's record (if any) predates this version —
@@ -639,10 +765,14 @@ class JobStore:
                 if not self.archive.index_job(doc.to_json()):
                     continue  # archive unavailable: keep the job in RAM
                 doc.archived_at = cut_modified
+                if self.tier is not None:
+                    marked.append(doc.to_json())
             with self._lock:
                 if self._jobs.get(doc.id) is doc:  # not re-created meanwhile
                     del self._jobs[doc.id]
+                    self._tier_spilled.pop(doc.id, None)
                     dropped += 1
+        self._wal_docs(marked)
         if dropped:
             with self._lock:
                 self._persist()
@@ -665,6 +795,19 @@ class JobStore:
                           app, namespace, statuses, strategy)
             ]
         seen = {r["id"] for r in live}
+        if self.tier is not None:
+            # stream the spilled tier through a bounded top-N heap: the
+            # tier can hold a million docs and /jobs only wants `limit`
+            matches = (
+                rec for rec in self.tier.iter_docs(statuses)
+                if rec.get("id") not in seen
+                and _match(rec, app, namespace, statuses, strategy)
+            )
+            for rec in heapq.nlargest(
+                    limit, matches,
+                    key=lambda r: r.get("modified_at", 0.0)):
+                live.append(rec)
+                seen.add(rec.get("id"))
         if self.archive is not None:
             for rec in self.archive.search(app=app, namespace=namespace,
                                            status=statuses, strategy=strategy,
@@ -861,6 +1004,7 @@ class JobStore:
                 > self._state_archived.get(k, 0.0)
             ]
         consecutive_failures = 0
+        marked: list[dict] = []
         for doc, rec, cut_modified in cut:  # archive I/O outside the lock
             ok = self.archive.index_job(rec)
             with self._lock:  # backoff map is read by /metrics threads
@@ -871,6 +1015,8 @@ class JobStore:
                     # keeps archived_at < modified_at and re-mirrors next
                     # flush
                     doc.archived_at = max(doc.archived_at, cut_modified)
+                    if self.tier is not None:
+                        marked.append(doc.to_json())
                 else:
                     # a failed write parks THIS doc in a doubling backoff
                     # and moves on, so a permanently-rejected doc cannot
@@ -902,6 +1048,10 @@ class JobStore:
                 self._mirror_backoff = {
                     k: v for k, v in self._mirror_backoff.items()
                     if v[0] > now}
+        # archive-confirm marks are WAL'd so the drain backlog
+        # (archive_dirty_count) survives kill -9 instead of re-mirroring
+        # the whole cut on every restart
+        self._wal_docs(marked)
         if hasattr(self.archive, "index_state"):
             for key, value, stamp in state_cut:
                 if self.archive.index_state(key, value, stamp):
@@ -974,6 +1124,7 @@ class JobStore:
             return 0
         now = time.time() if now is None else now
         adopted = 0
+        adopted_recs: list[dict] = []
         claim_cas = getattr(self.archive, "claim_job", None)
         # oldest_first: stale jobs have the OLDEST stamps; a newest-first
         # cap at fleet scale would return only the healthy churn
@@ -1049,21 +1200,208 @@ class JobStore:
                 self.adopted_total += 1
                 adopted += 1
                 self._persist()
+                if self.tier is not None:
+                    adopted_recs.append(doc.to_json())
             if on_adopt is not None:
                 try:
                     on_adopt(doc)
                 except Exception:  # noqa: BLE001 - observer, never fatal
                     log.warning("on_adopt hook failed for %s", doc.id,
                                 exc_info=True)
+        self._wal_docs(adopted_recs)  # adoptions survive a kill -9 too
         return adopted
+
+    # -- tier checkpoint / recovery --
+    def tier_checkpoint(self, force: bool = False) -> dict:
+        """Rotate the tier WAL -> spill every dirty record into the
+        segment -> retire the rotated generation once the spill debt is
+        zero -> evict cold terminal docs from RAM.
+
+        Record-or-effect: a mutation is either in a WAL generation
+        (rotated or current) or in the segment at every instant, so a
+        crash anywhere inside this sequence loses nothing — at worst
+        the next recovery replays records whose effects already landed,
+        which the newest-wins apply counts as stale no-ops. Rate
+        limited (tier_checkpoint_min_seconds) so the runtime can call
+        it every sweep."""
+        if self.tier is None:
+            return {}
+        now_mono = time.monotonic()
+        if not force and (now_mono - self._tier_last_checkpoint
+                          < self.tier_checkpoint_min_seconds):
+            return {}
+        self._tier_last_checkpoint = now_mono
+        t0 = time.monotonic()
+        self.tier.rotate_wal()  # no-op if a prior generation's debt holds
+        with self._lock:
+            cut = [
+                (doc.id, doc.modified_at, doc.to_json())
+                for doc in self._jobs.values()
+                if self._tier_spilled.get(doc.id, -1.0) < doc.modified_at
+            ]
+            state_cut = [
+                (k, self._state[k], self._state_updated.get(k, 0.0))
+                for k in self._state
+                if self._tier_state_spilled.get(k, -1.0)
+                < self._state_updated.get(k, 0.0)
+            ]
+        # spill OUTSIDE the lock (disk I/O); the cut-version stamps keep
+        # docs mutated mid-spill dirty for the next round
+        wrote = self.tier.spill_docs([rec for _, _, rec in cut])
+        debt = len(cut) - wrote
+        with self._lock:
+            for jid, cut_modified, _rec in cut[:wrote]:
+                self._tier_spilled[jid] = max(
+                    self._tier_spilled.get(jid, -1.0), cut_modified)
+        for key, value, stamp in state_cut:
+            if self.tier.spill_state(key, value, stamp):
+                with self._lock:
+                    self._tier_state_spilled[key] = max(
+                        self._tier_state_spilled.get(key, -1.0), stamp)
+            else:
+                debt += 1
+        if debt == 0:
+            self.tier.retire_wal()
+        evicted = self._evict_cold()
+        stats = {
+            "spilled": wrote,
+            "spill_debt": debt,
+            "evicted": evicted,
+            "seconds": round(time.monotonic() - t0, 4),
+        }
+        self.tier._observe_duration("checkpoint", time.monotonic() - t0)
+        return stats
+
+    def tier_snapshot(self) -> dict:
+        """Tier section for /status and /metrics: the tier's own disk
+        footprint + traffic, this store's eviction count, and what the
+        last boot replayed."""
+        if self.tier is None:
+            return {}
+        out = self.tier.snapshot()
+        out["evictions"] = self.tier_evictions_total
+        out["recovery"] = dict(self.tier_recovery)
+        return out
+
+    def _evict_cold(self) -> int:
+        """Drop terminal docs from RAM once every durable medium that
+        answers for them confirmed holding the current version: the
+        segment always, the archive too when one exists. The hot window
+        keeps recent verdicts as objects for the API's read-mostly
+        traffic; everything colder is served from the segment mmap."""
+        now = time.time()
+        with self._lock:
+            dead = [
+                doc.id for doc in self._jobs.values()
+                if doc.status in TERMINAL_STATUSES
+                and self._tier_spilled.get(doc.id, -1.0) >= doc.modified_at
+                and (self.archive is None
+                     or doc.archived_at >= doc.modified_at)
+                and now - doc.modified_at > self.tier_hot_seconds
+            ]
+            for jid in dead:
+                del self._jobs[jid]
+                self._tier_spilled.pop(jid, None)
+                self._mirror_backoff.pop(jid, None)
+            if dead:
+                self.tier_evictions_total += len(dead)
+                self._persist()  # the snapshot must not resurrect them
+        return len(dead)
+
+    def _apply_replay(self, kind: str, obj) -> str:
+        """Apply one WAL record with the SAME newest-wins rule live
+        mutation follows — the replay path is the transition path's
+        idempotent twin. A record the store (RAM or segment) already
+        reflects is a counted ``stale`` no-op; equal-stamp records
+        tie-break on archived_at so a crash between the archive-confirm
+        mark and its spill still recovers the mark."""
+        if kind == KIND_DOC:
+            try:
+                doc = Document.from_json(obj)
+            except (TypeError, ValueError):
+                return "dropped"
+            with self._lock:
+                cur = self._jobs.get(doc.id)
+                cur_mod = cur.modified_at if cur is not None else None
+                cur_arch = cur.archived_at if cur is not None else 0.0
+            if cur_mod is None:
+                seg = self.tier.get_doc(doc.id)  # outside the store lock
+                if seg is not None:
+                    cur_mod = float(seg.get("modified_at", 0.0))
+                    cur_arch = float(seg.get("archived_at", 0.0))
+            if cur_mod is not None and (
+                    doc.modified_at < cur_mod
+                    or (doc.modified_at == cur_mod
+                        and doc.archived_at <= cur_arch)):
+                return "stale"
+            with self._lock:
+                cur = self._jobs.get(doc.id)
+                if cur is not None and (
+                        doc.modified_at < cur.modified_at
+                        or (doc.modified_at == cur.modified_at
+                            and doc.archived_at <= cur.archived_at)):
+                    return "stale"
+                self._jobs[doc.id] = doc  # tier-dirty: absent from
+                #                           _tier_spilled, spills next
+                #                           checkpoint
+            return "applied"
+        if kind == KIND_STATE:
+            key = obj.get("k") if isinstance(obj, dict) else None
+            if key is None:
+                return "dropped"
+            stamp = float(obj.get("ts", 0.0))
+            seg = self.tier.get_state(key)
+            seg_stamp = seg[1] if seg is not None else -1.0
+            with self._lock:
+                if (self._state_updated.get(key, -1.0) >= stamp
+                        or seg_stamp >= stamp):
+                    return "stale"
+                self._state[key] = obj.get("v")
+                self._state_updated[key] = stamp
+            return "applied"
+        return "dropped"
+
+    def recover_from_tier(self) -> dict:
+        """Boot-time recovery: rebuild the segment index, materialize
+        every OPEN doc into RAM (this replica must re-claim its
+        in-flight fleet; terminal docs stay in the segment), replay the
+        WAL generations through _apply_replay, then checkpoint so the
+        WAL restarts empty. Runs after _load() so WAL/segment records
+        newer than the snapshot win."""
+        if self.tier is None:
+            return {}
+        t0 = time.monotonic()
+        stats = self.tier.recover(self._apply_replay)
+        restored = 0
+        for rec in self.tier.iter_docs(OPEN_STATUSES):
+            try:
+                doc = Document.from_json(rec)
+            except (TypeError, ValueError):
+                continue
+            with self._lock:
+                cur = self._jobs.get(doc.id)
+                if cur is not None and cur.modified_at >= doc.modified_at:
+                    continue
+                self._jobs[doc.id] = doc
+                # the segment IS the spilled version
+                self._tier_spilled[doc.id] = doc.modified_at
+                restored += 1
+        stats["open_docs_restored"] = restored
+        stats["seconds"] = round(time.monotonic() - t0, 4)
+        self.tier_recovery = stats
+        self.tier_checkpoint(force=True)
+        return dict(stats)
 
     def close(self):
         """Final flush + stop the background flusher (idempotent)."""
+        already = self._closed
         self._closed = True
         self._flush_wake.set()
         if self._flusher is not None:
             self._flusher.join(timeout=5.0)
         self.flush()
+        if self.tier is not None and not already:
+            self.tier_checkpoint(force=True)
 
     def _load(self):
         if not os.path.exists(self._snapshot_path):

@@ -1,0 +1,524 @@
+"""Tiered, crash-durable job store backing: segments + WAL for jobs.
+
+PR 13 made the *window* store crash-durable; this module extends the
+same machinery (``dataplane/segfile.py`` CRC framing) to the JOB store
+and provenance records — the last state surface where a kill -9 could
+forfeit acked work, and the RAM ceiling between the measured 100k
+simfleet run and 1M jobs per replica:
+
+  * **segment tier** (``jobs.seg``) — terminal/cold job ``Document``s,
+    closed provenance records, and engine state blobs live as framed
+    ``key\\x00status\\x00body`` payloads with newest-wins compaction.
+    The index keeps only ``(offset, length, status)`` per key (~100
+    bytes), so a million spilled jobs cost index entries, not Python
+    object graphs; reads mmap the body on demand.
+  * **WAL** (``wal.log``/``wal.old``) — every acknowledged job-store
+    mutation (create, transition, lease claim/steal/release, adoption,
+    state write) appends the full post-mutation record BEFORE the call
+    returns. Replay is newest-wins by ``modified_at``/stamp, so it is
+    idempotent: a record the store already reflects is a counted
+    ``stale`` no-op, and replay-twice == replay-once.
+  * **record-or-effect** — the checkpoint rotates the WAL, spills every
+    dirty record into the segment, and only unlinks the rotated
+    generation once the spill debt is zero. A crash anywhere leaves
+    each mutation either in a WAL generation or in the segment.
+
+Failure policy mirrors the window store: append failures (disk full,
+EIO, the ``disk=`` chaos shape) DEGRADE — counted, logged once per
+breath, never raised to the mutating caller — because durability must
+not turn disk pressure into a scoring outage. The record stays dirty
+and retries at the next checkpoint.
+
+Threading: the engine's cycle thread and API threads mutate through
+``JobStore`` (which serializes on its own lock); the tier serializes
+file access on two leaf locks (WAL, segment) that are never held
+together with the store lock held by the same caller path twice —
+``JobStore`` always calls the tier OUTSIDE its own lock.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import mmap
+import os
+import time
+
+from ..dataplane import segfile
+from ..dataplane.segfile import SCAN_OK
+from ..utils.locks import make_lock
+
+log = logging.getLogger("foremast_tpu.engine.jobtier")
+
+__all__ = ["JobTier", "KIND_DOC", "KIND_STATE"]
+
+# WAL record kinds (payload prefix byte before the first NUL)
+KIND_DOC = "d"      # full post-mutation Document JSON
+KIND_STATE = "s"    # {"k": key, "v": value, "ts": stamp}
+
+# segment key prefixes
+_K_DOC = "j:"       # job documents (status column = doc.status)
+_K_PROV = "p:"      # closed provenance records (status column empty)
+_K_STATE = "s:"     # engine state blobs (body {"v":..., "ts":...})
+
+
+def _split_payload(payload: bytes) -> tuple[str, str, int] | None:
+    """``key\\x00status\\x00body`` -> (key, status, body_offset) or None.
+    Only the two NUL-terminated prefixes are decoded — index builds over
+    a million frames must not pay a JSON parse per record."""
+    n1 = payload.find(b"\x00")
+    if n1 <= 0:
+        return None
+    n2 = payload.find(b"\x00", n1 + 1)
+    if n2 < 0:
+        return None
+    try:
+        return (payload[:n1].decode(), payload[n1 + 1:n2].decode(), n2 + 1)
+    except UnicodeDecodeError:
+        return None
+
+
+class JobTier:
+    """Durable segment + WAL tier under one directory.
+
+    ``injector`` is a resilience/faults.py FaultInjector carrying the
+    ``disk=PROB[:kind]`` chaos plan; its decisions surface at every
+    append seam (segment and WAL alike)."""
+
+    def __init__(self, dirpath: str, segment_max_bytes: int = 512 << 20,
+                 fsync: bool = False, injector=None, exporter=None):
+        os.makedirs(dirpath, exist_ok=True)
+        self.dir = dirpath
+        self.seg_path = os.path.join(dirpath, "jobs.seg")
+        self.wal_path = os.path.join(dirpath, "wal.log")
+        self.wal_old_path = os.path.join(dirpath, "wal.old")
+        self.segment_max_bytes = int(segment_max_bytes)
+        self.fsync = bool(fsync)
+        self.injector = injector
+        self.exporter = exporter
+        self._wal_lock = make_lock("engine.jobtier.wal")
+        self._seg_lock = make_lock("engine.jobtier.segment")
+        # key -> (body_off, body_len, status) in the CURRENT segment file
+        self._index: dict[str, tuple[int, int, str]] = {}
+        # doc status -> count over _K_DOC keys (kept incrementally so
+        # /status never walks a million index entries)
+        self._counts: dict[str, int] = {}
+        self._seg_mm: mmap.mmap | None = None
+        self._seg_mm_size = 0
+        # observability counters (exposed on /metrics + /status)
+        self.spills = 0
+        self.spill_errors = 0
+        self.compactions = 0
+        self.wal_records = 0
+        self.wal_errors = 0
+        self.recovery: dict = {}
+        self._last_err_log = 0.0
+
+    # ------------------------------------------------------------- helpers
+    def _degrade(self, what: str, e: Exception) -> None:
+        """Log disk trouble at most once per 5 s breath — a full disk
+        under a 1M-job fleet must not emit a log line per mutation."""
+        now = time.monotonic()
+        if now - self._last_err_log >= 5.0:
+            self._last_err_log = now
+            log.warning("job tier %s failed (degrading, will retry at "
+                        "next checkpoint): %s", what, e)
+
+    def _seg_buffer(self):
+        """mmap over the current segment (remade on growth). Readers keep
+        old views valid across compaction renames — POSIX keeps the
+        mapping alive after os.replace."""
+        size = os.path.getsize(self.seg_path) \
+            if os.path.exists(self.seg_path) else 0
+        if size == 0:
+            return None
+        if self._seg_mm is None or self._seg_mm_size != size:
+            fd = os.open(self.seg_path, os.O_RDONLY)
+            try:
+                self._seg_mm = mmap.mmap(fd, size, access=mmap.ACCESS_READ)
+                self._seg_mm_size = size
+            finally:
+                os.close(fd)
+        return self._seg_mm
+
+    def _observe_duration(self, kind: str, seconds: float):
+        if self.exporter is not None:
+            self.exporter.record_histogram(
+                "foremastbrain:job_store_checkpoint_seconds",
+                {"kind": kind}, max(float(seconds), 0.0),
+                help="Job-store checkpoint (WAL rotate + dirty spill + "
+                     "retire) and boot recovery durations in seconds, "
+                     "by kind.")
+
+    # ------------------------------------------------------------------ WAL
+    def wal_append(self, kind: str, obj) -> bool:
+        """Append one mutation record BEFORE the store acks it. Failures
+        degrade (counted): the mutation stays dirty in RAM and reaches
+        the segment at the next checkpoint instead."""
+        return self.wal_append_many(kind, (obj,))
+
+    def wal_append_many(self, kind: str, objs) -> bool:
+        """Batch variant: claim sweeps lease hundreds of docs per call;
+        one fd open + one locked write sequence covers them all."""
+        payloads = [kind.encode() + b"\x00" + json.dumps(o).encode()
+                    for o in objs]
+        if not payloads:
+            return True
+        t0 = time.monotonic()
+        with self._wal_lock:
+            try:
+                _, wrote = segfile.append_frames(
+                    self.wal_path, payloads, fsync=self.fsync,
+                    injector=self.injector)
+            except OSError as e:
+                self.wal_errors += 1
+                self.wal_records += getattr(e, "frames_written", 0)
+                self._degrade("WAL append", e)
+                return False
+            self.wal_records += wrote
+        if self.exporter is not None:
+            self.exporter.record_histogram(
+                "foremastbrain:job_store_wal_append_seconds", {},
+                time.monotonic() - t0,
+                help="One job-store WAL append batch (write + optional "
+                     "fsync) in seconds; rising tails signal disk "
+                     "pressure before job_store_wal_errors does.")
+        return True
+
+    def wal_size(self) -> int:
+        try:
+            return os.path.getsize(self.wal_path)
+        except OSError:
+            return 0
+
+    # -------------------------------------------------------------- segment
+    def _spill_many_locked(self, entries) -> int:
+        """Append ``(key, status, body_bytes)`` frames; index what
+        landed. Returns the number written (a mid-batch disk failure
+        keeps the completed prefix — segfile truncates back to the last
+        frame boundary)."""
+        payloads = []
+        metas = []
+        for key, status, body in entries:
+            payload = (key.encode() + b"\x00" + status.encode() + b"\x00"
+                       + body)
+            payloads.append(payload)
+            metas.append((key, status,
+                          len(key.encode()) + len(status.encode()) + 2,
+                          len(body)))
+        if not payloads:
+            return 0
+        base = os.path.getsize(self.seg_path) \
+            if os.path.exists(self.seg_path) else 0
+        wrote = len(payloads)
+        err = None
+        try:
+            _, wrote = segfile.append_frames(
+                self.seg_path, payloads, fsync=self.fsync,
+                injector=self.injector)
+        except OSError as e:
+            wrote = getattr(e, "frames_written", 0)
+            err = e
+        off = base
+        for i in range(wrote):
+            key, status, body_rel, body_len = metas[i]
+            off += segfile.FRAME_OVERHEAD
+            self._note_index_locked(key, status,
+                                    (off + body_rel, body_len, status))
+            off += len(payloads[i])
+        self.spills += wrote
+        if err is not None:
+            self.spill_errors += 1
+            self._degrade("segment spill", err)
+        elif os.path.getsize(self.seg_path) > self.segment_max_bytes:
+            self._compact_locked()
+        return wrote
+
+    def _note_index_locked(self, key: str, status: str, slot) -> None:
+        """An empty body (slot length 0) is a TOMBSTONE: the key leaves
+        the index, and the next compaction erases both the tombstone and
+        whatever it shadowed."""
+        tombstone = slot[1] == 0
+        if key.startswith(_K_DOC):
+            prev = self._index.get(key)
+            if prev is not None:
+                self._counts[prev[2]] = self._counts.get(prev[2], 1) - 1
+            if not tombstone:
+                self._counts[status] = self._counts.get(status, 0) + 1
+        if tombstone:
+            self._index.pop(key, None)
+        else:
+            self._index[key] = slot
+
+    def spill_docs(self, recs) -> int:
+        """Spill full Document JSON dicts; returns how many landed."""
+        entries = [(_K_DOC + r["id"], r.get("status", ""),
+                    json.dumps(r).encode()) for r in recs]
+        with self._seg_lock:
+            return self._spill_many_locked(entries)
+
+    def tombstone_docs(self, job_ids) -> int:
+        """Erase spilled docs (handed-off jobs whose record of truth
+        moved to the archive for a peer): an empty-body frame drops the
+        key now, compaction reclaims the bytes later."""
+        entries = [(_K_DOC + jid, "", b"") for jid in job_ids]
+        with self._seg_lock:
+            return self._spill_many_locked(entries)
+
+    def spill_prov(self, job_id: str, rec: dict) -> bool:
+        """Spill one CLOSED provenance record (terminal verdicts close
+        the hop chain + detection annotations; the record never mutates
+        again, so it goes straight to the segment — no WAL hop)."""
+        with self._seg_lock:
+            return self._spill_many_locked(
+                [(_K_PROV + job_id, "", json.dumps(rec).encode())]) == 1
+
+    def spill_state(self, key: str, value, stamp: float) -> bool:
+        body = json.dumps({"v": value, "ts": stamp}).encode()
+        with self._seg_lock:
+            return self._spill_many_locked(
+                [(_K_STATE + key, "", body)]) == 1
+
+    def _read_locked(self, key: str) -> bytes | None:
+        slot = self._index.get(key)
+        if slot is None:
+            return None
+        off, length, _ = slot
+        buf = self._seg_buffer()
+        if buf is None or off + length > len(buf):
+            return None
+        return bytes(buf[off:off + length])
+
+    def get_doc(self, job_id: str) -> dict | None:
+        with self._seg_lock:
+            raw = self._read_locked(_K_DOC + job_id)
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def get_prov(self, job_id: str) -> dict | None:
+        with self._seg_lock:
+            raw = self._read_locked(_K_PROV + job_id)
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def get_state(self, key: str) -> tuple[object, float] | None:
+        with self._seg_lock:
+            raw = self._read_locked(_K_STATE + key)
+        if not raw:
+            return None
+        try:
+            rec = json.loads(raw)
+            return rec["v"], float(rec.get("ts", 0.0))
+        except (ValueError, KeyError, TypeError):
+            return None
+
+    def status_of(self, job_id: str) -> str | None:
+        """O(1): the spilled doc's status from the index, no parse."""
+        with self._seg_lock:
+            slot = self._index.get(_K_DOC + job_id)
+            return slot[2] if slot is not None else None
+
+    def doc_count(self) -> int:
+        with self._seg_lock:
+            return sum(self._counts.values())
+
+    def doc_status_counts(self) -> dict:
+        with self._seg_lock:
+            return {k: v for k, v in self._counts.items() if v > 0}
+
+    def snapshot(self) -> dict:
+        """Point-in-time tier health for /status and /metrics: on-disk
+        footprint plus the WAL/spill traffic counters."""
+        try:
+            seg_bytes = os.path.getsize(self.seg_path)
+        except OSError:
+            seg_bytes = 0
+        with self._seg_lock:
+            entries = len(self._index)
+        return {
+            "segment_bytes": seg_bytes,
+            "segment_entries": entries,
+            "docs": self.doc_count(),
+            "wal_bytes": self.wal_size(),
+            "wal_records": self.wal_records,
+            "wal_errors": self.wal_errors,
+            "spills": self.spills,
+            "spill_errors": self.spill_errors,
+            "compactions": self.compactions,
+        }
+
+    def iter_docs(self, statuses=None):
+        """Yield spilled Document JSON dicts (optionally filtered by
+        status WITHOUT parsing non-matching bodies). The index cut and
+        the mmap ref are taken together under the lock; parsing runs
+        outside it — an old view stays valid across a concurrent
+        compaction, it just misses records spilled after the cut."""
+        want = set(statuses) if statuses is not None else None
+        with self._seg_lock:
+            buf = self._seg_buffer()
+            items = [(off, length) for key, (off, length, status)
+                     in self._index.items()
+                     if key.startswith(_K_DOC)
+                     and (want is None or status in want)]
+        if buf is None:
+            return
+        n = len(buf)
+        for off, length in items:
+            if off + length > n:
+                continue
+            try:
+                yield json.loads(buf[off:off + length])
+            except ValueError:
+                continue
+
+    def _compact_locked(self) -> None:
+        """Newest-wins rewrite: keep only each key's latest record.
+        Atomic — build ``.tmp``, fsync, rename over, re-point index."""
+        buf = self._seg_buffer()
+        if buf is None:
+            return
+        tmp = self.seg_path + ".tmp"
+        new_index: dict[str, tuple[int, int, str]] = {}
+        off = 0
+        with open(tmp, "wb") as f:
+            for key, (o, length, status) in self._index.items():
+                if o + length > len(buf):
+                    continue
+                body = buf[o:o + length]
+                payload = (key.encode() + b"\x00" + status.encode()
+                           + b"\x00" + body)
+                f.write(segfile.frame(payload))
+                body_rel = len(payload) - length
+                new_index[key] = (off + segfile.FRAME_OVERHEAD + body_rel,
+                                  length, status)
+                off += segfile.FRAME_OVERHEAD + len(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.seg_path)
+        self._index = new_index
+        self._seg_mm = None  # old views stay valid; next read re-maps
+        self._seg_mm_size = 0
+        self.compactions += 1
+
+    def compact(self) -> None:
+        with self._seg_lock:
+            self._compact_locked()
+
+    def _build_index_locked(self) -> tuple[int, str]:
+        """Rebuild the index from the segment file. Segment records are
+        independent newest-wins states — ORDER carries no meaning — so
+        the walk RESUMES past damage at the next CRC-valid frame, then
+        compacts so valid frames never sit behind unparseable bytes."""
+        self._index = {}
+        self._counts = {}
+        self._seg_mm = None
+        self._seg_mm_size = 0
+        buf = self._seg_buffer()
+        if buf is None:
+            return 0, SCAN_OK
+        total, status, pos = 0, SCAN_OK, 0
+        while True:
+            frames, st, bad = segfile.scan(buf, pos)
+            for off, length in frames:
+                parsed = _split_payload(bytes(buf[off:off + length]))
+                if parsed is None:
+                    continue
+                key, doc_status, body_rel = parsed
+                self._note_index_locked(
+                    key, doc_status,
+                    (off + body_rel, length - body_rel, doc_status))
+                total += 1
+            if st == SCAN_OK:
+                break
+            status = st
+            nxt = segfile.next_valid_frame(buf, bad + 1)
+            if nxt == -1:
+                break
+            pos = nxt
+        if status != SCAN_OK:
+            try:
+                self._compact_locked()
+            except OSError as e:
+                log.warning("segment rewrite after bad scan failed: %s", e)
+        return total, status
+
+    # ------------------------------------------------- recovery/checkpoint
+    def recover(self, apply_fn) -> dict:
+        """Boot-time replay. Rebuild the segment index, then replay
+        ``wal.old`` + ``wal.log`` IN ORDER through ``apply_fn(kind,
+        obj) -> 'applied'|'stale'|'dropped'`` (JobStore wires this to
+        its newest-wins install — the same rule live mutation uses, so
+        replay is idempotent and a twice-replayed WAL is all stale
+        no-ops the second time). WAL order matters, so the replay walk
+        STOPS at damage instead of salvaging past it."""
+        t0 = time.monotonic()
+        with self._seg_lock:
+            seg_frames, seg_status = self._build_index_locked()
+        replayed = stale = dropped = 0
+        wal_status = SCAN_OK
+        with self._wal_lock:
+            for path in (self.wal_old_path, self.wal_path):
+                buf = segfile.read_file(path)
+                if not buf:
+                    continue
+                frames, st, _ = segfile.scan(buf)
+                if st != SCAN_OK:
+                    wal_status = st
+                for off, length in frames:
+                    payload = buf[off:off + length]
+                    n1 = payload.find(b"\x00")
+                    if n1 <= 0:
+                        dropped += 1
+                        continue
+                    try:
+                        obj = json.loads(payload[n1 + 1:])
+                    except ValueError:
+                        dropped += 1
+                        continue
+                    verdict = apply_fn(payload[:n1].decode(), obj)
+                    if verdict == "applied":
+                        replayed += 1
+                    elif verdict == "stale":
+                        stale += 1
+                    else:
+                        dropped += 1
+        self.recovery = {
+            "segment_frames": seg_frames,
+            "segment_docs": self.doc_count(),
+            "segment_scan": seg_status,
+            "wal_records_replayed": replayed,
+            "wal_records_stale": stale,
+            "wal_records_dropped": dropped,
+            "wal_scan": wal_status,
+            "seconds": round(time.monotonic() - t0, 4),
+        }
+        self._observe_duration("recovery", time.monotonic() - t0)
+        return dict(self.recovery)
+
+    def rotate_wal(self) -> bool:
+        """Rename ``wal.log`` -> ``wal.old`` (start a fresh generation).
+        No-op when a previous rotation's generation still exists — its
+        spill debt has not cleared, and records must never be lost to a
+        double rotation."""
+        with self._wal_lock:
+            if os.path.exists(self.wal_old_path):
+                return False
+            if os.path.exists(self.wal_path):
+                os.replace(self.wal_path, self.wal_old_path)
+            return True
+
+    def retire_wal(self) -> None:
+        """Drop the rotated generation — caller asserts zero spill debt
+        (every record in it now has its effect in the segment)."""
+        with self._wal_lock:
+            try:
+                os.unlink(self.wal_old_path)
+            except FileNotFoundError:
+                pass

@@ -26,12 +26,15 @@ runbook can enumerate.
 from __future__ import annotations
 
 import json
+import logging
 import time
 from collections import OrderedDict, deque
 
 from .jobs import TERMINAL_STATUSES
 from ..utils import tracing
 from ..utils.locks import make_lock
+
+log = logging.getLogger("foremast_tpu.engine.provenance")
 
 __all__ = [
     "ProvenanceRecorder", "PATHS",
@@ -103,6 +106,15 @@ class ProvenanceRecorder:
         self._cycle: dict = {}        # shared per-cycle block (stamped late)
         self._cycle_records: int = 0  # records written this cycle
         self.records_total = 0
+        # durable spill hook (engine/jobtier.py JobTier.spill_prov): a
+        # TERMINAL record closes the job's chain and never mutates
+        # again, so it goes to the segment tier the moment it is
+        # written — `explain` then outlives the LRU, gc, and kill -9.
+        # Called OUTSIDE the recorder lock (it does file I/O);
+        # best-effort — a full disk must not fail the scoring cycle.
+        self.spill = None
+        self.spills_total = 0
+        self.spill_failures_total = 0
 
     # ------------------------------------------------------------- writing
     def begin_cycle(self, cycle_id: str, worker: str = ""):
@@ -176,6 +188,21 @@ class ProvenanceRecorder:
             self._ring.append(rec)
             self._cycle_records += 1
             self.records_total += 1
+        if self.spill is not None and status in TERMINAL_STATUSES:
+            # same slimming the archive summary applies: keep the
+            # attribution skeleton, drop the bulky shared cycle block
+            # (which finish_cycle would mutate AFTER this spill anyway)
+            slim = {k: v for k, v in rec.items() if k != "cycle"}
+            slim["cycle_id"] = (self._cycle or {}).get("cycle_id", "")
+            try:
+                if self.spill(job_id, slim):
+                    self.spills_total += 1
+                else:
+                    self.spill_failures_total += 1
+            except Exception as e:  # noqa: BLE001 - observer, never fatal
+                self.spill_failures_total += 1
+                log.warning("provenance spill failed for %s: %s",
+                            job_id, e)
 
     def finish_cycle(self, stage_seconds: dict | None = None,
                      device_launches: int | None = None,

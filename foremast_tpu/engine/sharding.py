@@ -230,6 +230,10 @@ class ShardManager:
         self.handoffs_total = 0
         self.adoptions_total = 0
         self.membership_read_failures = 0
+        # dead member-incarnation state blobs shed via the archive's
+        # delete_state during membership refresh (EsArchive hygiene;
+        # FileArchive ages them out at compaction instead)
+        self.member_prunes_total = 0
         self.last_rebalance_at = 0.0
 
     # ------------------------------------------------------------ ownership
@@ -473,7 +477,8 @@ class ShardManager:
                 if (prune is not None and pruned < 8
                         and now - stamp > KEEP_MEMBER_SECONDS):
                     try:
-                        prune(key)
+                        if prune(key):
+                            self.member_prunes_total += 1
                         pruned += 1
                     except Exception:  # noqa: BLE001 - hygiene only
                         pass
@@ -696,6 +701,7 @@ class ShardManager:
             "handoffs_total": self.handoffs_total,
             "adoptions_total": self.adoptions_total,
             "membership_read_failures": self.membership_read_failures,
+            "member_prunes_total": self.member_prunes_total,
             "heartbeat_seconds": self.heartbeat_seconds,
             "member_ttl_seconds": self.member_ttl_seconds,
         }

@@ -9,6 +9,13 @@ FOREMAST_CHAOS grammar (full reference: docs/resilience.md):
 
     spec    := clause (';' clause)*
     clause  := 'seed=' INT
+             | 'disk=' PROB [':' kind]        store append-seam faults:
+                                              kind := 'short' (detected
+                                              short write, rolled back) |
+                                              'enospc' | 'eio'; injected
+                                              at the job-store segment +
+                                              WAL appends
+                                              (dataplane/segfile.py)
              | target '.' fault '=' value
     target  := 'fetch' | 'archive' | 'kube' | 'push' | 'wal'
     fault   := 'error'   '=' PROB            random injected error
@@ -124,6 +131,12 @@ class FaultPlan:
     # torn WAL writes (target ``wal``; dataplane/winstore.py): the frame
     # reaches the disk only half-way, as a crash mid-append would leave it
     torn_rate: float = 0.0
+    # disk faults at the store append seams (target ``disk``;
+    # dataplane/segfile.py): a detected short write (rolled back), an
+    # ENOSPC, or an EIO — the disk-pressure failures the job-store WAL
+    # and segment spill paths must degrade under
+    disk_rate: float = 0.0
+    disk_kind: str = "short"
 
     def active(self) -> bool:
         return bool(
@@ -131,6 +144,7 @@ class FaultPlan:
             or self.garbage_rate or self.flap_down or self.outages
             or self.spikes or self.hang_rate or self.duplicate_rate
             or self.reorder_rate or self.late_rate or self.torn_rate
+            or self.disk_rate
         )
 
 
@@ -158,6 +172,18 @@ def parse_chaos_spec(spec: str) -> tuple[int, dict[str, FaultPlan]]:
         value = value.strip()
         if key == "seed":
             seed = int(value)
+            continue
+        if key == "disk":
+            # targetless clause: the store append seam is one place
+            # (dataplane/segfile.py), not a per-boundary wrapper
+            rate, _, kind = value.partition(":")
+            kind = kind.strip() or "short"
+            if kind not in ("short", "enospc", "eio"):
+                raise ValueError(
+                    f"disk kind must be short|enospc|eio, got {kind!r}")
+            plan = plans.setdefault("disk", FaultPlan())
+            plan.disk_rate = float(rate)
+            plan.disk_kind = kind
             continue
         target, dot, fault = key.partition(".")
         if not dot or target not in ("fetch", "archive", "kube", "push",
@@ -241,6 +267,10 @@ class FaultInjector:
         self.injected_duplicates = 0
         self.injected_reorders = 0
         self.injected_late = 0
+        # disk-seam stream (decide_disk): its own counter, same isolation
+        # rationale as decide_push
+        self.disk_calls = 0
+        self.injected_disk = 0
 
     def decide(self) -> str:
         """Advance one call: maybe sleep (latency), then return OK / ERROR
@@ -336,6 +366,18 @@ class FaultInjector:
             if late:
                 self.injected_late += 1
         return dup, reorder, late
+
+    def decide_disk(self) -> str:
+        """Advance one store append (dataplane/segfile.py seam): '' for a
+        clean write, else the fault kind to inject ('short' | 'enospc' |
+        'eio'). Deterministic from the seed like every other stream."""
+        p = self.plan
+        with self._lock:
+            self.disk_calls += 1
+            hit = p.disk_rate > 0 and self._rng.random() < p.disk_rate
+            if hit:
+                self.injected_disk += 1
+        return p.disk_kind if hit else ""
 
     def shuffled(self, seq: list) -> list:
         """Deterministically shuffled copy (the reorder fault)."""

@@ -161,6 +161,11 @@ class Runtime:
         window_store_segment_max_mb: int = 256,
         window_store_fsync: bool = False,
         window_store_checkpoint_seconds: float = 5.0,
+        job_store_dir: str = "",
+        job_store_segment_max_mb: int = 512,
+        job_store_fsync: bool = False,
+        job_store_checkpoint_seconds: float = 5.0,
+        job_store_hot_seconds: float = 300.0,
         trace_sample: float = 1.0,
         trace_export_url: str = "",
     ):
@@ -292,7 +297,34 @@ class Runtime:
             source = CachingDataSource(source, max_entries=self.config.max_cache_size)
             self.cache_source = source
         self.source = source
-        self.store = JobStore(snapshot_path=snapshot_path, archive=archive)
+        # -- crash-durable tiered job store (JOB_STORE_DIR;
+        # engine/jobtier.py): live-job mutations WAL'd ahead of their
+        # acknowledgement, terminal/cold Documents + closed provenance
+        # spilled to newest-wins segments and evicted from RAM. Boot
+        # replays WAL records through the normal transition path (stale
+        # records are counted no-ops), so kill -9 mid-transition loses
+        # nothing acked. Empty dir (the default) = snapshot-only store,
+        # exactly as before. --
+        job_tier = None
+        if job_store_dir:
+            from .engine.jobtier import JobTier
+
+            job_tier = JobTier(
+                job_store_dir,
+                segment_max_bytes=max(int(job_store_segment_max_mb), 1)
+                * (1 << 20),
+                fsync=job_store_fsync,
+                injector=self.chaos_injectors.get("disk"),
+                exporter=self.exporter,
+            )
+        self.store = JobStore(
+            snapshot_path=snapshot_path, archive=archive, tier=job_tier,
+            tier_hot_seconds=job_store_hot_seconds,
+            tier_checkpoint_min_seconds=job_store_checkpoint_seconds)
+        self._job_recovery_stats = None
+        if job_tier is not None:
+            self._job_recovery_stats = self.store.recover_from_tier()
+            log.info("job store recovered: %s", self._job_recovery_stats)
         self.job_retention_seconds = job_retention_seconds
         # cross-replica failover cadence: how often to scan the shared
         # archive for a crashed peer's stale open jobs (0 disables; the
@@ -312,6 +344,17 @@ class Runtime:
 
             self.analyzer.flight.record_event(
                 EVENT_STORE_RECOVERY, **self._recovery_stats)
+        if self._job_recovery_stats is not None:
+            from .engine.flightrec import EVENT_STORE_RECOVERY
+
+            self.analyzer.flight.record_event(
+                EVENT_STORE_RECOVERY, store="jobs",
+                **self._job_recovery_stats)
+        if self.store.tier is not None:
+            # closed provenance records spill into the same tier, so a
+            # restarted (or long-lived) replica can still `explain` a
+            # verdict whose RAM ring entry has been evicted/pruned
+            self.analyzer.provenance.spill = self.store.tier.spill_prov
         # health state machine wiring (engine/health.py): merge every live
         # breaker board (data source + archive) into the DEGRADED signal;
         # cycle cadence lands in start() where it is known
@@ -592,7 +635,8 @@ class Runtime:
             # sweeps too (rate-limited inside the store), bounding WAL
             # growth under sustained push traffic with a long cadence
             checkpoint_fn=(self._store_checkpoint
-                           if self.window_store is not None else None))
+                           if (self.window_store is not None
+                               or self.store.tier is not None) else None))
         self.scheduler = sched
         self.service.scheduler = sched
         if self.ingest is not None:
@@ -677,15 +721,20 @@ class Runtime:
         self._store_checkpoint()
 
     def _store_checkpoint(self, force: bool = False):
-        """Fold dirty window state into the warm segments and rotate the
-        WAL (dataplane/winstore.py). Own try: a full disk must degrade
-        durability, never stop the scoring loop."""
-        if self.window_store is None:
-            return
-        try:
-            self.window_store.checkpoint(self.delta_source, force=force)
-        except Exception:  # noqa: BLE001 - durability is best-effort
-            log.exception("window-store checkpoint failed")
+        """Fold dirty window/job state into the warm segments and rotate
+        the WALs (dataplane/winstore.py; engine/jobtier.py). Own try per
+        store: a full disk must degrade durability, never stop the
+        scoring loop."""
+        if self.window_store is not None:
+            try:
+                self.window_store.checkpoint(self.delta_source, force=force)
+            except Exception:  # noqa: BLE001 - durability is best-effort
+                log.exception("window-store checkpoint failed")
+        if self.store.tier is not None:
+            try:
+                self.store.tier_checkpoint(force=force)
+            except Exception:  # noqa: BLE001 - durability is best-effort
+                log.exception("job-store checkpoint failed")
 
     def request_stop(self):
         """Signal-safe: ask run_forever to exit and shut down cleanly
@@ -880,6 +929,11 @@ def main():
         window_store_fsync=knobs.read("WINDOW_STORE_FSYNC"),
         window_store_checkpoint_seconds=knobs.read(
             "WINDOW_STORE_CHECKPOINT_S"),
+        job_store_dir=knobs.read("JOB_STORE_DIR"),
+        job_store_segment_max_mb=knobs.read("JOB_STORE_SEGMENT_MAX_MB"),
+        job_store_fsync=knobs.read("JOB_STORE_FSYNC"),
+        job_store_checkpoint_seconds=knobs.read("JOB_STORE_CHECKPOINT_S"),
+        job_store_hot_seconds=knobs.read("JOB_STORE_HOT_S"),
         trace_sample=knobs.read("TRACE_SAMPLE"),
         trace_export_url=knobs.read("TRACE_EXPORT_URL"),
     )

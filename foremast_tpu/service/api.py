@@ -552,6 +552,21 @@ class ForemastService:
                 "foremast_archive_compactions_skipped_unlocked "
                 f"{getattr(self.store.archive, 'compactions_skipped_unlocked', 0)}"
             )
+            # write-behind backlog: docs whose latest version the archive
+            # has not confirmed yet. Graceful shutdown drains this to
+            # zero (runtime.stop); a persistent nonzero value under a
+            # healthy archive means mirror churn is outrunning the flush
+            lines.append(
+                "foremastbrain:archive_dirty_count "
+                f"{self.store.archive_dirty_count()}"
+            )
+            # full two-generation view rebuilds (FileArchive): steady
+            # state advances the read view incrementally, so this should
+            # track compactions, not reads
+            lines.append(
+                "foremast_archive_view_rebuilds_total "
+                f"{getattr(self.store.archive, 'view_rebuilds', 0)}"
+            )
         if self.analyzer is not None:
             # degraded-mode gauges: the counters themselves
             # (jobs_shed_total, stale_verdicts_served_total,
@@ -690,6 +705,47 @@ class ForemastService:
             lines.append(
                 "foremastbrain:window_store_wal_replayed_total "
                 f"{rec.get('wal_records_replayed', 0)}")
+        if getattr(self.store, "tier", None) is not None:
+            # crash-durable job tier health: on-disk footprint, WAL/spill
+            # traffic, RAM evictions, and what the last boot replayed
+            js = self.store.tier_snapshot()
+            lines.append(
+                f"foremastbrain:job_store_segment_bytes "
+                f"{js['segment_bytes']}")
+            lines.append(
+                "foremastbrain:job_store_segment_entries "
+                f"{js['segment_entries']}")
+            lines.append(
+                f"foremastbrain:job_store_docs {js['docs']}")
+            lines.append(
+                f"foremastbrain:job_store_wal_bytes {js['wal_bytes']}")
+            lines.append(
+                "foremastbrain:job_store_wal_records_total "
+                f"{js['wal_records']}")
+            lines.append(
+                "foremastbrain:job_store_wal_errors_total "
+                f"{js['wal_errors']}")
+            lines.append(
+                f"foremastbrain:job_store_spills_total {js['spills']}")
+            lines.append(
+                "foremastbrain:job_store_spill_errors_total "
+                f"{js['spill_errors']}")
+            lines.append(
+                "foremastbrain:job_store_compactions_total "
+                f"{js['compactions']}")
+            lines.append(
+                "foremastbrain:job_store_evictions_total "
+                f"{js['evictions']}")
+            rec = js.get("recovery") or {}
+            lines.append(
+                "foremastbrain:job_store_recovery_seconds "
+                f"{rec.get('seconds', 0)}")
+            lines.append(
+                "foremastbrain:job_store_wal_replayed_total "
+                f"{rec.get('wal_records_replayed', 0)}")
+            lines.append(
+                "foremastbrain:job_store_open_docs_restored "
+                f"{rec.get('open_docs_restored', 0)}")
         if self.http_shed_count is not None:
             lines.append(f"foremast_http_shed_total {self.http_shed_count()}")
         self_gauges = "\n".join(lines) + "\n"
@@ -753,6 +809,14 @@ class ForemastService:
             # promote traffic, and the last boot's replay stats
             # (docs/operations.md "Surviving a restart")
             out["window_store"] = self.window_store.snapshot()
+        if getattr(self.store, "tier", None) is not None:
+            # crash-durable job tier: segment/WAL footprint, spill/evict
+            # traffic, and the last boot's WAL replay stats
+            # (docs/operations.md "Job store durability")
+            out["job_store"] = self.store.tier_snapshot()
+        if self.store.archive is not None:
+            # write-behind backlog (drains to zero on graceful shutdown)
+            out["archive_dirty"] = self.store.archive_dirty_count()
         if self.shard is not None:
             # sharded-brain view: which slice of the fleet this replica
             # owns, membership health, rebalance/handoff history
@@ -828,6 +892,15 @@ class ForemastService:
         human-readably by `foremast-tpu explain <job>`."""
         recorder = getattr(self.analyzer, "provenance", None)
         rec = recorder.get(job_id) if recorder is not None else None
+        if rec is None:
+            # the recorder spills each job's CLOSED record into the
+            # durable job tier (engine/jobtier.py) — a restart or ring
+            # eviction loses nothing; served transparently here
+            tier = getattr(self.store, "tier", None)
+            trec = tier.get_prov(job_id) if tier is not None else None
+            if isinstance(trec, dict):
+                rec = dict(trec)
+                rec["from_tier"] = True
         doc = self.store.get(job_id)
         job = None
         if doc is not None:

@@ -27,7 +27,7 @@ artifact alone.
 """
 from .trace import FleetSpec, SimTrace, preset  # noqa: F401
 from .backend import SimBackend  # noqa: F401
-from .driver import run_fleet, run_fleet_ab  # noqa: F401
+from .driver import run_fleet, run_fleet_ab, run_jobstore  # noqa: F401
 
 __all__ = ["FleetSpec", "SimTrace", "preset", "SimBackend",
-           "run_fleet", "run_fleet_ab"]
+           "run_fleet", "run_fleet_ab", "run_jobstore"]

@@ -17,7 +17,8 @@ from __future__ import annotations
 import json
 import time
 
-__all__ = ["run_fleet", "run_fleet_ab", "run_live", "main"]
+__all__ = ["run_fleet", "run_fleet_ab", "run_jobstore", "run_live",
+           "main"]
 
 
 def _rss_bytes() -> int:
@@ -60,8 +61,12 @@ def run_fleet(jobs: int = 2000, seed: int = 0, shape: str = "diurnal",
               cycles: int = 6, cadence_s: float = 10.0, replicas: int = 1,
               megabatch: bool = False, stream: bool = False,
               spec=None, provenance: bool = True,
-              anomaly_rate: float | None = None) -> dict:
-    """One simfleet leg. Returns the honesty-convention bench dict."""
+              anomaly_rate: float | None = None, store=None) -> dict:
+    """One simfleet leg. Returns the honesty-convention bench dict.
+
+    `store` lets a caller supply the JobStore (run_jobstore passes a
+    tier-backed one so the engine's verdicts ride the WAL/segment path);
+    default is the plain RAM store every other leg uses."""
     import numpy as np  # noqa: F401  (transitively required)
 
     from ..dataplane.delta import DeltaWindowSource
@@ -92,7 +97,8 @@ def run_fleet(jobs: int = 2000, seed: int = 0, shape: str = "diurnal",
     source = DeltaWindowSource(
         inner, max_entries=max(8192, 4 * (spec.jobs + extra)),
         clock=lambda: backend.now)
-    store = J.JobStore()
+    if store is None:
+        store = J.JobStore()
     for d in backend.make_docs():
         store.create(d)
 
@@ -330,6 +336,162 @@ def run_fleet_ab(jobs: int = 2000, seed: int = 0, shape: str = "diurnal",
     }
 
 
+def run_jobstore(jobs: int = 100000, seed: int = 0, shape: str = "diurnal",
+                 cycles: int = 3, cadence_s: float = 60.0,
+                 tier_dir: str = "", open_jobs: int = 0,
+                 hot_seconds: float = 0.0, fsync: bool = False,
+                 checkpoint_every: int = 25000,
+                 segment_max_mb: int = 4096) -> dict:
+    """Crash-durable job-store leg at fleet scale (the 1M-per-replica
+    gate). Three passes over ONE deterministic workload:
+
+      1. **tier on** — an open subset is scored by the real engine
+         (run_fleet, the production parse/score path, every transition
+         WAL'd) and the terminal majority is driven through the real
+         store.transition() chain with spill+evict on the checkpoint
+         cadence. Measures steady jobs/s through the durable path and
+         resident bytes/job after eviction.
+      2. **restart** — a FRESH JobTier+JobStore over the same directory
+         recovers (index rebuild + WAL replay + open-doc restore),
+         timed; its verdict digest must equal leg 1's byte-for-byte.
+      3. **tier off** — the identical workload into a RAM-only store;
+         byte-identical digest required (durability must not change one
+         verdict).
+
+    `hot_seconds=0` evicts every spilled terminal doc at the next
+    checkpoint — the configuration the resident-bytes figure is FOR.
+    `tier_dir=""` uses a temp dir removed afterward."""
+    import random
+    import shutil
+    import tempfile
+
+    from ..engine import jobs as J
+    from ..engine.jobtier import JobTier
+
+    if open_jobs <= 0:
+        open_jobs = max(min(jobs // 20, 50000), 200)
+    open_jobs = min(open_jobs, jobs)
+    terminal_n = max(jobs - open_jobs, 0)
+    checkpoint_every = max(int(checkpoint_every), 1)
+
+    def _drive_terminal(store, checkpoint: bool) -> float:
+        """Create -> claim-advance -> terminal verdict for the cold
+        majority, deterministic per seed (identical across all legs)."""
+        rng = random.Random(seed * 1_000_003 + 17)
+        t0 = time.perf_counter()
+        for i in range(terminal_n):
+            jid = f"jsb-{seed}-{i:07d}"
+            store.create(J.Document(
+                id=jid, app_name=f"app-{i % 997}", namespace="jobstore",
+                strategy="rollingUpdate", start_time="START",
+                end_time="END"))
+            store.advance(jid, J.PREPROCESS_INPROGRESS,
+                          J.PREPROCESS_COMPLETED,
+                          J.POSTPROCESS_INPROGRESS, worker="simjobstore")
+            r = rng.random()
+            if r < 0.03:
+                ts = 1_700_000_000 + i
+                store.transition(
+                    jid, J.COMPLETED_UNHEALTH,
+                    reason=f"anomaly p={r:.6f}",
+                    anomaly={"latency": [float(ts), round(1.0 + r, 4)]})
+            elif r < 0.04:
+                store.transition(jid, J.COMPLETED_UNKNOWN,
+                                 reason="insufficient data")
+            else:
+                store.transition(jid, J.COMPLETED_HEALTH,
+                                 reason="healthy")
+            if checkpoint and (i + 1) % checkpoint_every == 0:
+                store.tier_checkpoint(force=True)
+        return time.perf_counter() - t0
+
+    made_tmp = not tier_dir
+    if made_tmp:
+        tier_dir = tempfile.mkdtemp(prefix="simjobstore-")
+    try:
+        # ---- leg 1: tier on (runs FIRST so its RSS figure is not
+        # polluted by the RAM leg's 1M-doc high-water mark — CPython
+        # keeps freed arenas resident) ----
+        tier = JobTier(tier_dir, fsync=fsync,
+                       segment_max_bytes=max(int(segment_max_mb), 1)
+                       * (1 << 20))
+        store_on = J.JobStore(tier=tier, tier_hot_seconds=hot_seconds)
+        open_on = run_fleet(open_jobs, seed, shape, cycles, cadence_s,
+                            store=store_on)
+        store_on.tier_checkpoint(force=True)
+        rss_mid = _rss_bytes()  # baseline: engine warm, majority not yet
+        drive_s = _drive_terminal(store_on, checkpoint=True)
+        store_on.tier_checkpoint(force=True)
+        rss_on = _rss_bytes()  # BEFORE the digest walk re-materializes
+        with store_on._lock:
+            hot_docs = len(store_on._jobs)
+        digest_on = _digest(store_on)
+        tier_stats = store_on.tier_snapshot()
+        store_on.close()
+
+        # ---- leg 2: restart-recovery over the same directory ----
+        t0 = time.perf_counter()
+        tier2 = JobTier(tier_dir, fsync=fsync,
+                        segment_max_bytes=max(int(segment_max_mb), 1)
+                        * (1 << 20))
+        store_rec = J.JobStore(tier=tier2, tier_hot_seconds=hot_seconds)
+        rec_stats = store_rec.recover_from_tier()
+        recovery_s = time.perf_counter() - t0
+        digest_rec = _digest(store_rec)
+        store_rec.close()
+
+        # ---- leg 3: tier off (RAM-only identity reference) ----
+        store_off = J.JobStore()
+        open_off = run_fleet(open_jobs, seed, shape, cycles, cadence_s,
+                             store=store_off)
+        drive_off_s = _drive_terminal(store_off, checkpoint=False)
+        digest_off = _digest(store_off)
+    finally:
+        if made_tmp:
+            shutil.rmtree(tier_dir, ignore_errors=True)
+
+    on_jps = round(terminal_n / drive_s, 1) if drive_s > 0 else 0.0
+    off_jps = round(terminal_n / drive_off_s, 1) if drive_off_s > 0 \
+        else 0.0
+    return {
+        "metric": "jobstore_steady_jobs_per_sec",
+        "value": on_jps,
+        "unit": "jobs/s",
+        # -- reproducibility header --
+        "seed": seed,
+        "trace": open_on["trace"],
+        "fleet": jobs,
+        "open_jobs": open_jobs,
+        "terminal_jobs": terminal_n,
+        "cycles": cycles,
+        "cadence_s": cadence_s,
+        "checkpoint_every": checkpoint_every,
+        "hot_seconds": hot_seconds,
+        "fsync": fsync,
+        "segment_max_mb": segment_max_mb,
+        # -- measured figures --
+        "steady_jobs_per_sec": on_jps,
+        "steady_jobs_per_sec_ram": off_jps,
+        "durability_cost_ratio": round(off_jps / on_jps, 3)
+        if on_jps else None,
+        "resident_rss_bytes": rss_on,
+        "resident_rss_per_job": round(rss_on / max(jobs, 1), 1),
+        # the 1M claim: what the terminal majority ADDED to the warm
+        # process, per job, with the cold set evicted to the segment
+        "terminal_resident_delta_per_job": round(
+            max(rss_on - rss_mid, 0) / max(terminal_n, 1), 1),
+        "ram_docs_after_evict": hot_docs,
+        "tier": tier_stats,
+        "recovery": {"wall_seconds": round(recovery_s, 3), **rec_stats},
+        "digests": {"tier_on": digest_on, "recovered": digest_rec,
+                    "tier_off": digest_off},
+        "verdicts_identical": digest_on == digest_rec == digest_off,
+        "open_leg_jobs_per_sec": open_on["jobs_per_sec"],
+        "open_leg_truth": open_on["truth"],
+        "open_leg_truth_ram": open_off["truth"],
+    }
+
+
 def run_live(endpoint: str, jobs: int = 200, seed: int = 0,
              shape: str = "diurnal", duration_s: float = 60.0,
              push: bool = False, serve_port: int = 0) -> dict:
@@ -432,7 +594,14 @@ def main() -> None:
     cycles = knobs.read("SIM_CYCLES")
     cadence = knobs.read("SIM_CADENCE_S")
     replicas = knobs.read("SIM_REPLICAS")
-    if knobs.read("SIM_AB"):
+    if knobs.read("SIM_JOBSTORE"):
+        out = run_jobstore(
+            jobs, seed, shape, cycles, cadence,
+            tier_dir=knobs.read("SIM_JOBSTORE_DIR"),
+            open_jobs=knobs.read("SIM_JOBSTORE_OPEN"),
+            hot_seconds=knobs.read("SIM_JOBSTORE_HOT_S"),
+            fsync=knobs.read("JOB_STORE_FSYNC"))
+    elif knobs.read("SIM_AB"):
         out = run_fleet_ab(jobs, seed, shape, cycles, cadence, replicas,
                            rounds=knobs.read("SIM_ROUNDS"))
     else:

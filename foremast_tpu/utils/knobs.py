@@ -246,6 +246,28 @@ register("WINDOW_STORE_CHECKPOINT_S", 5.0, float,
          "rotation + dirty-entry spill); the sweep and partial cycles "
          "both try, this floors the disk churn")
 
+# -- crash-durable tiered job store (engine/jobtier.py; runtime.py) --
+register("JOB_STORE_DIR", "", str,
+         "directory for the crash-durable job tier (mutation WAL + "
+         "newest-wins job/provenance segments; terminal jobs spill "
+         "there and evict from RAM); empty disables — the job store "
+         "is snapshot-only exactly as before")
+register("JOB_STORE_SEGMENT_MAX_MB", 512, int,
+         "job-segment file size (MB) past which it compacts "
+         "newest-wins per job id")
+register("JOB_STORE_FSYNC", False, parse_bool,
+         "fsync every job-WAL append: survives machine crashes, not "
+         "just process death (kill -9 needs no fsync), at a "
+         "per-mutation cost")
+register("JOB_STORE_CHECKPOINT_S", 5.0, float,
+         "minimum seconds between job-store checkpoints (WAL rotation "
+         "+ dirty-doc spill + cold eviction); the sweep calls every "
+         "pass, this floors the disk churn")
+register("JOB_STORE_HOT_S", 300.0, float,
+         "seconds a terminal job stays RAM-resident after its last "
+         "modification before evicting to the warm tier (reads fall "
+         "through transparently)")
+
 # -- distributed tracing (utils/tracing.py; runtime.py) --
 register("TRACE_SAMPLE", 1.0, float,
          "head-sampling probability for freshly minted root traces "
@@ -300,6 +322,20 @@ register("SIM_STREAM", False, parse_bool,
          "single-leg mode: push the advancing samples through the "
          "ingest receiver (remote-write) instead of poll-only",
          scope="bench")
+register("SIM_JOBSTORE", False, parse_bool,
+         "run the crash-durable job-store leg (tier on / restart-"
+         "recovery / tier off over one deterministic workload) instead "
+         "of the mega-batch A/B", scope="bench")
+register("SIM_JOBSTORE_DIR", "", str,
+         "job-store leg tier directory (empty = fresh temp dir, "
+         "removed after the leg)", scope="bench")
+register("SIM_JOBSTORE_OPEN", 0, int,
+         "engine-scored open subset of the job-store leg's fleet "
+         "(0 = auto: SIM_JOBS/20 capped at 50k)", scope="bench")
+register("SIM_JOBSTORE_HOT_S", 0.0, float,
+         "job-store leg hot window; 0 evicts every spilled terminal "
+         "doc at the next checkpoint (the resident-bytes "
+         "configuration)", scope="bench")
 
 # -- multi-host world (parallel/distributed.py) --
 register("COORDINATOR_ADDRESS", "", str,

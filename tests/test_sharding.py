@@ -610,28 +610,24 @@ def test_runtime_default_worker_is_replica_id(tmp_path):
 
 def test_file_list_state_memoized_between_mutations(tmp_path):
     """Between archive mutations list_state serves a cached view (the
-    membership read costs stat(2)s, not a two-generation parse); any
-    append invalidates it."""
+    membership read costs stat(2)s, not a two-generation parse); an
+    append advances the view by parsing only the new suffix — a full
+    rebuild happens once up front and then only on rotation."""
     ar = FileArchive(str(tmp_path / "ar.jsonl"))
     ar.index_state(MEMBER_KEY_PREFIX + "A", {"replica": "A"}, 1000.0)
-    scans = {"n": 0}
-    real = ar._iter_records
-
-    def counting():
-        scans["n"] += 1
-        return real()
-
-    ar._iter_records = counting
     first = ar.list_state(MEMBER_KEY_PREFIX)
-    assert set(first) == {MEMBER_KEY_PREFIX + "A"} and scans["n"] == 1
+    assert set(first) == {MEMBER_KEY_PREFIX + "A"}
+    assert ar.view_rebuilds == 1
     for _ in range(5):
         assert ar.list_state(MEMBER_KEY_PREFIX) == first
     assert ar.list_state() == first  # prefix filter shares the one view
-    assert scans["n"] == 1
+    assert ar.view_rebuilds == 1
     ar.index_state(MEMBER_KEY_PREFIX + "B", {"replica": "B"}, 1001.0)
     assert set(ar.list_state(MEMBER_KEY_PREFIX)) == {
         MEMBER_KEY_PREFIX + "A", MEMBER_KEY_PREFIX + "B"}
-    assert scans["n"] == 2
+    # the heartbeat's own append is absorbed incrementally, never as
+    # another two-generation walk
+    assert ar.view_rebuilds == 1
 
 
 def test_es_delete_state_and_membership_prunes_dead_blobs():
@@ -680,6 +676,108 @@ def test_es_delete_state_and_membership_prunes_dead_blobs():
     m = _mgr(store, "A", member_ttl_seconds=5.0)
     assert m.tick()["replicas"] == ["A", "live"]
     assert ar.deleted == [MEMBER_KEY_PREFIX + "ancient"]
+
+
+def test_member_blob_hygiene_under_replica_id_churn(tmp_path):
+    """Join/leave churn loop (ISSUE 19): 40 hostname-pid incarnations
+    join, heartbeat, and leave (half gracefully, half kill -9 silent)
+    over a synthetic 3 h window. FileArchive compaction ages every
+    incarnation past the 1 h KEEP_MEMBER_SECONDS horizon out of the
+    state section — the archive tracks the LIVE fleet, not deployment
+    history — while blobs inside the horizon survive, left or silent."""
+    from foremast_tpu.engine import archive as AR
+
+    ar = FileArchive(str(tmp_path / "ar.jsonl"))
+    store = JobStore(archive=ar)
+    now0 = time.time()
+    t0 = now0 - 3 * 3600.0
+    survivor = _mgr(store, "survivor", member_ttl_seconds=30.0)
+    churned = []
+    for g in range(40):
+        now = t0 + g * 240.0  # one incarnation every 4 minutes
+        rid = f"pod-{g}-{1000 + g}"  # hostname-pid: new key per restart
+        churned.append(MEMBER_KEY_PREFIX + rid)
+        m = _mgr(store, rid, member_ttl_seconds=30.0)
+        m.tick(now=now)
+        survivor.tick(now=now)
+        if g % 2 == 0:
+            m.withdraw(now=now + 1.0)  # graceful leave ("left" mark)
+        # odd generations go silent (kill -9): the blob just stops
+    # before hygiene: every incarnation ever sits in the state section
+    assert len(ar.list_state(MEMBER_KEY_PREFIX)) >= 41
+    survivor.tick(now=now0)
+    ar._compact_locked()
+    keys = set(ar.list_state(MEMBER_KEY_PREFIX))
+    horizon = now0 - AR.KEEP_MEMBER_SECONDS
+    for g, key in enumerate(churned):
+        stamped = t0 + g * 240.0 + (1.0 if g % 2 == 0 else 0.0)
+        if stamped < horizon:
+            assert key not in keys, f"incarnation {g} not aged out"
+        else:
+            assert key in keys, f"in-horizon incarnation {g} lost"
+    assert MEMBER_KEY_PREFIX + "survivor" in keys
+    # the membership view never resurrects the churned fleet: only the
+    # survivor is live (every churned blob is left and/or TTL-expired)
+    assert survivor.tick(now=now0)["replicas"] == ["survivor"]
+
+
+def test_es_delete_state_prune_drains_churned_fleet_across_refreshes():
+    """The EsArchive-style prune is bounded (8 deletes per membership
+    refresh): a churned fleet of 30 dead incarnations drains over
+    successive refreshes — never one giant delete storm — and the
+    member_prunes_total counter tracks exactly the drained keys.
+    TTL-expired-but-recent members are filtered from the view but NEVER
+    deleted (they may still be rebooting)."""
+    from foremast_tpu.engine import archive as AR
+
+    now0 = time.time()
+
+    class ChurnArchive:
+        """delete_state actually removes — the drain must converge."""
+
+        def __init__(self):
+            self.deleted = []
+            self.state = {
+                MEMBER_KEY_PREFIX + f"gone-{i}":
+                    ({"replica": f"gone-{i}"},
+                     now0 - AR.KEEP_MEMBER_SECONDS - 300.0 - i)
+                for i in range(30)
+            }
+            self.state[MEMBER_KEY_PREFIX + "recent-dead"] = (
+                {"replica": "recent-dead"}, now0 - 60.0)
+            self.state[MEMBER_KEY_PREFIX + "live"] = (
+                {"replica": "live"}, now0)
+
+        def index_state(self, key, value, updated_at):
+            if key.startswith(MEMBER_KEY_PREFIX + "A"):
+                self.state[key] = (value, updated_at)
+            return True
+
+        def list_state(self, prefix=""):
+            return {k: v for k, v in self.state.items()
+                    if k.startswith(prefix)}
+
+        def delete_state(self, key):
+            self.deleted.append(key)
+            return self.state.pop(key, None) is not None
+
+    ar = ChurnArchive()
+    store = JobStore()
+    store.archive = ar
+    m = _mgr(store, "A", member_ttl_seconds=5.0)
+    per_refresh = []
+    for k in range(6):
+        before = len(ar.deleted)
+        view = m.tick(now=now0 + k)
+        per_refresh.append(len(ar.deleted) - before)
+        assert view["replicas"] == ["A", "live"]  # view is churn-clean
+    assert all(n <= 8 for n in per_refresh), per_refresh
+    # the full churned fleet drained, exactly once each, nothing else
+    assert sorted(ar.deleted) == sorted(
+        MEMBER_KEY_PREFIX + f"gone-{i}" for i in range(30))
+    assert m.snapshot()["member_prunes_total"] == 30
+    assert MEMBER_KEY_PREFIX + "recent-dead" in ar.state
+    assert MEMBER_KEY_PREFIX + "live" in ar.state
 
 
 def test_runtime_floors_adopt_interval_when_sharded(tmp_path):

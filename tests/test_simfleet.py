@@ -225,6 +225,31 @@ def test_driver_stream_leg_matches_polled_verdicts():
     assert streamed["jobs_scored"] == polled["jobs_scored"]
 
 
+def test_driver_jobstore_leg_digests_identical(tmp_path):
+    """Tier-1 shape check for the crash-durable job-store leg (the 1M
+    acceptance run is `SIM_JOBS=1000000 SIM_JOBSTORE=1`, artifact
+    BENCH_JOBSTORE_r01.json): tiny fleet, all three passes — tier on,
+    restart-recovery, tier off — must land one verdict digest."""
+    from foremast_tpu.simfleet import run_jobstore
+
+    out = run_jobstore(jobs=360, seed=7, shape="steady", cycles=2,
+                       cadence_s=60.0, tier_dir=str(tmp_path / "tier"),
+                       open_jobs=40, checkpoint_every=100)
+    assert out["verdicts_identical"]
+    d = out["digests"]
+    assert d["tier_on"] == d["recovered"] == d["tier_off"]
+    # reproducibility header + honest split
+    assert out["seed"] == 7 and out["fleet"] == 360
+    assert out["open_jobs"] == 40 and out["terminal_jobs"] == 320
+    json.dumps(out)
+    # the tier really carried the fleet: every doc spilled, the cold
+    # majority evicted from RAM, and recovery restored the open set
+    assert out["tier"]["docs"] == 360
+    assert out["ram_docs_after_evict"] < 360
+    assert out["recovery"]["wall_seconds"] > 0
+    assert out["steady_jobs_per_sec"] > 0
+
+
 # ---------------------------------------------------------- perf A/B gate
 @pytest.mark.slow
 @pytest.mark.perf
