@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf perf-smoke demo demo-hpa dryrun fuzz chaos soak soak-sharded soak-stream soak-restart soak-jobstore clean
+.PHONY: test test-fast lint native bench bench-smoke bench-watch prewarm perf perf-smoke demo demo-hpa dryrun fuzz chaos soak soak-sharded soak-stream soak-restart soak-jobstore crashcheck clean
 
 test: lint       ## full suite (CPU, 8 virtual devices via conftest), gated on lint
 	$(PY) -m pytest tests/ -q
@@ -64,6 +64,9 @@ soak-stream:     ## streaming-ingest soaks (<120s): push+poll under chaos latenc
 
 soak-restart:    ## crash-durability soak (<60s): kill -9 a replica mid-push-stream, restart over the same WINDOW_STORE_DIR; WAL+segment replay, zero refetch storm, verdicts == never-restarted baseline (torn-WAL chaos leg included)
 	$(CPU_ENV) $(PY) -m pytest tests/test_restart_soak.py -q
+
+crashcheck:      ## exhaustive crash-point sweep (<60s): enumerate every durable-seam crossing in the winstore/jobstore/archive scenarios, SimulatedCrash at each one + every torn-tail byte cut, run the REAL recovery, assert record-or-effect, replay-twice == replay-once, and digest convergence; includes the seeded-bug selftest that must convict
+	$(CPU_ENV) $(PY) -m foremast_tpu.devtools.crashcheck --scenario all
 
 soak-jobstore:   ## job-store durability soak (<60s): kill -9 mid-transition with claimed leases over a JOB_STORE_DIR; WAL replay through the normal transition path, zero lost / zero double-scored jobs, provenance chains intact (disk-fault chaos leg + graceful-shutdown archive drain included)
 	$(CPU_ENV) $(PY) -m pytest tests/test_jobstore_soak.py -q

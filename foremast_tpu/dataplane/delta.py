@@ -41,7 +41,6 @@ from __future__ import annotations
 
 import logging
 import re
-import threading
 import time
 from collections import OrderedDict
 

@@ -18,7 +18,7 @@ Re-implements the behavior of foremast-barrelman's query builder
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from urllib.parse import quote
 
 from ..ops.windowing import DEFAULT_STEP, align_step

@@ -101,12 +101,18 @@ def _injected_fault(injector, path: str, fd: int, base: int,
     """Apply one ``disk=`` chaos decision at the append seam. ``short``
     leaves a torn prefix then rolls back and raises — the detected
     short-write path every store must degrade through; ``enospc`` /
-    ``eio`` raise before any byte lands."""
+    ``eio`` raise before any byte lands. A ``crash=N`` plan fires first:
+    every frame is a durable-seam crossing, so the crashcheck sweep can
+    cut a multi-frame batch between any two records."""
+    seam = getattr(injector, "seam", None)
+    if seam is not None:
+        seam("segfile.append:" + os.path.basename(path))
     kind = injector.decide_disk()
     if not kind:
         return
     if kind == "short":
-        os.write(fd, framed[:max(len(framed) // 2, 1)])
+        os.write(  # lint: disable=unchecked-write -- deliberate torn prefix
+            fd, framed[:max(len(framed) // 2, 1)])
         try:
             os.ftruncate(fd, base)
         except OSError:

@@ -67,6 +67,8 @@ import time
 import numpy as np
 
 from ..ops.windowing import Window
+from ..resilience.faults import OK as _FAULT_OK
+from ..resilience.faults import durable_seam, seam_point
 from ..utils.locks import make_lock
 from . import segfile
 from .segfile import SCAN_CORRUPT, SCAN_OK, SCAN_TORN  # noqa: F401 (API)
@@ -230,6 +232,7 @@ class WindowStore:
         return self._seg_mm
 
     # ------------------------------------------------------------------ WAL
+    @durable_seam("winstore.wal_append")
     def wal_append(self, url: str, ts, vals) -> bool:
         """Append one accepted push batch; called by the ingest receiver
         BEFORE it acks. Failures degrade (counted, logged) rather than
@@ -244,9 +247,7 @@ class WindowStore:
                    + ts_a.tobytes() + vals_a.tobytes())
         tear = False
         if self.wal_injector is not None:
-            from ..resilience.faults import OK as _OK
-
-            tear = self.wal_injector.decide() != _OK
+            tear = self.wal_injector.decide() != _FAULT_OK
         t0 = time.monotonic()
         try:
             with self._wal_lock:
@@ -287,6 +288,7 @@ class WindowStore:
         return records, status
 
     # ------------------------------------------------------------ segments
+    @durable_seam("winstore.spill")
     def spill(self, state: dict) -> None:
         """Append one entry state to the warm segment (newest-wins) and
         update the in-RAM index; compacts when the file outgrows its
@@ -375,6 +377,7 @@ class WindowStore:
                 off += _FRAME_OVERHEAD + len(payload)
             f.flush()
             os.fsync(f.fileno())
+        seam_point(self, "winstore.compact.replace")
         os.replace(tmp, self.seg_path)
         self._index = new_index
         self._seg_mm = None  # old views stay valid; next read re-maps
@@ -509,6 +512,7 @@ class WindowStore:
                 if os.path.exists(self.wal_path) else 0
             had_old = os.path.exists(self.wal_old_path)
             if wal_bytes and not had_old:
+                seam_point(self, "winstore.checkpoint.rotate")
                 os.replace(self.wal_path, self.wal_old_path)
         spilled = delta.spill_dirty()
         # only drop the rotated generation once the spill committed its
@@ -524,6 +528,7 @@ class WindowStore:
             return {"spilled": spilled, "wal_bytes_rotated": wal_bytes,
                     "wal_retained_for_drops": True}
         with self._wal_lock:
+            seam_point(self, "winstore.checkpoint.retire")
             try:
                 os.unlink(self.wal_old_path)
             except FileNotFoundError:

@@ -29,6 +29,25 @@ thread-hygiene    threading.Thread constructions pass daemon= explicitly
 jit-hygiene       no jax.jit construction inside loop bodies; jit static
                   args are literal (hashable by construction); no Python
                   `if`/`while` on traced values in ops/ and models/.
+unchecked-write   os.write() results are checked (a discarded count hides
+                  short writes); os.replace/os.unlink/os.rename in the
+                  durable-store modules happen behind a registered crash
+                  seam (seam_point()/@durable_seam) so the crashcheck
+                  sweep can cut power on either side of the rename.
+ack-after-durable flow-sensitive: a public store method that mutates
+                  RAM-visible state (self.<x>[k] = ...) must not return
+                  (ack the caller) before a WAL/persist call — the PR 13
+                  lost-ack bug class crashcheck convicts dynamically.
+verdict-determin. scoring-path modules draw no wall-clock or unseeded
+-ism              randomness: time.time()/datetime.now() only as the
+                  `x if clock is None else clock` injectable fallback,
+                  RNG only via literal-seeded PRNGKey/default_rng —
+                  replayed verdicts must be bit-identical.
+exception-swallow broad `except` in durability modules must re-raise,
+                  return a failure, bump an error counter, or log at
+                  warning+; `except BaseException` must re-raise —
+                  SimulatedCrash (resilience/faults.py) rides
+                  BaseException precisely so it cannot be swallowed.
 """
 from __future__ import annotations
 
@@ -38,7 +57,8 @@ from .linter import Checker, Finding, ModuleInfo
 
 __all__ = ["default_checkers", "LockDiscipline", "KnobRegistry",
            "MetricsLint", "ThreadHygiene", "JitHygiene",
-           "TraceNameRegistry"]
+           "TraceNameRegistry", "UncheckedWrite", "AckAfterDurable",
+           "VerdictDeterminism", "ExceptionSwallow"]
 
 
 # ---------------------------------------------------------------------------
@@ -766,6 +786,373 @@ class TraceNameRegistry(Checker):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# (7) unchecked-write
+# ---------------------------------------------------------------------------
+
+# the modules that own CRC-framed durable files; mirrors the seam roster in
+# resilience/faults.py (checks.py must stay stdlib-only, so it cannot import
+# faults to read the live registry)
+_SEAM_MODULES = {
+    "foremast_tpu/dataplane/segfile.py",
+    "foremast_tpu/dataplane/winstore.py",
+    "foremast_tpu/engine/jobtier.py",
+    "foremast_tpu/engine/archive.py",
+}
+_RENAME_CALLS = {"os.replace", "os.unlink", "os.rename"}
+
+
+def _is_seam_call(node: ast.Call) -> bool:
+    """seam_point(self, ...) / injector.seam(...) / seam(...) — a
+    registered crash-point crossing (resilience/faults.py)."""
+    name = dotted(node.func)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in ("seam_point", "seam")
+
+
+class UncheckedWrite(Checker):
+    """Discarded ``os.write`` return values, and rename/unlink durability
+    steps that the crashcheck sweep cannot see. A short write that nobody
+    notices tears the LAST frame silently; an unregistered rename is a
+    crash point the exhaustive sweep never enumerates — both defeat the
+    record-or-effect proof."""
+
+    name = "unchecked-write"
+    require_reason = True
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        # (a) everywhere: os.write() as a bare expression statement —
+        # the byte count is the ONLY signal a write was short
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Expr) and isinstance(node.value,
+                                                         ast.Call) \
+                    and dotted(node.value.func) == "os.write":
+                findings.append(Finding(
+                    self.name, module.relpath, node.lineno,
+                    "os.write() result discarded — a short write would "
+                    "land a torn frame undetected; check the count and "
+                    "roll back (see segfile.append_frame)"))
+        if module.relpath not in _SEAM_MODULES:
+            return findings
+        # (b) seam modules: every rename/unlink happens in a function
+        # that registered a crash seam BEFORE it (or is itself a
+        # @durable_seam), so crashcheck can cut power on either side
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            sealed = any(
+                (dotted(d) or dotted(getattr(d, "func", ast.Pass())) or "")
+                .rsplit(".", 1)[-1] == "durable_seam"
+                for d in fn.decorator_list)
+            seam_lines = [n.lineno for n in _iter_body(fn)
+                          if isinstance(n, ast.Call) and _is_seam_call(n)]
+            for n in _iter_body(fn):
+                if isinstance(n, ast.Call) \
+                        and dotted(n.func) in _RENAME_CALLS:
+                    if sealed or any(s <= n.lineno for s in seam_lines):
+                        continue
+                    findings.append(Finding(
+                        self.name, module.relpath, n.lineno,
+                        f"{dotted(n.func)}() in a durable-store module "
+                        "with no seam_point()/@durable_seam before it — "
+                        "crashcheck cannot enumerate a crash at this "
+                        "boundary; register the seam"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# (8) ack-after-durable
+# ---------------------------------------------------------------------------
+
+# the durable-write primitives: a call to any of these (directly, or via
+# ONE level of same-class helper) covers the mutation. The rule scopes
+# STRUCTURALLY — any class one of whose methods calls a primitive is a
+# durable store, wherever it lives — so a store moved to a new module
+# stays covered and test fixtures exercise the rule from any path.
+_WAL_CALLS = {"_wal_docs", "_wal_state", "wal_append", "wal_append_many",
+              "append_frame", "append_frames", "_persist",
+              "spill_docs", "spill_state", "spill_prov", "tombstone_docs"}
+# recovery/replay methods rebuild RAM FROM the durable tier — mutation
+# without a WAL append is their whole job. Read-path methods (get*/fetch*)
+# that mutate are lazy cache fills from the tier: same direction of flow,
+# the WAL is the SOURCE of the write, not its destination.
+_REPLAY_NAME_HINTS = ("recover", "replay", "restore", "load", "boot",
+                      "from_tier")
+_READ_PATH_PREFIXES = ("get", "fetch", "peek", "read")
+
+
+class AckAfterDurable(Checker):
+    """A public store method that mutates RAM-visible state and then
+    returns has acked the caller; if no WAL/persist call precedes that
+    return (lexically — one `if` branch covering is accepted), a crash
+    after the ack loses an acknowledged write. This is the static twin of
+    crashcheck's record-or-effect assertion and the PR 13 lost-ack bug."""
+
+    name = "ack-after-durable"
+    require_reason = True
+
+    def _self_subscript_store(self, node: ast.AST) -> bool:
+        """self._jobs[k] = ... / del self._windows[k] — a mutation of
+        RAM-visible keyed state (plain attribute stores are counters)."""
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                base = dotted(t.value)
+                if base is not None and base.startswith("self."):
+                    return True
+        return False
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        for cls in module.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [m for m in cls.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            # pass 1: which methods call a WAL primitive directly?
+            # A class with none is not a durable store — skip it.
+            wal_methods = set()
+            for m in methods:
+                for n in _iter_body(m):
+                    if isinstance(n, ast.Call):
+                        name = dotted(n.func) or ""
+                        if name.rsplit(".", 1)[-1] in _WAL_CALLS:
+                            wal_methods.add(m.name)
+                            break
+            if not wal_methods:
+                continue
+            # pass 2: public mutating methods must hit WAL before return
+            for m in methods:
+                if m.name.startswith("_"):
+                    continue
+                if any(h in m.name.lower() for h in _REPLAY_NAME_HINTS):
+                    continue
+                if m.name.lower().startswith(_READ_PATH_PREFIXES):
+                    continue
+                mut_lines: list[int] = []
+                wal_lines: list[int] = []
+                ret_nodes: list[ast.Return] = []
+                for n in _iter_body(m):
+                    if self._self_subscript_store(n):
+                        mut_lines.append(n.lineno)
+                    elif isinstance(n, ast.Call):
+                        name = dotted(n.func) or ""
+                        last = name.rsplit(".", 1)[-1]
+                        if last in _WAL_CALLS or (
+                                name.startswith("self.")
+                                and last in wal_methods):
+                            wal_lines.append(n.lineno)
+                    elif isinstance(n, ast.Return):
+                        ret_nodes.append(n)
+                if not mut_lines:
+                    continue
+                first_mut = min(mut_lines)
+                if not wal_lines:
+                    findings.append(Finding(
+                        self.name, module.relpath, first_mut,
+                        f"{cls.name}.{m.name}() mutates RAM-visible "
+                        "state with no WAL/persist call on any path — a "
+                        "crash loses the acked write (PR 13 bug class)"))
+                    continue
+                first_wal = min(wal_lines)
+                for r in ret_nodes:
+                    if first_mut < r.lineno < first_wal:
+                        findings.append(Finding(
+                            self.name, module.relpath, r.lineno,
+                            f"{cls.name}.{m.name}() returns after "
+                            "mutating state but before the first "
+                            "WAL/persist call — ack-after-durable: the "
+                            "caller sees success a crash would undo"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# (9) verdict-determinism
+# ---------------------------------------------------------------------------
+
+_SCORING_PREFIXES = ("foremast_tpu/engine/analyzer.py",
+                     "foremast_tpu/models/", "foremast_tpu/ops/")
+_WALLCLOCK_CALLS = {"time.time", "datetime.now", "datetime.utcnow",
+                    "datetime.datetime.now", "datetime.datetime.utcnow",
+                    "date.today", "datetime.date.today"}
+# seeded constructors: fine iff the seed/key argument is a literal
+_SEEDED_RNG = {"default_rng", "RandomState", "PRNGKey", "key", "seed"}
+
+
+class VerdictDeterminism(Checker):
+    """Scoring-path modules must replay bit-identically: the same window
+    through the same model yields the same verdict digest (crashcheck's
+    converge assertion and the PR 16 incident both hang off this). Wall
+    clocks are allowed ONLY as the injectable fallback
+    ``now = time.time() if now is None else now`` — tests pin the clock;
+    RNG only through a literal-seeded PRNGKey/default_rng."""
+
+    name = "verdict-determinism"
+    require_reason = True
+
+    def _fallback_allowed(self, tree: ast.AST) -> set[int]:
+        """ids of wall-clock Call nodes inside the injectable-clock
+        fallback idiom: `x if <name> is None else <name>` or
+        `if <name> is None: x = time.time()`."""
+
+        def is_none_test(test: ast.AST) -> bool:
+            return (isinstance(test, ast.Compare)
+                    and len(test.ops) == 1
+                    and isinstance(test.ops[0], ast.Is)
+                    and isinstance(test.comparators[0], ast.Constant)
+                    and test.comparators[0].value is None)
+
+        allowed: set[int] = set()
+        for node in ast.walk(tree):
+            body: list[ast.AST] = []
+            if isinstance(node, ast.IfExp) and is_none_test(node.test):
+                body = [node.body, node.orelse]
+            elif isinstance(node, ast.If) and is_none_test(node.test):
+                body = list(node.body)
+            for sub in body:
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Call) \
+                            and dotted(n.func) in _WALLCLOCK_CALLS:
+                        allowed.add(id(n))
+        return allowed
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        if not module.relpath.startswith(_SCORING_PREFIXES):
+            return []
+        findings: list[Finding] = []
+        allowed = self._fallback_allowed(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            if name in _WALLCLOCK_CALLS and id(node) not in allowed:
+                findings.append(Finding(
+                    self.name, module.relpath, node.lineno,
+                    f"{name}() on the scoring path — verdicts must "
+                    "replay bit-identically; take an injectable clock "
+                    "(`now=None` parameter with an `is None` fallback)"))
+                continue
+            parts = name.split(".")
+            if "random" not in parts[:-1]:
+                continue  # only random-module/namespace draws
+            last = parts[-1]
+            if last in _SEEDED_RNG:
+                seed = node.args[0] if node.args else None
+                for kw in node.keywords:
+                    if kw.arg in ("seed", "key"):
+                        seed = kw.value
+                if seed is None or not _is_literal(seed):
+                    findings.append(Finding(
+                        self.name, module.relpath, node.lineno,
+                        f"{name}() without a literal seed on the scoring "
+                        "path — derive keys from a literal root so "
+                        "replays are bit-identical"))
+            else:
+                findings.append(Finding(
+                    self.name, module.relpath, node.lineno,
+                    f"unseeded {name}() on the scoring path — draw from "
+                    "a literal-seeded PRNGKey/default_rng instead"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# (10) exception-swallow
+# ---------------------------------------------------------------------------
+
+_DURABILITY_MODULES = {
+    "foremast_tpu/dataplane/segfile.py",
+    "foremast_tpu/dataplane/winstore.py",
+    "foremast_tpu/dataplane/delta.py",
+    "foremast_tpu/engine/jobtier.py",
+    "foremast_tpu/engine/jobs.py",
+    "foremast_tpu/engine/archive.py",
+}
+_ERRORISH = ("error", "degrad", "drop", "skip", "fallback", "fail",
+             "lost", "miss")
+_LOG_LEVELS = {"warning", "warn", "error", "exception", "critical"}
+
+
+class ExceptionSwallow(Checker):
+    """A broad ``except`` in a durability module that neither re-raises,
+    returns a failure, counts the error, nor logs at warning+ turns a
+    torn write into silent data loss. ``except BaseException`` is held
+    to the strict form — it must re-raise — because SimulatedCrash
+    (resilience/faults.py) rides BaseException precisely so degrade
+    handlers cannot swallow a crash the sweep injected."""
+
+    name = "exception-swallow"
+    require_reason = True
+
+    def _handler_escapes(self, handler: ast.ExceptHandler) -> tuple[bool,
+                                                                    bool]:
+        """(re-raises, otherwise-accounts-for-the-error)."""
+        reraises = False
+        accounted = False
+        for n in _iter_body(handler):
+            if isinstance(n, ast.Raise):
+                reraises = True
+            elif isinstance(n, ast.Return):
+                accounted = True  # failure surfaced to the caller
+            elif isinstance(n, ast.AugAssign):
+                t = dotted(n.target)
+                if t and t.startswith("self.") and any(
+                        h in t.rsplit(".", 1)[-1].lower()
+                        for h in _ERRORISH):
+                    accounted = True  # error counter bumped
+            elif isinstance(n, ast.Call):
+                name = dotted(n.func) or ""
+                last = name.rsplit(".", 1)[-1]
+                if last in _LOG_LEVELS and "log" in name.lower():
+                    accounted = True
+                elif last.startswith("degrade"):
+                    accounted = True
+        return reraises, accounted
+
+    def check(self, module: ModuleInfo) -> list[Finding]:
+        if module.relpath not in _DURABILITY_MODULES:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                htype = handler.type
+                tname = dotted(htype) if htype is not None else None
+                broad = htype is None or tname in ("Exception",
+                                                   "BaseException")
+                if not broad:
+                    continue
+                reraises, accounted = self._handler_escapes(handler)
+                if tname == "BaseException" or htype is None:
+                    if not reraises:
+                        findings.append(Finding(
+                            self.name, module.relpath, handler.lineno,
+                            "bare/BaseException handler that does not "
+                            "re-raise — it would swallow SimulatedCrash "
+                            "and KeyboardInterrupt; narrow it or add "
+                            "`raise`"))
+                elif not (reraises or accounted):
+                    findings.append(Finding(
+                        self.name, module.relpath, handler.lineno,
+                        "broad except swallows failures in a durability "
+                        "module — re-raise, return a failure, bump an "
+                        "error counter (self.errors += 1), or log at "
+                        "warning+ with exc_info"))
+        return findings
+
+
 def default_checkers(docs_text: str | None = None) -> list[Checker]:
     return [
         LockDiscipline(),
@@ -774,4 +1161,8 @@ def default_checkers(docs_text: str | None = None) -> list[Checker]:
         ThreadHygiene(),
         JitHygiene(),
         TraceNameRegistry(),
+        UncheckedWrite(),
+        AckAfterDurable(),
+        VerdictDeterminism(),
+        ExceptionSwallow(),
     ]

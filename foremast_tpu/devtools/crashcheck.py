@@ -1,0 +1,943 @@
+"""Crash-consistency sanitizer: exhaustive crash-point enumeration.
+
+ALICE/CrashMonkey-style checker over the repo's durable stores. The
+stores register their write points as durable seams
+(``resilience/faults.py``: ``@durable_seam`` on whole-method write
+points, ``seam_point`` at mid-sequence steps like rotate -> spill ->
+retire, and the per-frame seam inside ``dataplane/segfile.py``). A
+``crash=N`` fault plan raises ``SimulatedCrash`` — a BaseException, so
+no store degrade handler can swallow the power cut — at the N-th seam
+crossing.
+
+The sweep, per scenario (window store, job store, file archive):
+
+  1. **clean run** — a deterministic workload of idempotent ops runs
+     against a counting injector; the crossing count defines the crash
+     points, and the recovered clean world's content digest is the
+     baseline.
+  2. **step sweep** — for every crossing index k: re-run the workload
+     with ``crash_at=k``, catch the SimulatedCrash, freeze the
+     directory as the post-crash disk image, then drive the REAL
+     recovery path over a copy and assert:
+       * **record-or-effect** — every op the workload ACKED before the
+         crash is present with its acked state; the one in-flight op is
+         allowed but not required (durable-but-unacked is a legal
+         superset, never a loss);
+       * **replay-twice == replay-once** — recovering the recovered
+         directory again changes no content byte;
+       * **converge** — resuming the remaining ops and rebooting yields
+         the content digest of the never-crashed world.
+  3. **torn-byte sweep** — the workload stops with a non-empty log
+     file; the last frame is cut at EVERY byte boundary (the shapes a
+     real power cut leaves) and recovery must classify a torn tail (not
+     corruption), keep every earlier acked record, and never latch.
+
+A seeded-bug self-test re-introduces the PR 13 checkpoint-ordering bug
+(retire the rotated WAL generation BEFORE spilling the dirty entries)
+in a toy store subclass and asserts the sweep CONVICTS it — proving the
+harness detects the bug class it exists for.
+
+Deliberately NOT imported from ``devtools/__init__`` — the devtools
+package stays importable with stdlib only; this module pulls in the
+numpy-backed stores and is entered via
+``python -m foremast_tpu.devtools.crashcheck`` (``make crashcheck``).
+
+Knobs (utils/knobs.py, rows in docs/configuration.md):
+  * ``CRASHCHECK_MAX_POINTS`` — per-scenario crash-point budget; the
+    sweep subsamples evenly (first and last always kept) so CI stays
+    bounded while a nightly can raise it toward exhaustive.
+  * ``CRASHCHECK_DUMP_DIR`` — where failing points freeze their
+    crashed directory + enumeration log for the CI artifact upload.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+from ..utils import knobs
+
+MAX_POINTS_KNOB = knobs.register(
+    "CRASHCHECK_MAX_POINTS", 160, int,
+    help="Per-scenario crash-point budget for the crashcheck sweep "
+         "(step + torn points each); the enumeration subsamples evenly "
+         "when the workload exposes more crossings than this.",
+    scope="devtools")
+DUMP_DIR_KNOB = knobs.register(
+    "CRASHCHECK_DUMP_DIR", "/tmp/foremast-crashcheck-dumps", str,
+    help="Directory where crashcheck freezes the crashed WAL/segment "
+         "directory and enumeration log of every FAILING crash point "
+         "(CI uploads it as an artifact).",
+    scope="devtools")
+
+STEP = 60
+T0 = 1_700_000_000 // STEP * STEP
+
+
+# --------------------------------------------------------------- plumbing
+def _injector(crash_at: int = -1):
+    """A crash-plan injector: counts seam crossings, raises at
+    ``crash_at`` (never, when -1). All chaos rates stay zero, so no RNG
+    is drawn — the workload is bit-deterministic across runs."""
+    from ..resilience.faults import FaultInjector, FaultPlan
+    return FaultInjector(FaultPlan(crash_at=crash_at), seed=0,
+                         target="crash")
+
+
+class Op:
+    """One idempotent workload step. ``fn(ctx)`` must be safe to re-run
+    after a crash + recovery (state-guarded or naturally idempotent) —
+    that is what makes the converge assertion meaningful. ``touches``
+    names the keys whose state the op mutates: when the op is the one
+    in flight at the crash, those keys may hold either the pre- or
+    post-op state after recovery."""
+
+    __slots__ = ("name", "fn", "touches")
+
+    def __init__(self, name, fn, touches=()):
+        self.name = name
+        self.fn = fn
+        self.touches = frozenset(touches)
+
+
+class PointResult:
+    __slots__ = ("scenario", "kind", "index", "seam", "op", "errors")
+
+    def __init__(self, scenario, kind, index, seam, op, errors):
+        self.scenario = scenario
+        self.kind = kind          # "step" | "torn"
+        self.index = index
+        self.seam = seam
+        self.op = op
+        self.errors = errors
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    def line(self):
+        status = "ok" if self.ok else "FAIL " + "; ".join(self.errors)
+        return (f"[{self.scenario}] {self.kind} point {self.index} "
+                f"seam={self.seam} op={self.op} -> {status}")
+
+
+def _subsample(n: int, cap: int) -> list[int]:
+    """Up to ``cap`` indices out of range(n), evenly spaced, endpoints
+    always kept — the first crossing and the final retire/truncate are
+    the classic bug sites."""
+    if n <= cap:
+        return list(range(n))
+    picked = sorted({round(i * (n - 1) / (cap - 1)) for i in range(cap)})
+    return picked
+
+
+def _last_frame_cuts(path: str) -> list[int]:
+    """Byte offsets that cut INSIDE the last frame of a segfile log —
+    every prefix length a crash mid-append can leave behind."""
+    from ..dataplane import segfile
+    buf = segfile.read_file(path)
+    frames, status, _ = segfile.scan(buf)
+    if status != segfile.SCAN_OK or not frames:
+        return []
+    last_payload_off, last_plen = frames[-1]
+    last_start = last_payload_off - segfile.FRAME_OVERHEAD
+    return list(range(last_start + 1, len(buf)))
+
+
+# ------------------------------------------------------ winstore scenario
+def _win_body(samples) -> bytes:
+    return json.dumps({
+        "status": "success",
+        "data": {"resultType": "matrix", "result": [
+            {"metric": {"__name__": "m"},
+             "values": [[t, str(v)] for t, v in samples]}
+        ]},
+    }).encode()
+
+
+class _WinBackend:
+    """Range-honoring synthetic Prometheus (tests/test_winstore.py
+    idiom). Pushed samples are deliberately NOT added to the backend:
+    if recovery loses an acked push, no repoll can paper over the hole
+    — the digest must change."""
+
+    def __init__(self, names):
+        self.series = {
+            name: [(T0 + k * STEP, round(10.0 + 0.1 * k, 3))
+                   for k in range(40)]
+            for name in names
+        }
+
+    def resolver(self, url: str) -> bytes:
+        from ..dataplane.delta import parse_range_params
+        name = url.split("?", 1)[0].rsplit("/", 1)[-1]
+        qs, qe, _ = parse_range_params(url)
+        return _win_body([(t, v) for t, v in self.series.get(name, [])
+                          if qs <= t <= qe])
+
+    def source(self):
+        from ..dataplane.fetch import RawFixtureDataSource
+        return RawFixtureDataSource(resolver=self.resolver)
+
+
+def _win_url(name):
+    return (f"http://prom/{name}?query=x&start={T0:.0f}"
+            f"&end={T0 + 86400:.0f}&step=60")
+
+
+class _WinCtx:
+    __slots__ = ("store", "src", "inj", "urls", "model", "stats")
+
+
+class WinstoreScenario:
+    """Window store + delta cache: prime -> checkpoint (entries reach
+    the segment — boot replay promotes from there) -> acked push stream
+    interleaved with checkpoints, exercising wal_append, spill,
+    rotate -> spill_dirty -> retire, and compaction replace."""
+
+    name = "winstore"
+    required_seams = ("winstore.wal_append", "winstore.spill",
+                      "winstore.checkpoint.rotate",
+                      "winstore.checkpoint.retire")
+    store_cls = None  # default WindowStore; the selftest swaps a buggy one
+
+    NAMES = ("m0", "m1", "m2")
+    # (metric index, grid slot, value) per push — deterministic
+    PUSHES = [(0, 40, 40.5), (1, 40, 41.5), (0, 41, 42.5),
+              (2, 40, 43.5), (1, 41, 44.5), (0, 42, 45.5),
+              (2, 41, 46.5)]
+
+    def _make(self, dirpath, inj):
+        from ..dataplane.delta import DeltaWindowSource
+        from ..dataplane.winstore import WindowStore
+        cls = self.store_cls or WindowStore
+        ctx = _WinCtx()
+        ctx.inj = inj
+        ctx.urls = {i: _win_url(n) for i, n in enumerate(self.NAMES)}
+        ctx.store = cls(dirpath, segment_max_bytes=4096,
+                        checkpoint_min_seconds=0.0, wal_injector=inj)
+        be = _WinBackend(self.NAMES)
+        ctx.src = DeltaWindowSource(be.source(), store=ctx.store,
+                                    clock=lambda: float(T0))
+        ctx.model = {}  # url -> [(ts, val)] acked pushes
+        return ctx
+
+    def build(self, dirpath, inj):
+        return self._make(dirpath, inj)
+
+    def recover(self, dirpath):
+        ctx = self._make(dirpath, _injector())
+        ctx.stats = ctx.store.recover(ctx.src)
+        return ctx
+
+    def ops(self):
+        def prime(ctx):
+            for u in ctx.urls.values():
+                ctx.src.fetch_window(u)
+            ctx.store.checkpoint(ctx.src, force=True)
+
+        def push(mi, slot, val):
+            ts = float(T0 + slot * STEP)
+
+            def fn(ctx):
+                u = ctx.urls[mi]
+                # receiver order: splice -> WAL -> ack (the seam between
+                # them is a real crash point the receiver lives with)
+                ctx.src.ingest_append(u, [ts], [val])
+                ctx.inj.seam("receiver.splice_to_wal")
+                if ctx.store.wal_append(u, [ts], [val]):
+                    ctx.model.setdefault(u, [])
+                    if (ts, val) not in ctx.model[u]:
+                        ctx.model[u].append((ts, val))
+            return fn, ts
+
+        def ckpt(ctx):
+            ctx.store.checkpoint(ctx.src, force=True)
+
+        out = [Op("prime", prime)]
+        for j, (mi, slot, val) in enumerate(self.PUSHES):
+            fn, ts = push(mi, slot, val)
+            out.append(Op(f"push{j}", fn,
+                          touches={(self.NAMES[mi], ts)}))
+            if j in (2, 4):
+                out.append(Op(f"ckpt{j}", ckpt))
+        out.append(Op("ckpt-final", ckpt))
+        return out
+
+    def check(self, ctx, model, extras, allow, errors):
+        for mi, u in ctx.urls.items():
+            acked = model.get(u)
+            if not acked:
+                continue
+            w = ctx.src.fetch_window(u)
+            for ts, val in acked:
+                if (self.NAMES[mi], ts) in allow:
+                    continue
+                idx = int((ts - w.start) // w.step)
+                if (idx < 0 or idx >= len(w.values)
+                        or not bool(w.mask[idx])
+                        or float(w.values[idx]) != val):
+                    errors.append(
+                        f"acked push lost: {self.NAMES[mi]} ts={ts:.0f} "
+                        f"val={val}")
+
+    def digest(self, ctx):
+        dig = hashlib.blake2b(digest_size=16)
+        for mi in sorted(ctx.urls):
+            w = ctx.src.fetch_window(ctx.urls[mi])
+            dig.update(repr((mi, float(w.start), float(w.step))).encode())
+            dig.update(w.values.tobytes())
+            dig.update(w.mask.tobytes())
+        return dig.hexdigest()
+
+    # torn sweep: stop before the final checkpoint so wal.log holds the
+    # push stream; the last frame is the last push (unacked when torn)
+    def torn_ops(self):
+        ops = self.ops()
+        return [op for op in ops if op.name != "ckpt-final"]
+
+    def torn_file(self, ctx):
+        return ctx.store.wal_path
+
+    def torn_allow(self):
+        mi, slot, _ = self.PUSHES[-1]
+        return frozenset({(self.NAMES[mi], float(T0 + slot * STEP))})
+
+    def torn_check(self, ctx, errors):
+        if ctx.stats.get("wal_scan") == "corrupt":
+            errors.append("torn tail misclassified as corruption")
+        if ctx.store.force_block:
+            errors.append("torn tail latched the store into resync")
+
+
+# ------------------------------------------------------ jobstore scenario
+class _JobCtx:
+    __slots__ = ("store", "tier", "inj", "model", "prov", "states",
+                 "stats")
+
+
+class JobstoreScenario:
+    """Tiered job store: create -> claim -> advance -> provenance spill
+    -> terminal verdict, put_state, tombstone, and tier checkpoints
+    (rotate -> spill docs/state -> retire) — every mutation WAL-ahead-
+    of-ack, every WAL/segment frame a crash point."""
+
+    name = "jobstore"
+    required_seams = ("segfile.append:wal.log", "segfile.append:jobs.seg",
+                      "jobtier.checkpoint.rotate",
+                      "jobtier.checkpoint.retire")
+
+    N_JOBS = 5
+    TORN_JID = "cc-torn"
+
+    def _make(self, dirpath, inj):
+        from ..engine.jobs import JobStore
+        from ..engine.jobtier import JobTier
+        ctx = _JobCtx()
+        ctx.inj = inj
+        ctx.tier = JobTier(dirpath, injector=inj, segment_max_bytes=4096)
+        ctx.store = JobStore(tier=ctx.tier, tier_hot_seconds=0.0,
+                             tier_checkpoint_min_seconds=0.0)
+        ctx.model = {}   # jid -> (status, reason) expected after ack
+        ctx.prov = {}    # jid -> verdict with acked provenance
+        ctx.states = {}  # key -> value
+        return ctx
+
+    def build(self, dirpath, inj):
+        return self._make(dirpath, inj)
+
+    def recover(self, dirpath):
+        ctx = self._make(dirpath, _injector())
+        ctx.stats = ctx.store.recover_from_tier()
+        return ctx
+
+    def ops(self):
+        from ..engine import jobs as J
+
+        def create(jid):
+            def fn(ctx):
+                from ..engine.jobs import Document
+                ctx.store.create(Document(
+                    id=jid, app_name="cc-app", strategy="canary",
+                    start_time="0", end_time="0"))
+                ctx.model[jid] = (J.INITIAL, "")
+            return fn
+
+        def claim(jid, worker):
+            def fn(ctx):
+                doc = ctx.store.get(jid)
+                if doc is not None and doc.status == J.INITIAL:
+                    ctx.store.claim_open_jobs(worker, limit=1,
+                                              only_ids={jid})
+                doc = ctx.store.get(jid)
+                if doc is not None and doc.status == J.PREPROCESS_INPROGRESS:
+                    ctx.model[jid] = (J.PREPROCESS_INPROGRESS, "")
+            return fn
+
+        def advance(jid):
+            def fn(ctx):
+                doc = ctx.store.get(jid)
+                if doc is not None and doc.status == J.PREPROCESS_INPROGRESS:
+                    ctx.store.advance(jid, J.PREPROCESS_COMPLETED,
+                                      J.POSTPROCESS_INPROGRESS)
+                doc = ctx.store.get(jid)
+                if (doc is not None
+                        and doc.status == J.POSTPROCESS_INPROGRESS):
+                    ctx.model[jid] = (J.POSTPROCESS_INPROGRESS, "")
+            return fn
+
+        def score(jid, verdict, reason):
+            def fn(ctx):
+                doc = ctx.store.get(jid)
+                if doc is None or doc.status in J.TERMINAL_STATUSES:
+                    return
+                # the recorder's spill hook runs before the verdict acks
+                ctx.tier.spill_prov(jid, {"job_id": jid,
+                                          "verdict": verdict,
+                                          "hops": [{"worker": "cc",
+                                                    "action": "scored"}]})
+                ctx.prov[jid] = verdict
+                ctx.store.transition(jid, verdict, reason=reason)
+                ctx.model[jid] = (verdict, reason)
+            return fn
+
+        def put_state(key, value):
+            def fn(ctx):
+                ctx.store.put_state(key, value)
+                ctx.states[key] = value
+            return fn
+
+        def tombstone(jid):
+            def fn(ctx):
+                ctx.tier.tombstone_docs([jid])
+                ctx.model[jid] = (None, "")  # gone from the tier
+            return fn
+
+        def ckpt(ctx):
+            ctx.store.tier_checkpoint(force=True)
+
+        out = []
+        for i in range(self.N_JOBS):
+            jid = f"cc-{i:03d}"
+            worker = f"w{i % 2}"
+            verdict = (J.COMPLETED_UNHEALTH if i % 2 == 0
+                       else J.COMPLETED_HEALTH)
+            out.append(Op(f"create:{jid}", create(jid), touches={jid}))
+            out.append(Op(f"claim:{jid}", claim(jid, worker),
+                          touches={jid}))
+            if i == 1:
+                out.append(Op("ckpt-a", ckpt))
+            out.append(Op(f"advance:{jid}", advance(jid), touches={jid}))
+            if i != 3:  # cc-003 stays claimed-in-flight across the crash
+                out.append(Op(f"score:{jid}",
+                              score(jid, verdict, f"scored #{i}"),
+                              touches={jid}))
+            if i == 2:
+                out.append(Op("state:epoch", put_state("epoch", {"n": 7}),
+                              touches={"state:epoch"}))
+        # a scored job whose record of truth moved to a peer: tombstoned
+        out.append(Op("tombstone:cc-000", tombstone("cc-000"),
+                      touches={"cc-000"}))
+        out.append(Op("ckpt-b", ckpt))
+        out.append(Op(f"create:{self.TORN_JID}", create(self.TORN_JID),
+                      touches={self.TORN_JID}))
+        out.append(Op("ckpt-final", ckpt))
+        return out
+
+    def check(self, ctx, model, extras, allow, errors):
+        for jid, (status, reason) in model.items():
+            if jid in allow:
+                continue
+            doc = ctx.store.get(jid)
+            if status is None:
+                # tombstoned: the tier must not resurrect it
+                if ctx.tier.status_of(jid) is not None:
+                    errors.append(f"tombstoned doc resurrected: {jid}")
+                continue
+            if doc is None:
+                errors.append(f"acked doc lost: {jid} (expected {status})")
+                continue
+            if doc.status != status:
+                errors.append(f"acked status lost: {jid} "
+                              f"{doc.status} != {status}")
+            elif reason and doc.reason != reason:
+                errors.append(f"acked reason lost: {jid} "
+                              f"{doc.reason!r} != {reason!r}")
+        for key, value in extras.get("states", {}).items():
+            if ("state:" + key) in allow:
+                continue
+            got = ctx.store.get_state(key)
+            if got != value:
+                errors.append(f"acked state lost: {key} "
+                              f"{got!r} != {value!r}")
+        for jid, verdict in extras.get("prov", {}).items():
+            if jid in allow:
+                continue
+            rec = ctx.tier.get_prov(jid)
+            if rec is None or rec.get("verdict") != verdict:
+                errors.append(f"acked provenance lost: {jid}")
+
+    def digest(self, ctx):
+        from ..engine.jobs import verdict_digest
+        dig = hashlib.blake2b(digest_size=16)
+        dig.update(verdict_digest(ctx.store).encode())
+        for key in ("epoch",):
+            dig.update(repr((key, ctx.store.get_state(key))).encode())
+        for i in range(self.N_JOBS):
+            jid = f"cc-{i:03d}"
+            rec = ctx.tier.get_prov(jid)
+            dig.update(repr((jid, rec and rec.get("verdict"))).encode())
+        return dig.hexdigest()
+
+    def torn_ops(self):
+        ops = self.ops()
+        return [op for op in ops if op.name != "ckpt-final"]
+
+    def torn_file(self, ctx):
+        return ctx.tier.wal_path
+
+    def torn_allow(self):
+        # the last WAL frame is the torn-target create
+        return frozenset({self.TORN_JID})
+
+    def torn_check(self, ctx, errors):
+        if ctx.stats.get("wal_scan") == "corrupt":
+            errors.append("torn WAL tail misclassified as corruption")
+
+
+# ------------------------------------------------------- archive scenario
+class _ArcCtx:
+    __slots__ = ("ar", "inj", "model", "states", "stats")
+
+
+class ArchiveScenario:
+    """Append-only two-generation FileArchive: indexed documents, CAS
+    claims, state blobs, and size-triggered compaction (merge -> replace
+    `.1` -> truncate active) — the crash between replace and truncate
+    leaves records in BOTH generations and the newest-wins view must
+    read through unchanged."""
+
+    name = "archive"
+    required_seams = ("archive.append",)
+
+    N_DOCS = 8
+
+    def _make(self, dirpath, inj):
+        from ..engine.archive import FileArchive
+        os.makedirs(dirpath, exist_ok=True)
+        ctx = _ArcCtx()
+        ctx.inj = inj
+        # keep_terminal_seconds huge: the workload's deterministic
+        # modified_at stamps must never age out mid-sweep
+        ctx.ar = FileArchive(os.path.join(dirpath, "archive.dat"),
+                             max_bytes=1024, keep_terminal_seconds=1e12,
+                             injector=inj)
+        ctx.model = {}   # id -> (status, modified_at) acked
+        ctx.states = {}  # key -> value acked
+        ctx.stats = {}
+        return ctx
+
+    def build(self, dirpath, inj):
+        return self._make(dirpath, inj)
+
+    def recover(self, dirpath):
+        # the archive has no replay step: "recovery" is a fresh process
+        # reading the two generations through the torn-tail-safe scan
+        return self._make(dirpath, _injector())
+
+    def ops(self):
+        def index(jid, status, stamp):
+            def fn(ctx):
+                if ctx.ar.index_job({"id": jid, "status": status,
+                                     "modified_at": stamp}):
+                    ctx.model[jid] = (status, stamp)
+            return fn
+
+        def claim(jid, expect, stamp):
+            def fn(ctx):
+                ctx.ar.claim_job(jid, expect,
+                                 {"id": jid, "status": "inprogress",
+                                  "modified_at": stamp})
+                rec = ctx.ar.get(jid)
+                if rec is not None and rec.get("modified_at") == stamp:
+                    ctx.model[jid] = ("inprogress", stamp)
+            return fn
+
+        def state(key, value, stamp):
+            def fn(ctx):
+                if ctx.ar.index_state(key, value, stamp):
+                    ctx.states[key] = value
+            return fn
+
+        out = []
+        for i in range(self.N_DOCS):
+            jid = f"arc-{i:03d}"
+            out.append(Op(f"index:{jid}",
+                          index(jid, "new", 1000.0 + i), touches={jid}))
+            if i % 2 == 0:
+                out.append(Op(f"claim:{jid}",
+                              claim(jid, 1000.0 + i, 2000.0 + i),
+                              touches={jid}))
+            if i % 3 == 0:
+                out.append(Op(f"state:s{i}",
+                              state(f"s{i}", {"i": i}, 3000.0 + i),
+                              touches={f"state:s{i}"}))
+        # a terminal re-index over a claim: newest-wins merge material
+        out.append(Op("index:arc-000-done",
+                      index("arc-000", "success", 4000.0),
+                      touches={"arc-000"}))
+        return out
+
+    def check(self, ctx, model, extras, allow, errors):
+        for jid, (status, stamp) in model.items():
+            if jid in allow:
+                continue
+            rec = ctx.ar.get(jid)
+            if rec is None:
+                errors.append(f"acked archive record lost: {jid}")
+            elif (rec.get("status"), rec.get("modified_at")) \
+                    != (status, stamp):
+                errors.append(
+                    f"acked archive record regressed: {jid} "
+                    f"{rec.get('status')}@{rec.get('modified_at')} "
+                    f"!= {status}@{stamp}")
+        for key, value in extras.get("states", {}).items():
+            if ("state:" + key) in allow:
+                continue
+            got = ctx.ar.get_state(key)
+            got_v = got[0] if isinstance(got, tuple) else got
+            if got_v != value:
+                errors.append(f"acked archive state lost: {key}")
+
+    def digest(self, ctx):
+        dig = hashlib.blake2b(digest_size=16)
+        for i in range(self.N_DOCS):
+            jid = f"arc-{i:03d}"
+            rec = ctx.ar.get(jid) or {}
+            dig.update(repr((jid, rec.get("status"),
+                             rec.get("modified_at"))).encode())
+        for i in range(self.N_DOCS):
+            dig.update(repr((f"s{i}", ctx.ar.get_state(f"s{i}"))).encode())
+        return dig.hexdigest()
+
+    def torn_ops(self):
+        return self.ops()
+
+    def torn_file(self, ctx):
+        return ctx.ar.path
+
+    def torn_allow(self):
+        return frozenset({"arc-000"})  # the final re-index frame
+
+    def torn_check(self, ctx, errors):
+        pass  # the framed scan truncates; check() proves the content
+
+
+# ------------------------------------------------------------- the sweep
+def _freeze(src_dir: str, dst_dir: str) -> str:
+    shutil.rmtree(dst_dir, ignore_errors=True)
+    shutil.copytree(src_dir, dst_dir)
+    return dst_dir
+
+
+def _model_copy(scn, ctx):
+    model = {k: list(v) if isinstance(v, list) else v
+             for k, v in ctx.model.items()}
+    extras = {}
+    for attr in ("prov", "states"):
+        if hasattr(ctx, attr):
+            extras[attr] = dict(getattr(ctx, attr))
+    return model, extras
+
+
+def _check_all(scn, rctx, model, extras, allow, errors):
+    scn.check(rctx, model, extras, allow, errors)
+
+
+def _run_clean(scn, workdir, ops):
+    """Clean run -> (crossing count, seam log, baseline digest)."""
+    d = os.path.join(workdir, "clean")
+    inj = _injector()
+    ctx = scn.build(d, inj)
+    for op in ops:
+        op.fn(ctx)
+    crossings, seams = inj.seam_crossings, list(inj.seam_log)
+    baseline = scn.digest(scn.recover(d))
+    return crossings, seams, baseline
+
+
+def _eval_step_point(scn, workdir, ops, k, seams, baseline):
+    d = os.path.join(workdir, f"step-{k}")
+    inj = _injector(crash_at=k)
+    ctx = scn.build(d, inj)
+    crashed = None
+    op_idx = len(ops)
+    for i, op in enumerate(ops):
+        try:
+            op.fn(ctx)
+        except BaseException as e:  # noqa: BLE001 - SimulatedCrash only
+            from ..resilience.faults import SimulatedCrash
+            if not isinstance(e, SimulatedCrash):
+                raise
+            crashed, op_idx = e, i
+            break
+    seam = seams[k] if k < len(seams) else "?"
+    if crashed is None:
+        return PointResult(scn.name, "step", k, seam, "-",
+                           ["crash point never fired"])
+    model, extras = _model_copy(scn, ctx)
+    allow = ops[op_idx].touches
+    errors = []
+    # the crashed dir IS the post-crash disk image (all durable state
+    # is plain files; RAM dies with the exception)
+    frozen = _freeze(d, os.path.join(workdir, f"step-{k}-img"))
+
+    # A: real recovery + record-or-effect
+    rctx = scn.recover(frozen)
+    _check_all(scn, rctx, model, extras, allow, errors)
+    d1 = scn.digest(rctx)
+
+    # B: replay twice == replay once (a second boot over the recovered
+    # directory changes no content byte)
+    rctx2 = scn.recover(frozen)
+    d2 = scn.digest(rctx2)
+    if d2 != d1:
+        errors.append(f"replay-twice digest mismatch ({d1} != {d2})")
+
+    # C: resume the remaining ops (idempotent by construction) on the
+    # recovered world, reboot, and converge on the uncrashed digest
+    for attr, val in extras.items():
+        if attr in getattr(type(rctx2), "__slots__", ()):
+            setattr(rctx2, attr, val)
+    rctx2.model = model
+    for op in ops[op_idx:]:
+        op.fn(rctx2)
+    dfin = scn.digest(scn.recover(frozen))
+    if dfin != baseline:
+        errors.append(
+            f"resume did not converge (digest {dfin} != baseline "
+            f"{baseline})")
+    shutil.rmtree(d, ignore_errors=True)
+    if not errors:
+        shutil.rmtree(frozen, ignore_errors=True)
+    return PointResult(scn.name, "step", k, seam,
+                       ops[op_idx].name, errors)
+
+
+def _eval_torn_points(scn, workdir, cap, out):
+    """Cut the last frame of the scenario's live log at every byte
+    boundary; each cut is the disk image a crash mid-append leaves."""
+    ops = scn.torn_ops()
+    d = os.path.join(workdir, "torn-src")
+    ctx = scn.build(d, _injector())
+    for op in ops:
+        op.fn(ctx)
+    model, extras = _model_copy(scn, ctx)
+    path = scn.torn_file(ctx)
+    cuts = _last_frame_cuts(path)
+    allow = scn.torn_allow()
+    rel = os.path.relpath(path, d)
+    for j in _subsample(len(cuts), cap):
+        cut = cuts[j]
+        img = _freeze(d, os.path.join(workdir, f"torn-{cut}"))
+        with open(os.path.join(img, rel), "r+b") as f:
+            f.truncate(cut)
+        errors = []
+        rctx = scn.recover(img)
+        scn.torn_check(rctx, errors)
+        _check_all(scn, rctx, model, extras, allow, errors)
+        d1 = scn.digest(rctx)
+        d2 = scn.digest(scn.recover(img))
+        if d2 != d1:
+            errors.append(f"replay-twice digest mismatch ({d1} != {d2})")
+        if not errors:
+            shutil.rmtree(img, ignore_errors=True)
+        out.append(PointResult(scn.name, "torn", cut,
+                               os.path.basename(path), "tail-cut",
+                               errors))
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def sweep(scn, workdir, max_points, log=lambda s: None):
+    """Run one scenario's full enumeration. Returns PointResults."""
+    results = []
+    ops = scn.ops()
+    crossings, seams, baseline = _run_clean(scn, workdir, ops)
+    log(f"[{scn.name}] {crossings} seam crossings "
+        f"({len(set(seams))} distinct seams), baseline {baseline}")
+    missing = [s for s in scn.required_seams if s not in set(seams)]
+    if missing:
+        results.append(PointResult(
+            scn.name, "step", -1, "registry", "-",
+            [f"required seams never crossed: {missing}"]))
+    for k in _subsample(crossings, max_points):
+        r = _eval_step_point(scn, workdir, ops, k, seams, baseline)
+        results.append(r)
+        log(r.line())
+    torn = []
+    _eval_torn_points(scn, workdir, max_points, torn)
+    for r in torn:
+        log(r.line())
+    results.extend(torn)
+    return results
+
+
+# ------------------------------------------------------ seeded-bug proof
+def _buggy_store_cls():
+    """WindowStore with the PR 13 checkpoint-ordering bug re-introduced:
+    the rotated WAL generation is RETIRED before the dirty entries are
+    spilled. A crash in that gap loses every acked push of the rotated
+    generation — the exact bug class this harness exists to convict."""
+    from ..dataplane.winstore import WindowStore
+    from ..resilience.faults import seam_point
+
+    class _BuggyWindowStore(WindowStore):
+        def checkpoint(self, delta, force=False):
+            with self._wal_lock:
+                wal_bytes = os.path.getsize(self.wal_path) \
+                    if os.path.exists(self.wal_path) else 0
+                if wal_bytes and not os.path.exists(self.wal_old_path):
+                    seam_point(self, "buggy.checkpoint.rotate")
+                    os.replace(self.wal_path, self.wal_old_path)
+                # BUG (seeded, on purpose): retire BEFORE the spill —
+                # between the unlink and the spill the acked pushes have
+                # neither a WAL record nor a segment effect
+                seam_point(self, "buggy.checkpoint.retire")
+                try:
+                    os.unlink(self.wal_old_path)
+                except FileNotFoundError:
+                    pass
+            seam_point(self, "buggy.checkpoint.spill")
+            spilled = delta.spill_dirty()
+            self.checkpoints += 1
+            return {"spilled": spilled, "wal_bytes_rotated": wal_bytes}
+
+    return _BuggyWindowStore
+
+
+def run_selftest(workdir, max_points, log=lambda s: None):
+    """Sweep the winstore workload against the buggy store. Returns the
+    FAILING points — the self-test passes when this is non-empty (the
+    harness convicts the seeded bug) and the caller also ran the real
+    stores clean."""
+    scn = WinstoreScenario()
+    scn.store_cls = _buggy_store_cls()
+    scn.required_seams = ()  # the buggy store names its seams buggy.*
+    results = sweep(scn, workdir, max_points, log)
+    return [r for r in results if not r.ok and r.index >= 0]
+
+
+# ------------------------------------------------------------------- CLI
+SCENARIOS = {
+    "winstore": WinstoreScenario,
+    "jobstore": JobstoreScenario,
+    "archive": ArchiveScenario,
+}
+
+#: acceptance floor: the sweep must enumerate at least this many
+#: distinct crash points across the store seams or the run fails —
+#: a silently shrunken workload must not pass as coverage.
+MIN_POINTS = 30
+
+
+def _dump_failures(results, workdir, dump_dir, log_lines):
+    os.makedirs(dump_dir, exist_ok=True)
+    with open(os.path.join(dump_dir, "crashcheck.log"), "w") as f:
+        f.write("\n".join(log_lines) + "\n")
+    for r in results:
+        if r.ok:
+            continue
+        img = os.path.join(workdir, f"{r.kind}-{r.index}-img")
+        alt = os.path.join(workdir, f"{r.kind}-{r.index}")
+        for src in (img, alt):
+            if os.path.isdir(src):
+                dst = os.path.join(
+                    dump_dir, f"{r.scenario}-{r.kind}-{r.index}")
+                shutil.rmtree(dst, ignore_errors=True)
+                shutil.copytree(src, dst)
+                break
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m foremast_tpu.devtools.crashcheck",
+        description="Exhaustive crash-point sweep over the durable "
+                    "stores (step + torn-byte enumeration, real "
+                    "recovery at every point).")
+    ap.add_argument("--scenario", choices=[*SCENARIOS, "all"],
+                    default="all")
+    ap.add_argument("--max-points", type=int,
+                    default=MAX_POINTS_KNOB.read(),
+                    help="per-scenario crash-point budget "
+                         "(CRASHCHECK_MAX_POINTS)")
+    ap.add_argument("--dump-dir", default=DUMP_DIR_KNOB.read(),
+                    help="where failing points freeze their disk image "
+                         "(CRASHCHECK_DUMP_DIR)")
+    ap.add_argument("--no-selftest", action="store_true",
+                    help="skip the seeded-bug conviction proof")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    log_lines: list[str] = []
+
+    def log(s):
+        log_lines.append(s)
+        if not args.quiet:
+            print(s)
+
+    def say(s):
+        # summary lines print even under -q: CI greps these
+        log_lines.append(s)
+        print(s)
+
+    names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    results: list[PointResult] = []
+    with tempfile.TemporaryDirectory(prefix="crashcheck-") as workdir:
+        for name in names:
+            scn = SCENARIOS[name]()
+            results.extend(sweep(scn, os.path.join(workdir, name),
+                                 args.max_points, log))
+
+        convicted = None
+        if not args.no_selftest:
+            convicted = run_selftest(
+                os.path.join(workdir, "selftest"), args.max_points,
+                lambda s: None)
+            if convicted:
+                say(f"selftest: seeded retire-before-spill bug convicted "
+                    f"at {len(convicted)} point(s), e.g. "
+                    f"{convicted[0].line()}")
+            else:
+                say("selftest: FAIL — the seeded retire-before-spill bug "
+                    "was NOT convicted; the harness is blind")
+
+        failures = [r for r in results if not r.ok]
+        by_seam: dict[str, int] = {}
+        for r in results:
+            by_seam[r.seam] = by_seam.get(r.seam, 0) + 1
+        total = len([r for r in results if r.index >= 0])
+        say(f"crashcheck: {total} crash points across "
+            f"{len(by_seam)} seams "
+            f"({', '.join(sorted(by_seam))}); "
+            f"{len(failures)} failure(s)")
+        if args.scenario == "all" and total < MIN_POINTS:
+            say(f"crashcheck: FAIL — only {total} crash points "
+                f"enumerated (< {MIN_POINTS}); the workload shrank")
+            failures.append(PointResult("harness", "step", -1, "floor",
+                                        "-", ["coverage floor"]))
+        if failures:
+            _dump_failures(results, workdir, args.dump_dir, log_lines)
+            say(f"crashcheck: crashed images + log frozen under "
+                f"{args.dump_dir}")
+            return 1
+        if convicted is not None and not convicted:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,7 @@ except ImportError:  # Windows: no flock; single-process archives only
     fcntl = None
 
 from ..dataplane import segfile
+from ..resilience.faults import seam_point
 from ..utils.locks import make_lock
 
 __all__ = ["FileArchive", "EsArchive", "MEMBER_STATE_PREFIX"]
@@ -191,10 +192,15 @@ class FileArchive:
 
     def __init__(self, path: str, max_bytes: int = 64 * 1024 * 1024,
                  keep_hpalogs: int = 1000,
-                 keep_terminal_seconds: float = 30 * 86400.0):
+                 keep_terminal_seconds: float = 30 * 86400.0,
+                 injector=None):
         self.path = path
         self.max_bytes = max_bytes
         self.keep_hpalogs = keep_hpalogs
+        # resilience/faults.py FaultInjector carrying a crash plan: the
+        # append/compact seam_point crossings below are what let the
+        # crashcheck sweep cut between any two archive mutations
+        self.injector = injector
         # compaction retention for TERMINAL documents: without an age
         # bound, unique per-rollout job ids accumulate forever and every
         # compaction rewrites the whole history under the flock. Open
@@ -223,6 +229,11 @@ class FileArchive:
         # cross-process lock can destroy another replica's append)
         self.lock_degradations = 0
         self.compactions_skipped_unlocked = 0
+        # detected short writes (disk full mid-record): rolled back to
+        # the pre-append size under the cross-process lock, else left as
+        # a torn tail the framed scan truncates; either way the append
+        # reports failure so the caller keeps its RAM copy
+        self.append_short_writes = 0
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -297,18 +308,36 @@ class FileArchive:
             return True  # absent: next append starts a framed file
         return len(head) < len(segfile.MAGIC) or head == segfile.MAGIC
 
-    def _raw_append_locked(self, payload: bytes) -> bool:
+    def _raw_append_locked(self, payload: bytes,
+                           cross_locked: bool = True) -> bool:
         """One interleave-atomic write(2) (caller holds the flock).
-        Shared by _append and claim_job so the write path cannot drift."""
+        Shared by _append and claim_job so the write path cannot drift.
+        Deliberately NOT a write loop — the record must land as a single
+        write(2) or concurrent peer appends could interleave into it —
+        so a detected short write takes the rollback arm instead:
+        ftruncate back to the pre-append size while the cross-process
+        lock guarantees no peer appended after us. Without that lock the
+        torn tail stays (the framed scan truncates it; truncating
+        ourselves could destroy a peer's record)."""
         if self._active_framed_locked():
             blob = segfile.frame(payload)
         else:
             blob = payload + b"\n"  # legacy file: stay line-framed
+        seam_point(self, "archive.append")
         try:
             fd = os.open(self.path,
                          os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
             try:
-                os.write(fd, blob)
+                base = os.fstat(fd).st_size
+                n = os.write(fd, blob)
+                if n != len(blob):
+                    self.append_short_writes += 1
+                    if cross_locked:
+                        try:
+                            os.ftruncate(fd, base)
+                        except OSError:
+                            pass
+                    return False  # caller keeps RAM copy
             finally:
                 os.close(fd)
         except OSError:
@@ -320,7 +349,7 @@ class FileArchive:
         with self._flock() as lk:
             self._maybe_compact_locked(
                 len(payload) + segfile.FRAME_OVERHEAD, lk.cross_locked)
-            return self._raw_append_locked(payload)
+            return self._raw_append_locked(payload, lk.cross_locked)
 
     def _compact_locked(self):
         """Merge both generations into `.1`, last-write-wins (caller holds
@@ -372,8 +401,13 @@ class FileArchive:
                     json.dumps(rec, separators=(",", ":")).encode()))
             f.flush()
             os.fsync(f.fileno())
+        seam_point(self, "archive.compact.replace")
         os.replace(tmp, self.path + ".1")
         # truncate the active file (its records now live compacted in .1)
+        # — a crash between the replace above and this truncate leaves
+        # every record present in BOTH generations, which the newest-wins
+        # view merge reads through unchanged (crashcheck enumerates it)
+        seam_point(self, "archive.compact.truncate")
         fd = os.open(self.path, os.O_WRONLY | os.O_TRUNC | os.O_CREAT, 0o644)
         os.close(fd)
         self.compactions += 1
@@ -412,7 +446,7 @@ class FileArchive:
                 return False
             if latest.get("modified_at", 0.0) != expected_modified_at:
                 return False
-            return self._raw_append_locked(payload)
+            return self._raw_append_locked(payload, lk.cross_locked)
 
     def index_hpalog(self, log: dict) -> bool:
         return self._append({"_type": "hpalog", **log})

@@ -8,7 +8,6 @@ min-data-point gates per pairwise test, and the stuck-job takeover limit.
 """
 from __future__ import annotations
 
-import math
 import os
 from dataclasses import dataclass, field
 

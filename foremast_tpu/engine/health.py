@@ -33,7 +33,6 @@ and as the `foremastbrain:health_state` gauge (0 ok / 1 degraded /
 """
 from __future__ import annotations
 
-import threading
 import time
 
 from ..utils.locks import make_lock

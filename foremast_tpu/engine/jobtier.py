@@ -45,6 +45,7 @@ import time
 
 from ..dataplane import segfile
 from ..dataplane.segfile import SCAN_OK
+from ..resilience.faults import seam_point
 from ..utils.locks import make_lock
 
 log = logging.getLogger("foremast_tpu.engine.jobtier")
@@ -401,6 +402,7 @@ class JobTier:
                 off += segfile.FRAME_OVERHEAD + len(payload)
             f.flush()
             os.fsync(f.fileno())
+        seam_point(self, "jobtier.compact.replace")
         os.replace(tmp, self.seg_path)
         self._index = new_index
         self._seg_mm = None  # old views stay valid; next read re-maps
@@ -511,6 +513,7 @@ class JobTier:
             if os.path.exists(self.wal_old_path):
                 return False
             if os.path.exists(self.wal_path):
+                seam_point(self, "jobtier.checkpoint.rotate")
                 os.replace(self.wal_path, self.wal_old_path)
             return True
 
@@ -518,6 +521,7 @@ class JobTier:
         """Drop the rotated generation — caller asserts zero spill debt
         (every record in it now has its effect in the segment)."""
         with self._wal_lock:
+            seam_point(self, "jobtier.checkpoint.retire")
             try:
                 os.unlink(self.wal_old_path)
             except FileNotFoundError:

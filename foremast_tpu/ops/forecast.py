@@ -185,7 +185,6 @@ def _des_1d(x, mask, alpha, beta):
 
 def _hw_1d(x, mask, period: int, alpha, beta, gamma):
     """Additive Holt-Winters with static seasonal period."""
-    T = x.shape[0]
     m0 = mask[:period].astype(_F)
     n0 = jnp.maximum(jnp.sum(m0), 1.0)
     l0 = jnp.sum(jnp.where(mask[:period], x[:period].astype(_F), 0.0)) / n0

@@ -95,7 +95,6 @@ def _des_assoc_1d(x, mask, alpha, beta):
     Gap step: l' = l + b, b' = b. Both affine in v.
     """
     x = x.astype(_F)
-    T = x.shape[0]
     m = mask.astype(_F)
     l0 = _first_valid(x, mask)
     v0 = jnp.stack([l0, jnp.asarray(0.0, _F)])

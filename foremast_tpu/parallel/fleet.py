@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import forecast as fc
 from ..ops.pairwise import sign_test_exact, two_sample_tests
-from .mesh import FLEET_AXIS, fleet_sharding, replicated
+from .mesh import FLEET_AXIS, fleet_sharding
 
 __all__ = ["score_pairs", "pair_arg_spec", "make_fleet_scorer",
            "fleet_summary", "COMBINE_ANY", "COMBINE_ALL"]
@@ -215,7 +215,6 @@ def make_fleet_scorer(mesh, k: int = 8):
     verdict reduction riding ICI.
     """
     shard = fleet_sharding(mesh)
-    repl = replicated(mesh)
     n_shards = mesh.shape[FLEET_AXIS]
 
     @partial(

@@ -15,7 +15,6 @@ deadlock), in transition order per breaker.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable
 

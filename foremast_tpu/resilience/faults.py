@@ -16,6 +16,11 @@ FOREMAST_CHAOS grammar (full reference: docs/resilience.md):
                                               at the job-store segment +
                                               WAL appends
                                               (dataplane/segfile.py)
+             | 'crash=' N                     simulated power cut: raise
+                                              SimulatedCrash at the N-th
+                                              durable-seam crossing
+                                              (@durable_seam sites; the
+                                              crashcheck harness sweeps N)
              | target '.' fault '=' value
     target  := 'fetch' | 'archive' | 'kube' | 'push' | 'wal'
     fault   := 'error'   '=' PROB            random injected error
@@ -61,9 +66,9 @@ hash), so adding a kube clause cannot shift the fetch stream's decisions.
 """
 from __future__ import annotations
 
+import functools
 import logging
 import random
-import threading
 import time
 import zlib
 from dataclasses import dataclass, field
@@ -99,6 +104,65 @@ class InjectedArchiveError(InjectedError):
 class InjectedKubeError(KubeError, InjectedError):
     def __init__(self, message: str):
         KubeError.__init__(self, message, status=0)
+
+
+class SimulatedCrash(BaseException):
+    """Raised by a crash-plan injector (``crash=N``) at the N-th durable
+    seam crossing. Subclasses BaseException ON PURPOSE: the stores'
+    degrade handlers (``except OSError`` / ``except Exception``) must not
+    be able to swallow a simulated power cut — a real crash is not
+    catchable either. Only the crashcheck harness
+    (devtools/crashcheck.py) catches it, then freezes the directory as
+    the post-crash disk image."""
+
+    def __init__(self, seam: str, crossing: int):
+        super().__init__(f"simulated crash at seam {seam!r} "
+                         f"(crossing #{crossing})")
+        self.seam = seam
+        self.crossing = crossing
+
+
+# durable-seam registry: "<module>.<qualname>" -> seam name, filled at
+# import time by @durable_seam below. The crashcheck harness asserts its
+# scenario sweeps cross every registered seam, and the static
+# `unchecked-write` rule (devtools/checks.py) mirrors the module list —
+# registering a new write-point here is what puts it under both checkers.
+DURABLE_SEAMS: dict[str, str] = {}
+
+
+def durable_seam(name: str):
+    """Mark a store method as a durable write-point (a crash boundary).
+
+    The wrapped method fires ``injector.seam(name)`` before running —
+    the injector found on ``self.injector`` (jobtier/archive) or
+    ``self.wal_injector`` (winstore) — so a ``crash=N`` plan can cut the
+    process exactly between any two durable operations. Without an
+    injector (production) the cost is two getattr calls."""
+
+    def deco(fn):
+        DURABLE_SEAMS[f"{fn.__module__}.{fn.__qualname__}"] = name
+
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            seam_point(self, name)
+            return fn(self, *args, **kwargs)
+
+        wrapper.__durable_seam__ = name
+        return wrapper
+
+    return deco
+
+
+def seam_point(obj, name: str) -> None:
+    """Inline durable-seam crossing for mid-method steps (the checkpoint
+    rotate/retire and compaction replaces that are not methods of their
+    own). Same injector discovery as @durable_seam."""
+    inj = getattr(obj, "injector", None)
+    if inj is None:
+        inj = getattr(obj, "wal_injector", None)
+    seam = getattr(inj, "seam", None)
+    if seam is not None:
+        seam(name)
 
 
 @dataclass
@@ -137,6 +201,11 @@ class FaultPlan:
     # and segment spill paths must degrade under
     disk_rate: float = 0.0
     disk_kind: str = "short"
+    # simulated power cut (targetless clause ``crash=N``): raise
+    # SimulatedCrash at the N-th durable-seam crossing (@durable_seam /
+    # seam_point sites). -1 = off. Counter-deterministic, no randomness —
+    # the crashcheck harness enumerates N over a whole workload.
+    crash_at: int = -1
 
     def active(self) -> bool:
         return bool(
@@ -144,7 +213,7 @@ class FaultPlan:
             or self.garbage_rate or self.flap_down or self.outages
             or self.spikes or self.hang_rate or self.duplicate_rate
             or self.reorder_rate or self.late_rate or self.torn_rate
-            or self.disk_rate
+            or self.disk_rate or self.crash_at >= 0
         )
 
 
@@ -184,6 +253,16 @@ def parse_chaos_spec(spec: str) -> tuple[int, dict[str, FaultPlan]]:
             plan = plans.setdefault("disk", FaultPlan())
             plan.disk_rate = float(rate)
             plan.disk_kind = kind
+            continue
+        if key == "crash":
+            # targetless like disk: the durable seams are registered in
+            # one place (@durable_seam), not per-boundary wrappers
+            at = int(value)
+            if at < 0:
+                raise ValueError(f"crash needs a crossing index >= 0, "
+                                 f"got {value!r}")
+            plan = plans.setdefault("crash", FaultPlan())
+            plan.crash_at = at
             continue
         target, dot, fault = key.partition(".")
         if not dot or target not in ("fetch", "archive", "kube", "push",
@@ -271,6 +350,11 @@ class FaultInjector:
         # rationale as decide_push
         self.disk_calls = 0
         self.injected_disk = 0
+        # durable-seam stream (seam): pure counting, no randomness — the
+        # log doubles as the crash-point enumeration record crashcheck
+        # prints on conviction
+        self.seam_crossings = 0
+        self.seam_log: list[str] = []
 
     def decide(self) -> str:
         """Advance one call: maybe sleep (latency), then return OK / ERROR
@@ -366,6 +450,19 @@ class FaultInjector:
             if late:
                 self.injected_late += 1
         return dup, reorder, late
+
+    def seam(self, name: str) -> None:
+        """Advance one durable-seam crossing (@durable_seam / seam_point
+        sites) and simulate the power cut when the crossing index hits
+        the plan's ``crash_at``. Deterministic from the call sequence
+        alone — no randomness, so sweeping crash_at over [0, crossings)
+        enumerates every inter-operation crash window exactly once."""
+        with self._lock:
+            i = self.seam_crossings
+            self.seam_crossings += 1
+            self.seam_log.append(name)
+        if i == self.plan.crash_at:
+            raise SimulatedCrash(name, i)
 
     def decide_disk(self) -> str:
         """Advance one store append (dataplane/segfile.py seam): '' for a

@@ -17,7 +17,6 @@ triage):
 from __future__ import annotations
 
 import random
-import threading
 import time
 from collections import deque
 from typing import Callable

@@ -3,7 +3,6 @@ surface (bench.py children); pin their record shapes on tiny inputs."""
 import os
 import sys
 
-import numpy as np
 
 # bench.py lives at the repo root (driver contract), not in the package;
 # make the import work under bare `pytest` from any CWD

@@ -1,0 +1,99 @@
+"""Crash-consistency sanitizer self-verification (`make crashcheck`,
+ISSUE 20, foremast_tpu/devtools/crashcheck.py).
+
+The harness is only trustworthy if it can (a) convict a KNOWN bug and
+(b) acquit the shipped stores. Both directions are tested here with
+small per-scenario budgets so tier-1 stays fast — the exhaustive sweep
+runs as its own CI job (`make crashcheck`).
+
+  * seeded-bug conviction: the PR 13 retire-before-spill checkpoint
+    ordering, re-introduced in a toy WindowStore subclass, must FAIL
+    the sweep with "acked push lost" / digest-divergence evidence at a
+    buggy.* seam;
+  * real stores acquitted: every registered scenario sweeps clean at a
+    reduced budget, and the three required-seam families (winstore WAL,
+    jobtier segfile, archive append) all appear in the enumeration;
+  * CLI contract: `--scenario X -q` exits 0 on the shipped tree and the
+    always-printed summary line is grep-able by CI.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from foremast_tpu.devtools import crashcheck as cc
+
+
+def test_selftest_convicts_seeded_retire_before_spill(tmp_path):
+    """The harness must prove it can see: the seeded checkpoint-ordering
+    bug (retire the rotated WAL before spilling the dirty entries) has a
+    crash window in which acked pushes have neither a WAL record nor a
+    segment effect — the sweep must fail at least one point there, with
+    lost-record or digest-divergence evidence."""
+    failures = cc.run_selftest(str(tmp_path), max_points=160)
+    assert failures, "the seeded bug escaped the sweep — harness is blind"
+    assert any(r.seam.startswith("buggy.") for r in failures), \
+        [r.line() for r in failures]
+    blob = " ".join(e for r in failures for e in r.errors)
+    assert "lost" in blob or "converge" in blob, blob
+
+
+@pytest.mark.parametrize("name", sorted(cc.SCENARIOS))
+def test_real_scenarios_sweep_clean(name, tmp_path):
+    """Every shipped store passes every enumerated crash point: record-
+    or-effect, replay-twice == replay-once, resume converges to the
+    uncrashed baseline digest."""
+    results = cc.sweep(cc.SCENARIOS[name](), str(tmp_path), max_points=12)
+    bad = [r for r in results if not r.ok]
+    assert not bad, "\n".join(r.line() for r in bad)
+    # the budget never subsamples down to nothing
+    assert sum(1 for r in results if r.index >= 0) >= 5
+
+
+def test_enumeration_covers_required_seam_families(tmp_path):
+    """Across the three scenarios at a modest budget the sweep clears the
+    MIN_POINTS acceptance floor and crosses each store family's seams —
+    a silently shrunken workload must not pass as coverage."""
+    total = 0
+    seams: set[str] = set()
+    for name, cls in sorted(cc.SCENARIOS.items()):
+        wd = tmp_path / name
+        wd.mkdir()
+        results = cc.sweep(cls(), str(wd), max_points=20)
+        assert all(r.ok for r in results), \
+            (name, [r.line() for r in results if not r.ok])
+        pts = [r for r in results if r.index >= 0]
+        total += len(pts)
+        seams |= {r.seam for r in pts}
+    assert total >= cc.MIN_POINTS, (total, cc.MIN_POINTS)
+    for req in ("winstore.wal_append", "segfile.append:jobs.seg",
+                "archive.append"):
+        assert req in seams, (req, sorted(seams))
+
+
+def test_required_seam_registry_check_fires(tmp_path):
+    """If a store stops crossing a seam the scenario requires (e.g. a
+    refactor silently drops the checkpoint rotation), the sweep reports
+    it as a registry failure instead of shrinking coverage."""
+    scn = cc.SCENARIOS["archive"]()
+    scn.required_seams = ("archive.append", "archive.never_crossed")
+    results = cc.sweep(scn, str(tmp_path), max_points=8)
+    reg = [r for r in results if r.index == -1]
+    assert reg and not reg[0].ok
+    assert "archive.never_crossed" in " ".join(reg[0].errors)
+
+
+def test_cli_quick_sweep_exits_zero(tmp_path):
+    env = dict(os.environ)
+    env["CRASHCHECK_DUMP_DIR"] = str(tmp_path / "dumps")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "foremast_tpu.devtools.crashcheck",
+         "--scenario", "winstore", "--max-points", "8", "--no-selftest",
+         "-q"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 failure(s)" in proc.stdout, proc.stdout
+    # -q keeps the per-point log quiet but the summary still prints
+    assert "crash points" in proc.stdout, proc.stdout

@@ -12,7 +12,6 @@ The two load-bearing contracts:
     device programs (the perf gate).
 """
 import json
-import math
 import threading
 
 import numpy as np
@@ -38,7 +37,6 @@ from foremast_tpu.engine import (
     JobStore,
     MetricQueries,
 )
-from foremast_tpu.engine import jobs as J
 from foremast_tpu.utils.timeutils import to_rfc3339
 
 STEP = 60

@@ -28,16 +28,16 @@ def _np_ses(x, mask, alpha):
 
 def _np_des(x, mask, alpha, beta):
     preds = np.zeros_like(x)
-    l = x[np.argmax(mask)]
+    lvl = x[np.argmax(mask)]
     b = 0.0
     for t in range(len(x)):
-        preds[t] = l + b
+        preds[t] = lvl + b
         if mask[t]:
-            l_new = alpha * x[t] + (1 - alpha) * (l + b)
-            b = beta * (l_new - l) + (1 - beta) * b
-            l = l_new
+            lvl_new = alpha * x[t] + (1 - alpha) * (lvl + b)
+            b = beta * (lvl_new - lvl) + (1 - beta) * b
+            lvl = lvl_new
         else:
-            l = l + b
+            lvl = lvl + b
     return preds
 
 

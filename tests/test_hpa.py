@@ -247,7 +247,7 @@ def test_closed_loop_converges_with_per_pod_normalization():
 
     rng = np.random.default_rng(2)
     T, region_len = 96, 30
-    hist_tps_per_pod = 25.0  # provisioned: 4 pods x 25 = 100 total
+    # provisioned: 4 pods x 25 tps/pod = 100 total
     surge = 2.5
 
     def score_once(replicas_now, replicas_hist, with_pods=True):

@@ -1,6 +1,5 @@
 """Instrumentation tests — CommonMetricsFilter semantics mirror the
 reference's CommonMetricsFilterTest (SURVEY.md §4)."""
-import io
 
 from foremast_tpu.examples.demo_app import Generator, build_demo, demo_app
 from foremast_tpu.instrumentation import (

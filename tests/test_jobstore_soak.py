@@ -22,7 +22,6 @@ The claims under test, end to end across a process boundary:
 
 Marked slow+chaos so tier-1 (-m 'not slow') stays fast.
 """
-import json
 import os
 import shutil
 import signal
@@ -38,6 +37,23 @@ from foremast_tpu.engine.jobs import JobStore, verdict_digest
 from foremast_tpu.engine.jobtier import JobTier
 
 pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+
+@pytest.fixture(autouse=True)
+def _debug_locks(monkeypatch):
+    """Soak under the lock-order tracer (FOREMAST_DEBUG_LOCKS=1), same
+    gate as the chaos soak: recovery + replay over the kill -9 debris
+    must also never exhibit a held-before cycle. The env var propagates
+    to the SIGKILLed child too (subprocess inherits os.environ), so the
+    parent-side assertion covers the recovery half and the child runs
+    with traced locks for free."""
+    from foremast_tpu.devtools.locktrace import tracer
+
+    monkeypatch.setenv("FOREMAST_DEBUG_LOCKS", "1")
+    tracer.reset()
+    yield
+    rep = tracer.report()
+    assert not rep["cycles"], rep["cycles"]
 
 
 _CHILD = textwrap.dedent("""

@@ -24,7 +24,7 @@ import pytest
 from foremast_tpu.dataplane import segfile
 from foremast_tpu.engine import jobs as J
 from foremast_tpu.engine.jobs import Document, JobStore, verdict_digest
-from foremast_tpu.engine.jobtier import JobTier, KIND_DOC
+from foremast_tpu.engine.jobtier import JobTier
 from foremast_tpu.resilience.faults import FaultInjector, FaultPlan
 
 

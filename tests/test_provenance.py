@@ -15,7 +15,6 @@ import json
 import os
 
 import numpy as np
-import pytest
 
 from foremast_tpu.dataplane import FixtureDataSource, VerdictExporter
 from foremast_tpu.engine import (

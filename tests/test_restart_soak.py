@@ -309,7 +309,6 @@ def test_restart_soak_kill9_recovers_without_refetch_storm(tmp_path):
                   90.0, interval=0.2, what="pushes spliced + checkpoint")
         ws = h.status(h.base_a)["window_store"]
         assert ws["segment_entries"] >= 1
-        wal_appends_before = ws["wal_appends"]
 
         # one more acked push, then kill -9 IMMEDIATELY: the ack means
         # the WAL holds it, so the restart must not lose it

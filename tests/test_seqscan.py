@@ -5,7 +5,6 @@ from __future__ import annotations
 
 import jax
 import numpy as np
-import pytest
 
 from foremast_tpu.ops import forecast as fc
 from foremast_tpu.ops import seqscan as sq

@@ -8,7 +8,6 @@ import pytest
 from foremast_tpu.dataplane import FixtureDataSource, VerdictExporter
 from foremast_tpu.engine import Analyzer, EngineConfig, JobStore
 from foremast_tpu.service import ForemastService, build_document, serve_background
-from foremast_tpu.service.api import ApiError
 from foremast_tpu.utils.timeutils import to_rfc3339
 
 

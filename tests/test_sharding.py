@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import time
 
-import pytest
 
 from foremast_tpu.engine import jobs as J
 from foremast_tpu.engine.archive import FileArchive

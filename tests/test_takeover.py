@@ -12,7 +12,6 @@ within the stuck window — two real OS processes, one shared archive.
 """
 from __future__ import annotations
 
-import json
 import os
 import signal
 import subprocess

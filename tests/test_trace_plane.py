@@ -18,7 +18,6 @@ import json
 import threading
 import urllib.request
 
-import numpy as np
 import pytest
 
 from foremast_tpu.dataplane.delta import DeltaWindowSource, parse_range_params

@@ -1,7 +1,5 @@
 """Trigger + wavefront-mirror tests (SURVEY.md §2.3, §3.5)."""
-import time
 
-import pytest
 
 from foremast_tpu.dataplane.exporter import VerdictExporter
 from foremast_tpu.dataplane.wavefront_sink import WavefrontSink
